@@ -1,0 +1,33 @@
+"""gol_tpu — TPU-native Game of Life benchmark framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of
+v-pap/Game-of-Life-in-parallel-MPI-OpenMP-CUDA (six standalone C/MPI/OpenMP/CUDA
+programs, reference mounted at /root/reference): same CLI contract, same text
+grid format, same B3/S23 toroidal semantics and early-exit behavior, rebuilt as
+one engine with pluggable policies:
+
+- compute kernels: ``lax`` slicing stencil or fused Pallas VMEM-tiled stencil
+  (the reference's CUDA kernels, src/game_cuda.cu:52-148, reimagined for TPU)
+- distribution: 2D ``jax.sharding.Mesh`` + ``shard_map`` with two-phase
+  ``ppermute`` halo exchange (the reference's 16 persistent MPI requests,
+  src/game_mpi.c:340-383, reimagined for ICI)
+- termination: on-device ``lax.while_loop`` with ``psum`` consensus votes (the
+  reference's MPI_Allreduce-per-generation, src/game_mpi_collective.c:331)
+- I/O: serial, gathered (master-scatter, src/game_mpi.c:201-239) and sharded
+  offset-pread/pwrite (collective MPI-IO, src/game_mpi_collective.c:174-196)
+"""
+
+from gol_tpu.config import GameConfig, DEFAULT_CONFIG, GEN_LIMIT, SIMILARITY_FREQUENCY
+from gol_tpu.oracle import evolve as oracle_evolve, run as oracle_run, Result
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "GameConfig",
+    "DEFAULT_CONFIG",
+    "GEN_LIMIT",
+    "SIMILARITY_FREQUENCY",
+    "oracle_evolve",
+    "oracle_run",
+    "Result",
+]

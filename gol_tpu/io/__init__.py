@@ -1,0 +1,11 @@
+"""Grid I/O: text format codec plus serial, gathered and sharded strategies."""
+
+from gol_tpu.io.text_grid import (
+    decode,
+    encode,
+    generate,
+    read_grid,
+    write_grid,
+)
+
+__all__ = ["decode", "encode", "generate", "read_grid", "write_grid"]

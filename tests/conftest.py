@@ -1,0 +1,18 @@
+"""Test harness: 8 virtual CPU devices so mesh/ppermute/psum paths run anywhere.
+
+This is the reference's `mpiexec -n <x>` (README.md:54-57) without a cluster:
+XLA hosts N fake devices on CPU, and the same shard_map code that rides ICI on
+a pod runs unit-tested here. Must run before any jax import.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,64 @@
+"""Text-grid codec tests: the byte-level format contract (README.md:61-63)."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.io import text_grid
+
+
+def test_encode_layout():
+    g = np.array([[1, 0, 1], [0, 1, 0]], dtype=np.uint8)
+    assert text_grid.encode(g) == b"101\n010\n"
+
+
+def test_roundtrip_random():
+    g = text_grid.generate(37, 23, seed=0)
+    assert g.shape == (23, 37)
+    data = text_grid.encode(g)
+    assert len(data) == 23 * (37 + 1)
+    back = text_grid.decode(data, 37, 23)
+    assert np.array_equal(back, g)
+
+
+def test_output_is_valid_input():
+    # The final output file is a valid input file (src/game.c:25-40 emits what
+    # src/game.c:154-165 parses) — the manual-resume property.
+    g = text_grid.generate(16, 16, seed=1)
+    assert np.array_equal(text_grid.decode(text_grid.encode(g), 16, 16), g)
+
+
+def test_decode_tolerates_missing_trailing_newline():
+    # Reference's fgetc parser doesn't require the final newline.
+    assert np.array_equal(
+        text_grid.decode(b"10\n01", 2, 2), np.array([[1, 0], [0, 1]], np.uint8)
+    )
+
+
+def test_decode_skips_interior_newlines_only():
+    # Any non-'\n' byte is a cell; only '1' is alive (src/game.c:158-164,83).
+    g = text_grid.decode(b"1x\n0 \n", 2, 2)
+    assert np.array_equal(g, np.array([[1, 0], [0, 0]], np.uint8))
+
+
+def test_decode_too_short_raises():
+    with pytest.raises(ValueError):
+        text_grid.decode(b"10\n", 2, 2)
+
+
+def test_file_roundtrip(tmp_path):
+    g = text_grid.generate(30, 30, seed=2)
+    p = tmp_path / "grid.out"
+    text_grid.write_grid(str(p), g)
+    assert p.read_bytes() == text_grid.encode(g)
+    assert np.array_equal(text_grid.read_grid(str(p), 30, 30), g)
+
+
+def test_generate_density_extremes():
+    assert text_grid.generate(8, 8, density=0.0, seed=0).sum() == 0
+    assert text_grid.generate(8, 8, density=1.0, seed=0).sum() == 64
+
+
+def test_generate_deterministic_with_seed():
+    a = text_grid.generate(12, 12, seed=42)
+    b = text_grid.generate(12, 12, seed=42)
+    assert np.array_equal(a, b)

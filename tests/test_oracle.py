@@ -1,0 +1,165 @@
+"""Semantics tests for the serial oracle — pins the reference's behavior.
+
+The reference has no tests; its de-facto methodology is differential runs of
+six programs on the same input (SURVEY.md §4). These known-pattern tests pin
+the GoL semantics that methodology assumes: rule B3/S23, toroidal wrap, the
+empty early-exit (src/game.c:177) and the similarity early-exit with its
+generation accounting (src/game.c:181-189,202).
+"""
+
+import numpy as np
+import pytest
+
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu import oracle
+
+
+def grid_from_strings(rows):
+    return np.array([[1 if c == "1" else 0 for c in r] for r in rows], dtype=np.uint8)
+
+
+class TestEvolve:
+    def test_blinker_period_two(self):
+        horiz = grid_from_strings(["00000", "00000", "01110", "00000", "00000"])
+        vert = grid_from_strings(["00000", "00100", "00100", "00100", "00000"])
+        assert np.array_equal(oracle.evolve(horiz), vert)
+        assert np.array_equal(oracle.evolve(vert), horiz)
+
+    def test_block_still_life(self):
+        block = grid_from_strings(["0000", "0110", "0110", "0000"])
+        assert np.array_equal(oracle.evolve(block), block)
+
+    def test_all_dead_stays_dead(self):
+        dead = np.zeros((6, 6), dtype=np.uint8)
+        assert np.array_equal(oracle.evolve(dead), dead)
+
+    def test_lone_cell_dies(self):
+        g = np.zeros((5, 5), dtype=np.uint8)
+        g[2, 2] = 1
+        assert oracle.evolve(g).sum() == 0
+
+    def test_birth_on_exactly_three(self):
+        g = grid_from_strings(["00000", "01010", "00000", "00100", "00000"])
+        # Cell (2,2) has exactly 3 neighbors -> born.
+        assert oracle.evolve(g)[2, 2] == 1
+
+    def test_toroidal_wrap_corners(self):
+        # Three cells clustered across the corner torus seam form a neighborhood.
+        g = np.zeros((6, 6), dtype=np.uint8)
+        g[0, 0] = g[0, 5] = g[5, 0] = 1
+        # Cell (5,5) touches all three via wrap -> born.
+        assert oracle.evolve(g)[5, 5] == 1
+
+    def test_glider_translates(self):
+        glider = grid_from_strings(
+            ["0100000", "0010000", "1110000", "0000000", "0000000", "0000000", "0000000"]
+        )
+        g = glider
+        for _ in range(4):
+            g = oracle.evolve(g)
+        # After 4 generations a glider moves one cell down-right.
+        assert np.array_equal(g, np.roll(glider, (1, 1), axis=(0, 1)))
+
+    def test_glider_wraps_around_torus(self):
+        glider = np.zeros((8, 8), dtype=np.uint8)
+        glider[0, 1] = glider[1, 2] = glider[2, 0] = glider[2, 1] = glider[2, 2] = 1
+        g = glider
+        for _ in range(4 * 8):  # 8 diagonal steps of 1 cell = full wrap
+            g = oracle.evolve(g)
+        assert np.array_equal(g, glider)
+
+
+class TestRunAccounting:
+    def test_all_dead_zero_generations(self):
+        # empty() is evaluated before the first generation (src/game.c:177).
+        r = oracle.run(np.zeros((8, 8), dtype=np.uint8))
+        assert r.generations == 0
+        assert r.grid.sum() == 0
+
+    def test_still_life_similarity_exit(self):
+        # block: every generation equals the last; the check fires when
+        # counter==SIMILARITY_FREQUENCY i.e. during generation 3, and the
+        # reference reports generation-1 = 2 (src/game.c:183-188,202).
+        block = grid_from_strings(["0000", "0110", "0110", "0000"])
+        r = oracle.run(block)
+        assert r.generations == 2
+        assert np.array_equal(r.grid, block)
+
+    def test_blinker_never_triggers_similarity(self):
+        # Period-2: consecutive generations always differ -> runs to gen_limit.
+        blinker = grid_from_strings(["00000", "00000", "01110", "00000", "00000"])
+        cfg = GameConfig(gen_limit=10)
+        r = oracle.run(blinker, cfg)
+        assert r.generations == 10
+
+    def test_gen_limit_inclusive(self):
+        # while (gen <= GEN_LIMIT) runs exactly GEN_LIMIT generations
+        # (src/game.c:177); glider on a big-enough torus never stabilizes.
+        glider = np.zeros((16, 16), dtype=np.uint8)
+        glider[0, 1] = glider[1, 2] = glider[2, 0] = glider[2, 1] = glider[2, 2] = 1
+        cfg = GameConfig(gen_limit=7, check_similarity=False)
+        r = oracle.run(glider, cfg)
+        assert r.generations == 7
+
+    def test_death_before_similarity_check(self):
+        # A lone cell dies in generation 1; the empty check at the top of
+        # generation 2 exits -> reports 1.
+        g = np.zeros((6, 6), dtype=np.uint8)
+        g[3, 3] = 1
+        r = oracle.run(g)
+        assert r.generations == 1
+        assert r.grid.sum() == 0
+
+    def test_check_similarity_off(self):
+        block = grid_from_strings(["0000", "0110", "0110", "0000"])
+        cfg = GameConfig(gen_limit=5, check_similarity=False)
+        r = oracle.run(block, cfg)
+        assert r.generations == 5  # still-life no longer exits early
+
+    def test_similarity_frequency_respected(self):
+        block = grid_from_strings(["0000", "0110", "0110", "0000"])
+        cfg = GameConfig(similarity_frequency=5)
+        r = oracle.run(block, cfg)
+        assert r.generations == 4  # fires during generation 5, reports 5-1
+
+
+class TestCudaConvention:
+    def test_full_run_counts_match_c(self):
+        # Neither convention exits early on a blinker; CUDA reports the same
+        # 0-based count after GEN_LIMIT iterations (src/game_cuda.cu:222,294).
+        blinker = grid_from_strings(["00000", "00000", "01110", "00000", "00000"])
+        c = oracle.run(blinker, GameConfig(gen_limit=10))
+        cu = oracle.run(blinker, GameConfig(gen_limit=10, convention=Convention.CUDA))
+        assert c.generations == cu.generations == 10
+        assert np.array_equal(c.grid, cu.grid)
+
+    def test_empty_exit_keeps_previous_generation(self):
+        # CUDA breaks before the swap on emptiness (src/game_cuda.cu:259-268):
+        # the written grid is the last non-empty generation and the count is
+        # one less than C's.
+        g = np.zeros((6, 6), dtype=np.uint8)
+        g[3, 3] = 1
+        cu = oracle.run(g, GameConfig(convention=Convention.CUDA))
+        assert cu.generations == 0
+        assert cu.grid.sum() == 1  # pre-evolve grid retained
+        c = oracle.run(g)
+        assert c.generations == 1
+        assert c.grid.sum() == 0
+
+    def test_initially_empty_runs_one_evolve(self):
+        # No emptiness test before the first evolve in CUDA.
+        cu = oracle.run(np.zeros((4, 4), dtype=np.uint8), GameConfig(convention=Convention.CUDA))
+        assert cu.generations == 0
+        assert cu.grid.sum() == 0
+
+    def test_similarity_exit_count(self):
+        block = grid_from_strings(["0000", "0110", "0110", "0000"])
+        cu = oracle.run(block, GameConfig(convention=Convention.CUDA))
+        # Breaks during iteration with generation==2 (0-based), prints 2.
+        assert cu.generations == 2
+        assert np.array_equal(cu.grid, block)
+
+
+def test_rejects_non_2d():
+    with pytest.raises(ValueError):
+        oracle.run(np.zeros((2, 2, 2), dtype=np.uint8))
