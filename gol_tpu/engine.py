@@ -1,0 +1,192 @@
+"""The simulation engine: one compiled program per run, zero host round-trips.
+
+Plays the role of every reference driver loop at once (src/game.c:177-196,
+src/game_mpi_collective.c:331-365, src/game_cuda.cu:222-276), collapsed into a
+single ``lax.while_loop`` that runs entirely on device:
+
+  cond:  alive & not-similar & generation bound     (the reference's
+         `while (!empty_all(...) && generation <= GEN_LIMIT)`)
+  body:  halo exchange -> stencil -> consensus votes -> carry swap
+
+The double-buffer pointer swap of the reference (src/game.c:191-194, and the
+odd/even duplicated MPI request sets it forces, src/game_mpi.c:340-383) is
+simply the while_loop carry: XLA double-buffers and races are impossible by
+construction. The CUDA program's per-generation device->host flag copy
+(src/game_cuda.cu:259-268) becomes an on-device psum feeding the loop cond, so
+the host blocks exactly once, at the end of the run.
+
+Both loop-accounting conventions in the reference are implemented; see
+``gol_tpu.config.Convention``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gol_tpu.config import Convention, DEFAULT_CONFIG, GameConfig
+from gol_tpu.ops import get_kernel
+from gol_tpu.parallel import collectives
+from gol_tpu.parallel.mesh import (
+    SINGLE_DEVICE,
+    Topology,
+    grid_sharding,
+    topology_for,
+    validate_grid,
+)
+
+
+@dataclasses.dataclass
+class EngineResult:
+    """Host-side view of a finished run."""
+
+    grid: np.ndarray  # uint8 {0,1}, global (height, width)
+    generations: int  # the count the matching reference variant would print
+
+
+def _evolve(cur: jnp.ndarray, kernel_fn, topology: Topology) -> jnp.ndarray:
+    return kernel_fn(cur, topology)
+
+
+def _similarity_vote(fire, cur, new, topology: Topology):
+    """Every-Kth-generation consensus that the generations are identical
+    (similarity_all, src/game_mpi_collective.c:98-109). Guarded by lax.cond so
+    the compare/reduce pass is only paid on firing generations."""
+    return jax.lax.cond(
+        fire,
+        lambda: collectives.all_agree(jnp.all(cur == new), topology),
+        lambda: jnp.asarray(False),
+    )
+
+
+def _simulate_c(grid, config: GameConfig, topology: Topology, kernel_fn):
+    """C-variant loop (src/game.c:177-196, src/game_mpi_collective.c:331-365).
+
+    Emptiness is checked at the top of every generation on the current grid;
+    the similarity break does not increment the counter; the reported count is
+    ``generation - 1``.
+    """
+    limit = jnp.int32(config.gen_limit)
+    freq = jnp.int32(config.similarity_frequency)
+
+    def cond(state):
+        _, gen, _, alive, similar = state
+        return alive & jnp.logical_not(similar) & (gen <= limit)
+
+    def body(state):
+        cur, gen, counter, _, _ = state
+        new = _evolve(cur, kernel_fn, topology)
+        if config.check_similarity:
+            fire = (counter + 1) == freq
+            similar = _similarity_vote(fire, cur, new, topology)
+            counter = jnp.where(fire, 0, counter + 1)
+        else:
+            similar = jnp.asarray(False)
+        alive = collectives.any_flag(jnp.any(new), topology)
+        gen = jnp.where(similar, gen, gen + 1)
+        return (new, gen, counter, alive, similar)
+
+    alive0 = collectives.any_flag(jnp.any(grid), topology)
+    state0 = (grid, jnp.int32(1), jnp.int32(0), alive0, jnp.asarray(False))
+    final, gen, _, _, _ = jax.lax.while_loop(cond, body, state0)
+    return final, gen - 1
+
+
+def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel_fn):
+    """CUDA-variant loop (src/game_cuda.cu:222-276).
+
+    0-based exclusive bound; no emptiness test before the first evolve; the
+    emptiness test runs on the new grid and breaks *before* the swap, so an
+    empty exit keeps the last non-empty generation; reported count is the raw
+    counter. Checks scan the interior only — deliberately not the binary's
+    stale-halo padded scan; see gol_tpu.oracle._run_cuda.
+    """
+    limit = jnp.int32(config.gen_limit)
+    freq = jnp.int32(config.similarity_frequency)
+
+    def cond(state):
+        _, gen, _, stop = state
+        return jnp.logical_not(stop) & (gen < limit)
+
+    def body(state):
+        cur, gen, counter, _ = state
+        new = _evolve(cur, kernel_fn, topology)
+        if config.check_similarity:
+            fire = (counter + 1) == freq
+            similar = _similarity_vote(fire, cur, new, topology)
+            counter = jnp.where(fire, 0, counter + 1)
+        else:
+            similar = jnp.asarray(False)
+        empty = jnp.logical_not(collectives.any_flag(jnp.any(new), topology))
+        stop = similar | empty
+        cur = jnp.where(stop, cur, new)  # break precedes the swap (:250,:266)
+        gen = jnp.where(stop, gen, gen + 1)
+        return (cur, gen, counter, stop)
+
+    state0 = (grid, jnp.int32(0), jnp.int32(0), jnp.asarray(False))
+    final, gen, _, _ = jax.lax.while_loop(cond, body, state0)
+    return final, gen
+
+
+_SIMULATORS = {Convention.C: _simulate_c, Convention.CUDA: _simulate_cuda}
+
+
+@functools.lru_cache(maxsize=64)
+def make_runner(
+    shape: tuple[int, int],
+    config: GameConfig = DEFAULT_CONFIG,
+    mesh: Mesh | None = None,
+    kernel: str = "lax",
+):
+    """Compile a ``global_grid -> (global_grid, generations)`` runner.
+
+    With a mesh, the runner is a ``shard_map`` over ('row', 'col') — the
+    topology/bootstrap step the reference does with MPI_Init + MPI_Cart_create
+    (src/game_mpi_collective.c:116-133) happens here, at trace time.
+    """
+    kernel_fn = get_kernel(kernel)
+    topology = topology_for(mesh)
+    simulate = _SIMULATORS[config.convention]
+    validate_grid(shape[0], shape[1], topology)
+
+    def local_fn(g):
+        return simulate(g, config, topology, kernel_fn)
+
+    if topology.distributed:
+        fn = jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=P(*topology.axes),
+            out_specs=(P(*topology.axes), P()),
+        )
+    else:
+        fn = local_fn
+    return jax.jit(fn)
+
+
+def put_grid(grid, mesh: Mesh | None = None) -> jax.Array:
+    """Place a host grid onto the device(s) with the engine's sharding."""
+    arr = np.ascontiguousarray(np.asarray(grid, dtype=np.uint8))
+    if mesh is None:
+        return jnp.asarray(arr)
+    return jax.device_put(arr, grid_sharding(mesh))
+
+
+def simulate(
+    grid,
+    config: GameConfig = DEFAULT_CONFIG,
+    mesh: Mesh | None = None,
+    kernel: str = "lax",
+) -> EngineResult:
+    """Run a full simulation and fetch the result to the host."""
+    shape = tuple(np.shape(grid))
+    validate_grid(shape[0], shape[1], topology_for(mesh))
+    device_grid = grid if isinstance(grid, jax.Array) else put_grid(grid, mesh)
+    runner = make_runner(shape, config, mesh, kernel)
+    final, gen = runner(device_grid)
+    return EngineResult(np.asarray(jax.device_get(final), dtype=np.uint8), int(gen))

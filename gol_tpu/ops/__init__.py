@@ -1,0 +1,33 @@
+"""Compute kernels: the 3x3 Moore stencil in lax and Pallas flavors.
+
+A kernel is a callable ``evolve(cur, topology) -> new`` mapping a shard's
+(h, w) uint8 block to the next generation, owning its own halo strategy:
+the lax kernel wraps locally via rolls or exchanges ghosts via ppermute;
+the Pallas kernel fuses halo handling into its VMEM tiling.
+"""
+
+from __future__ import annotations
+
+from gol_tpu.ops import stencil_lax
+from gol_tpu.parallel import halo
+from gol_tpu.parallel.mesh import Topology
+
+
+def lax_evolve(cur, topology: Topology):
+    if topology.distributed:
+        return stencil_lax.evolve_padded(halo.exchange(cur, topology))
+    return stencil_lax.evolve_torus(cur)
+
+
+def get_kernel(name: str):
+    """Resolve a kernel name to an ``(cur, topology) -> new`` evolve function."""
+    kernels = {"lax": lax_evolve}
+    try:
+        from gol_tpu.ops.stencil_pallas import pallas_evolve
+
+        kernels["pallas"] = pallas_evolve
+    except ImportError:  # pragma: no cover - pallas unavailable on some backends
+        pass
+    if name not in kernels:
+        raise ValueError(f"unknown kernel {name!r}; available: {sorted(kernels)}")
+    return kernels[name]

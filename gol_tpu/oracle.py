@@ -79,10 +79,15 @@ def _run_cuda(grid: np.ndarray, config: GameConfig) -> Result:
     Differences vs ``_run_c``: no emptiness test before the first evolve; the
     emptiness test runs on the *new* grid and breaks before the swap, so an
     empty exit keeps (and writes) the last non-empty generation; the counter
-    is 0-based and printed un-decremented (src/game_cuda.cu:294). The
-    similarity comparison is on the interior (the reference compares the
-    padded arrays, src/game_cuda.cu:243-249, equivalent on a torus once the
-    halo kernels have run).
+    is 0-based and printed un-decremented (src/game_cuda.cu:294).
+
+    Deliberate divergence: the real binary's compare/empty kernels scan the
+    *padded* arrays (src/game_cuda.cu:243,259) whose d_new_univ ghost ring is
+    stale — the halo kernels only ever run on d_univ (src/game_cuda.cu:
+    224-231) — so live leftover border bytes can delay its early exits by a
+    generation when death/stabilization coincides with earlier live borders.
+    This build checks the interior only (exits are never later than the
+    binary's); reproducing the stale-memory artifact is a non-goal.
     """
     generation = 0
     counter = 0

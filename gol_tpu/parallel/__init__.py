@@ -1,0 +1,13 @@
+"""Distribution layer: mesh construction, halo exchange, consensus collectives.
+
+The TPU-native counterpart of the reference's MPI machinery: a 2D
+``jax.sharding.Mesh`` replaces ``MPI_Cart_create`` (src/game_mpi_collective.c:
+120-133), two-phase ``ppermute`` shifts replace the 16 persistent halo requests
+(src/game_mpi.c:340-383), and ``psum`` consensus replaces ``MPI_Allreduce``
+(src/game_mpi_collective.c:70-109).
+"""
+
+from gol_tpu.parallel.mesh import Topology, choose_mesh_shape, make_mesh, validate_grid
+from gol_tpu.parallel.halo import exchange
+
+__all__ = ["Topology", "choose_mesh_shape", "make_mesh", "validate_grid", "exchange"]

@@ -1,0 +1,32 @@
+"""Consensus collectives for termination votes.
+
+The reference's termination consensus is one MPI_Allreduce(SUM) of a 0/1 flag
+per check, compared against comm_sz (empty_all / similarity_all,
+src/game_mpi_collective.c:70-81,98-109). Here the same vote is a ``psum`` over
+both mesh axes inside the compiled step — it rides ICI and never touches the
+host, which is what removes the reference CUDA program's
+device-to-host-flag-per-generation bottleneck (src/game_cuda.cu:259-268).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.parallel.mesh import Topology
+
+
+def all_agree(local_flag: jnp.ndarray, topology: Topology) -> jnp.ndarray:
+    """True iff every shard's flag is true (the `global_sum == comm_sz` vote,
+    src/game_mpi_collective.c:80)."""
+    if not topology.distributed:
+        return local_flag
+    votes = jax.lax.psum(local_flag.astype(jnp.int32), topology.axes)
+    return votes == topology.num_devices
+
+
+def any_flag(local_flag: jnp.ndarray, topology: Topology) -> jnp.ndarray:
+    """True iff any shard's flag is true (alive-anywhere vote)."""
+    if not topology.distributed:
+        return local_flag
+    return jax.lax.psum(local_flag.astype(jnp.int32), topology.axes) > 0

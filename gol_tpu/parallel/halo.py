@@ -1,0 +1,61 @@
+"""Two-phase toroidal halo exchange.
+
+The reference exchanges halos with 16 persistent MPI requests — N/S rows, E/W
+columns via an MPI_Type_vector column datatype, and 4 corner singles
+(src/game_mpi.c:340-383, src/game_mpi_collective.c:287-326). On TPU the whole
+exchange is two ``ppermute`` phases per axis inside the compiled step:
+
+  phase 1  rows:    each shard sends its last interior row to its south
+                    neighbor and its first to its north neighbor
+  phase 2  columns: the same east/west, but over the *row-extended* (h+2, w)
+                    block — so the received columns already contain the
+                    diagonal neighbors' corner cells and no separate corner
+                    messages exist.
+
+Phase 2 covering the corners for free is the reference's own CUDA trick
+(halo_cols runs over the extended index range 0..width+1, src/game_cuda.cu:
+64-74); here it also replaces the reference's 8 corner requests.
+
+On a mesh axis of size 1 the torus wrap degenerates to a local edge copy (what
+the CUDA halo kernels do on a single device, src/game_cuda.cu:52-74), so the
+same engine serves 1x1 .. RxC meshes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.parallel.mesh import Topology, ROW_AXIS, COL_AXIS
+
+
+def _ring_perms(size: int) -> tuple[list, list]:
+    forward = [(i, (i + 1) % size) for i in range(size)]
+    backward = [(i, (i - 1) % size) for i in range(size)]
+    return forward, backward
+
+
+def _extend(x: jnp.ndarray, axis: int, axis_name: str | None, size: int) -> jnp.ndarray:
+    """Add the two ghost slices along ``axis`` (torus wrap across shards)."""
+    first = jax.lax.slice_in_dim(x, 0, 1, axis=axis)
+    last = jax.lax.slice_in_dim(x, x.shape[axis] - 1, x.shape[axis], axis=axis)
+    if axis_name is None or size == 1:
+        # Wrap is local: my own far edge is my ghost (src/game_cuda.cu:52-74).
+        ghost_before, ghost_after = last, first
+    else:
+        forward, backward = _ring_perms(size)
+        # Sending my last slice "forward" delivers my predecessor's last slice
+        # to me: the ghost before my first row/col.
+        ghost_before = jax.lax.ppermute(last, axis_name, forward)
+        ghost_after = jax.lax.ppermute(first, axis_name, backward)
+    return jnp.concatenate([ghost_before, x, ghost_after], axis=axis)
+
+
+def exchange(local: jnp.ndarray, topology: Topology) -> jnp.ndarray:
+    """Return the (h+2, w+2) halo-extended block for a (h, w) shard."""
+    rows, cols = topology.shape
+    row_axis = ROW_AXIS if topology.distributed else None
+    col_axis = COL_AXIS if topology.distributed else None
+    extended = _extend(local, 0, row_axis, rows)
+    # Column phase runs over the row-extended block: corners ride along.
+    return _extend(extended, 1, col_axis, cols)

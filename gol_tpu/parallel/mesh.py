@@ -1,0 +1,116 @@
+"""Device mesh construction and domain-decomposition bookkeeping.
+
+The reference decomposes the grid over a fully periodic sqrtP x sqrtP
+Cartesian process grid built with ``MPI_Cart_create(..., periods={1,1},
+reorder=1)`` (src/game_mpi_collective.c:120-133), each rank owning a
+``(width/sqrtP) x (height/sqrtP)`` block plus a one-cell ghost ring. Here the
+process grid is a ``jax.sharding.Mesh`` with axes ``('row', 'col')`` laid out
+over ICI; the periodic boundary is realized by ``ppermute`` rings (the physical
+ICI torus makes the wrap literal on real pods). Unlike MPI ranks, shards never
+materialize ghost cells in their owned array — halos live only inside the
+compiled step (see gol_tpu/parallel/halo.py).
+
+The reference implicitly requires a perfect-square process count and square
+grids divisible by sqrtP (forced ``height = width``, src/game_mpi.c:504;
+truncating ``width / rows_columns``, src/game_mpi.c:172). This build supports
+any R x C mesh and rectangular grids but validates divisibility loudly instead
+of silently truncating.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROW_AXIS = "row"
+COL_AXIS = "col"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Static description of how the grid is laid out over devices.
+
+    ``shape == (1, 1)`` with ``axes == ()`` is the single-device engine: halo
+    wrap is local and consensus reductions are identities. Otherwise ``axes``
+    names both mesh axes and collectives ride them.
+    """
+
+    shape: tuple[int, int] = (1, 1)
+    axes: tuple[str, ...] = ()
+
+    @property
+    def num_devices(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+    @property
+    def distributed(self) -> bool:
+        return bool(self.axes)
+
+
+SINGLE_DEVICE = Topology()
+MESH_TOPOLOGY_AXES = (ROW_AXIS, COL_AXIS)
+
+
+def choose_mesh_shape(n_devices: int) -> tuple[int, int]:
+    """Pick the most-square R x C factorization of ``n_devices``.
+
+    The reference only accepts perfect squares (``sqrt(comm_sz)`` truncation,
+    src/game_mpi_collective.c:125); a near-square factorization keeps the
+    O(perimeter) halo volume minimal while accepting any device count.
+    """
+    r = int(math.isqrt(n_devices))
+    while n_devices % r != 0:
+        r -= 1
+    return r, n_devices // r
+
+
+def make_mesh(
+    rows: int | None = None,
+    cols: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build the 2D ('row', 'col') device mesh."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if rows is None and cols is None:
+        rows, cols = choose_mesh_shape(n)
+    elif rows is None:
+        rows = n // cols
+    elif cols is None:
+        cols = n // rows
+    if rows * cols > n:
+        raise ValueError(f"mesh {rows}x{cols} needs {rows * cols} devices, have {n}")
+    return jax.make_mesh((rows, cols), MESH_TOPOLOGY_AXES, devices=devices[: rows * cols])
+
+
+def topology_for(mesh: Mesh | None) -> Topology:
+    if mesh is None:
+        return SINGLE_DEVICE
+    shape = (mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS])
+    if shape == (1, 1):
+        return SINGLE_DEVICE
+    return Topology(shape=shape, axes=MESH_TOPOLOGY_AXES)
+
+
+def grid_sharding(mesh: Mesh) -> NamedSharding:
+    """Block sharding of the (height, width) grid over the mesh."""
+    return NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+
+def validate_grid(height: int, width: int, topology: Topology) -> tuple[int, int]:
+    """Check divisibility and return the local shard shape.
+
+    The reference silently truncates (src/game_mpi.c:172) and corrupts the run
+    when the grid doesn't divide; here it is a loud error (SURVEY.md §7).
+    """
+    rows, cols = topology.shape
+    if height % rows != 0 or width % cols != 0:
+        raise ValueError(
+            f"grid {height}x{width} does not divide over a {rows}x{cols} mesh; "
+            f"height must be a multiple of {rows} and width of {cols}"
+        )
+    return height // rows, width // cols

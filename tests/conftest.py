@@ -2,17 +2,25 @@
 
 This is the reference's `mpiexec -n <x>` (README.md:54-57) without a cluster:
 XLA hosts N fake devices on CPU, and the same shard_map code that rides ICI on
-a pod runs unit-tested here. Must run before any jax import.
+a pod runs unit-tested here.
+
+Note: this environment preloads jax at interpreter start (sitecustomize), so
+JAX_PLATFORMS in os.environ is already consumed; the platform must be forced
+through jax.config instead. XLA_FLAGS is still honored because backends
+initialize lazily, on the first jax.devices() call.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,158 @@
+"""Engine vs oracle differential tests — the reference's six-programs-one-input
+methodology (SURVEY.md §4) automated, on 1x1 through RxC CPU meshes."""
+
+import numpy as np
+import pytest
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.io import text_grid
+from gol_tpu.parallel import make_mesh
+from gol_tpu.parallel.mesh import validate_grid, topology_for, choose_mesh_shape
+
+MESH_SHAPES = [(1, 1), (2, 2), (2, 4), (4, 2), (1, 8), (8, 1)]
+
+
+def mesh_or_none(rows, cols):
+    if (rows, cols) == (1, 1):
+        return None
+    return make_mesh(rows, cols)
+
+
+class TestSingleDevice:
+    def test_random_matches_oracle(self):
+        g = text_grid.generate(64, 64, seed=3)
+        cfg = GameConfig(gen_limit=50)
+        got = engine.simulate(g, cfg)
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations == 50
+        assert np.array_equal(got.grid, want.grid)
+
+    def test_rectangular_grid(self):
+        g = text_grid.generate(48, 24, seed=4)  # width=48, height=24
+        cfg = GameConfig(gen_limit=20)
+        got = engine.simulate(g, cfg)
+        want = oracle.run(g, cfg)
+        assert np.array_equal(got.grid, want.grid)
+
+    def test_similarity_exit(self):
+        block = np.zeros((8, 8), np.uint8)
+        block[3:5, 3:5] = 1
+        got = engine.simulate(block)
+        assert got.generations == 2
+        assert np.array_equal(got.grid, block)
+
+    def test_empty_exit(self):
+        lone = np.zeros((8, 8), np.uint8)
+        lone[4, 4] = 1
+        got = engine.simulate(lone)
+        assert got.generations == 1
+        assert got.grid.sum() == 0
+
+    def test_all_dead_zero_generations(self):
+        got = engine.simulate(np.zeros((8, 8), np.uint8))
+        assert got.generations == 0
+
+    def test_gen_limit_zero(self):
+        g = text_grid.generate(8, 8, seed=0)
+        got = engine.simulate(g, GameConfig(gen_limit=0))
+        assert got.generations == 0
+        assert np.array_equal(got.grid, g)
+
+    def test_check_similarity_off(self):
+        block = np.zeros((8, 8), np.uint8)
+        block[3:5, 3:5] = 1
+        got = engine.simulate(block, GameConfig(gen_limit=5, check_similarity=False))
+        assert got.generations == 5
+
+
+class TestCudaConvention:
+    def test_random_matches_cuda_oracle(self):
+        g = text_grid.generate(32, 32, seed=5)
+        cfg = GameConfig(gen_limit=40, convention=Convention.CUDA)
+        got = engine.simulate(g, cfg)
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations
+        assert np.array_equal(got.grid, want.grid)
+
+    def test_empty_exit_keeps_previous_generation(self):
+        lone = np.zeros((8, 8), np.uint8)
+        lone[4, 4] = 1
+        got = engine.simulate(lone, GameConfig(convention=Convention.CUDA))
+        assert got.generations == 0
+        assert got.grid.sum() == 1
+
+    def test_similarity_exit(self):
+        block = np.zeros((8, 8), np.uint8)
+        block[3:5, 3:5] = 1
+        got = engine.simulate(block, GameConfig(convention=Convention.CUDA))
+        assert got.generations == 2
+        assert np.array_equal(got.grid, block)
+
+
+class TestDistributed:
+    @pytest.mark.parametrize("rows,cols", MESH_SHAPES)
+    def test_random_matches_oracle_on_mesh(self, rows, cols):
+        g = text_grid.generate(32, 32, seed=6)
+        cfg = GameConfig(gen_limit=30)
+        got = engine.simulate(g, cfg, mesh=mesh_or_none(rows, cols))
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations
+        assert np.array_equal(got.grid, want.grid)
+
+    def test_glider_crosses_shard_boundaries_and_wraps(self):
+        # A glider travelling diagonally crosses every ppermute boundary and
+        # the torus seam — the halo-exchange acid test (SURVEY.md §4d).
+        g = np.zeros((16, 16), np.uint8)
+        g[0, 1] = g[1, 2] = g[2, 0] = g[2, 1] = g[2, 2] = 1
+        cfg = GameConfig(gen_limit=4 * 16, check_similarity=False)
+        got = engine.simulate(g, cfg, mesh=make_mesh(2, 4))
+        assert np.array_equal(got.grid, g)  # full wrap returns it home
+
+    def test_similarity_exit_on_mesh(self):
+        # Still life spanning a shard boundary: the similarity consensus must
+        # agree across shards (psum vote, src/game_mpi_collective.c:98-109).
+        block = np.zeros((8, 8), np.uint8)
+        block[3:5, 3:5] = 1  # straddles the 2x2 mesh center seam
+        got = engine.simulate(block, mesh=make_mesh(2, 2))
+        assert got.generations == 2
+        assert np.array_equal(got.grid, block)
+
+    def test_empty_exit_on_mesh(self):
+        lone = np.zeros((8, 8), np.uint8)
+        lone[0, 0] = 1  # dies; exercises the alive psum vote
+        got = engine.simulate(lone, mesh=make_mesh(2, 2))
+        assert got.generations == 1
+        assert got.grid.sum() == 0
+
+    def test_cuda_convention_on_mesh(self):
+        g = text_grid.generate(32, 32, seed=7)
+        cfg = GameConfig(gen_limit=25, convention=Convention.CUDA)
+        got = engine.simulate(g, cfg, mesh=make_mesh(2, 2))
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations
+        assert np.array_equal(got.grid, want.grid)
+
+    def test_indivisible_grid_rejected(self):
+        g = text_grid.generate(30, 30, seed=0)
+        with pytest.raises(ValueError, match="does not divide"):
+            engine.simulate(g, mesh=make_mesh(4, 2))
+
+    def test_determinism(self):
+        g = text_grid.generate(32, 32, seed=8)
+        cfg = GameConfig(gen_limit=20)
+        a = engine.simulate(g, cfg, mesh=make_mesh(2, 2))
+        b = engine.simulate(g, cfg, mesh=make_mesh(2, 2))
+        assert np.array_equal(a.grid, b.grid)
+
+
+def test_choose_mesh_shape():
+    assert choose_mesh_shape(8) == (2, 4)
+    assert choose_mesh_shape(16) == (4, 4)
+    assert choose_mesh_shape(1) == (1, 1)
+    assert choose_mesh_shape(7) == (1, 7)
+
+
+def test_validate_grid_local_shape():
+    topo = topology_for(make_mesh(2, 4))
+    assert validate_grid(16, 32, topo) == (8, 8)
