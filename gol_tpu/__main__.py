@@ -1,0 +1,7 @@
+"""``python -m gol_tpu`` — the ``./a.out`` of the TPU build."""
+
+import sys
+
+from gol_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,234 @@
+"""CLI entry point — the reference's ``./a.out <width> <height> <input_file>``.
+
+One binary replaces six: ``--variant`` selects the reference program being
+reproduced (same output filename, same printed lines, same accounting). The
+contract mirrored from the reference mains (src/game.c:224-245,
+src/game_mpi_collective.c:466-489):
+
+- ``width = atoi(argv[1])``, ``height = atoi(argv[2])`` — C atoi semantics,
+  non-numeric parses to 0;
+- non-positive dimensions default to 30x30;
+- distributed variants force ``height = width`` (src/game_mpi.c:504);
+- with no input file the simulation is skipped and only ``Finished`` prints
+  (src/game.c:238-241) — and the openmp variant prints nothing at all, since
+  its final printf is commented out (src/game_openmp.c:501);
+- timings print as ``<Phase>:\\t<ms> msecs`` from the lead process only.
+
+Additional subcommand: ``generate <width> <height>`` replaces generate.sh
+(emitting the contractual height rows x width cols; the script transposes,
+generate.sh:6-13).
+
+Divergences (documented, deliberate): Execution time is wall-clock for every
+variant (the serial reference prints CPU time via clock(), src/game.c:175,199);
+the cuda variant validates argv instead of segfaulting (src/game_cuda.cu:
+155-156 reads argv unchecked); compile time is excluded from Execution time —
+the analog of the reference building its persistent requests before starting
+the loop timer (src/game_mpi_collective.c:278-328).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import time
+
+import numpy as np
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import DEFAULT_HEIGHT, DEFAULT_WIDTH, GameConfig
+from gol_tpu.io import sharded, text_grid
+from gol_tpu.variants import VARIANTS, Variant, get_variant
+
+
+def atoi(s: str | None) -> int:
+    """C atoi: optional sign + leading digits, anything else is 0."""
+    if not s:
+        return 0
+    m = re.match(r"\s*([+-]?\d+)", s)
+    return int(m.group(1)) if m else 0
+
+
+def _parse_mesh_arg(spec: str | None, distributed: bool):
+    import jax
+
+    from gol_tpu.parallel.mesh import make_mesh
+
+    if not distributed:
+        if spec:
+            raise ValueError(
+                "--mesh only applies to distributed variants "
+                "(mpi/collective/async/openmp/tpu); this variant is single-device"
+            )
+        return None
+    if spec:
+        m = re.fullmatch(r"(\d+)x(\d+)", spec)
+        if not m:
+            raise ValueError(f"--mesh must look like RxC, got {spec!r}")
+        return make_mesh(int(m.group(1)), int(m.group(2)))
+    return make_mesh(devices=jax.devices())
+
+
+def _read_phase(variant: Variant, path: str, width: int, height: int, mesh):
+    if variant.io == "serial":
+        return engine.put_grid(text_grid.read_grid(path, width, height), mesh)
+    if variant.io == "gathered":
+        return sharded.read_gathered(path, width, height, mesh)
+    return sharded.read_sharded(
+        path, width, height, mesh, parallel=(variant.io == "sharded_async")
+    )
+
+
+def _write_phase(variant: Variant, path: str, grid) -> None:
+    if variant.io == "serial":
+        text_grid.write_grid(path, np.asarray(grid, dtype=np.uint8))
+    elif variant.io == "gathered":
+        sharded.write_gathered(path, grid)
+    else:
+        sharded.write_sharded(path, grid, parallel=(variant.io == "sharded_async"))
+
+
+def _run(args) -> int:
+    variant = get_variant(args.variant)
+    width, height = atoi(args.width), atoi(args.height)
+    if variant.force_square:
+        height = width  # src/game_mpi.c:504
+    if width <= 0:
+        width = DEFAULT_WIDTH
+    if height <= 0:
+        height = DEFAULT_HEIGHT
+
+    if args.input_file is None:
+        # Simulation skipped entirely (src/game.c:238-241).
+        if variant.final_finished:
+            print("Finished")
+        return 0
+
+    config = GameConfig(
+        gen_limit=args.gen_limit,
+        check_similarity=not args.no_check_similarity,
+        similarity_frequency=args.similarity_frequency,
+        convention=variant.convention,
+    )
+    output_path = args.output or f"./{variant.output_file}"
+
+    if args.host:
+        return _run_host(args, variant, config, width, height, output_path)
+
+    mesh = _parse_mesh_arg(args.mesh, variant.distributed)
+    from gol_tpu.parallel.mesh import topology_for, validate_grid
+
+    validate_grid(height, width, topology_for(mesh))
+
+    t0 = time.perf_counter()
+    device_grid = _read_phase(variant, args.input_file, width, height, mesh)
+    read_ms = (time.perf_counter() - t0) * 1000
+    if variant.io_timings:
+        print(f"Reading file:\t{read_ms:.2f} msecs")
+
+    runner = engine.make_runner((height, width), config, mesh, args.kernel)
+    compiled = runner.lower(device_grid).compile()
+
+    t0 = time.perf_counter()
+    final, gen = compiled(device_grid)
+    generations = int(gen)  # blocks until the on-device loop finishes
+    exec_ms = (time.perf_counter() - t0) * 1000
+
+    if variant.serial_header:
+        print("Finished.\n")
+    print(f"Generations:\t{generations}")
+    print(f"Execution time:\t{exec_ms:.2f} msecs")
+
+    t0 = time.perf_counter()
+    _write_phase(variant, output_path, final)
+    write_ms = (time.perf_counter() - t0) * 1000
+    if variant.io_timings:
+        print(f"Writing file:\t{write_ms:.2f} msecs")
+
+    if variant.final_finished:
+        print("Finished")
+    return 0
+
+
+def _run_host(args, variant, config, width, height, output_path) -> int:
+    """--host: the NumPy oracle path, no accelerator involved."""
+    grid = text_grid.read_grid(args.input_file, width, height)
+    t0 = time.perf_counter()
+    result = oracle.run(grid, config)
+    exec_ms = (time.perf_counter() - t0) * 1000
+    if variant.serial_header:
+        print("Finished.\n")
+    print(f"Generations:\t{result.generations}")
+    print(f"Execution time:\t{exec_ms:.2f} msecs")
+    text_grid.write_grid(output_path, result.grid)
+    if variant.final_finished:
+        print("Finished")
+    return 0
+
+
+def _generate(args) -> int:
+    grid = text_grid.generate(
+        args.width, args.height, density=args.density, seed=args.seed
+    )
+    data = text_grid.encode(grid)
+    if args.output:
+        with open(args.output, "wb") as f:
+            f.write(data)
+    else:
+        sys.stdout.write(data.decode("ascii"))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="gol",
+        description="TPU-native Game of Life (rebuild of the MPI/OpenMP/CUDA reference)",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    run = sub.add_parser("run", help="run a simulation (also the default command)")
+    run.add_argument("width", nargs="?", default=None)
+    run.add_argument("height", nargs="?", default=None)
+    run.add_argument("input_file", nargs="?", default=None)
+    run.add_argument(
+        "--variant",
+        default="tpu",
+        choices=sorted(VARIANTS),
+        help="which reference program to reproduce (default: the TPU-native flagship)",
+    )
+    run.add_argument("--mesh", default=None, help="device mesh RxC (default: all devices)")
+    run.add_argument("--kernel", default="lax", help="stencil kernel: lax or pallas")
+    run.add_argument("--gen-limit", type=int, default=GameConfig().gen_limit)
+    run.add_argument(
+        "--similarity-frequency", type=int, default=GameConfig().similarity_frequency
+    )
+    run.add_argument("--no-check-similarity", action="store_true")
+    run.add_argument("--output", default=None, help="override the output file path")
+    run.add_argument("--host", action="store_true", help="run the NumPy oracle on CPU")
+    run.set_defaults(func=_run)
+
+    gen = sub.add_parser("generate", help="emit a random grid (replaces generate.sh)")
+    gen.add_argument("width", type=int)
+    gen.add_argument("height", type=int)
+    gen.add_argument("-o", "--output", default=None)
+    gen.add_argument("--seed", type=int, default=None)
+    gen.add_argument("--density", type=float, default=0.5)
+    gen.set_defaults(func=_generate)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
+    if not argv or argv[0] not in ("run", "generate", "-h", "--help"):
+        argv = ["run", *argv]
+    args = build_parser().parse_args(argv)
+    try:
+        return args.func(args)
+    except (ValueError, OSError) as e:
+        print(f"gol: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,6 @@ from gol_tpu.config import Convention, DEFAULT_CONFIG, GameConfig
 from gol_tpu.ops import get_kernel
 from gol_tpu.parallel import collectives
 from gol_tpu.parallel.mesh import (
-    SINGLE_DEVICE,
     Topology,
     grid_sharding,
     topology_for,

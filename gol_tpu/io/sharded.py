@@ -1,0 +1,130 @@
+"""Sharded grid I/O: every shard reads/writes its own file window.
+
+TPU-native counterpart of the reference's MPI-IO paths. The file is modeled as
+a ``height x (width+1)`` byte matrix whose last column holds the newline chars
+— exactly the ``MPI_Type_create_subarray`` view of the collective variant
+(src/game_mpi_collective.c:174-196). Reads go through a strided memmap window
+per shard (no rank ever touches another rank's bytes); writes reproduce the
+east-edge trick: shards in the last mesh column own their rows' newline bytes
+(src/game_mpi_collective.c:382-393), so the collective write is byte-exact
+without any gather.
+
+Strategies, mirroring the reference's three I/O engines:
+
+- ``read_sharded`` / ``write_sharded``: the collective path
+  (``MPI_File_read_all`` / ``write_all``, src/game_mpi_collective.c:194,441).
+- the same with ``parallel=True``: the async path (``MPI_File_iread`` /
+  ``iwrite``, src/game_mpi_async.c:194-198,444-446) — except genuinely
+  overlapped via a thread pool where the reference waits immediately.
+- ``read_gathered`` / ``write_gathered``: the master-scatter path — rank 0
+  reads/writes everything and blocks are scattered/gathered
+  (src/game_mpi.c:201-239,429-467); kept as the debug-mode I/O.
+
+On a multi-host pod each process only materializes its addressable shards, so
+no host ever holds the full grid — the property the reference gets from
+MPI-IO file views.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from gol_tpu.io.text_grid import NEWLINE, ONE, ZERO, row_stride
+from gol_tpu.parallel.mesh import grid_sharding
+
+
+def _file_view(path: str, width: int, height: int, mode: str) -> np.memmap:
+    return np.memmap(path, dtype=np.uint8, mode=mode, shape=(height, row_stride(width)))
+
+
+def read_sharded(
+    path: str,
+    width: int,
+    height: int,
+    mesh: Mesh,
+    parallel: bool = False,
+) -> jax.Array:
+    """Load a grid file directly into a mesh-sharded device array."""
+    size = os.path.getsize(path)
+    expected = height * row_stride(width)
+    if size != expected:
+        raise ValueError(
+            f"{path}: size {size} != {expected} for a {height}x{width} text grid "
+            f"(sharded I/O requires the exact height x (width+1) layout)"
+        )
+    mm = _file_view(path, width, height, "r")
+    cells = mm[:, :width]  # strided view that excludes the newline column
+    sharding = grid_sharding(mesh)
+
+    def load_window(index) -> np.ndarray:
+        # index slices may be slice(None) for unsplit dimensions.
+        return (np.asarray(cells[index]) == ONE).astype(np.uint8)
+
+    if parallel:
+        # The async variant: overlap the per-shard reads (the reference's
+        # iread is nonblocking in API only — it MPI_Waits immediately).
+        def key(index):  # slices are only hashable on 3.12+; normalize
+            return tuple((s.start, s.stop, s.step) for s in index)
+
+        index_map = sharding.addressable_devices_indices_map((height, width))
+        unique = {key(idx): idx for idx in index_map.values()}
+        with concurrent.futures.ThreadPoolExecutor() as pool:
+            blocks = dict(
+                zip(unique, pool.map(load_window, unique.values()))
+            )
+        return jax.make_array_from_callback(
+            (height, width), sharding, lambda idx: blocks[key(idx)]
+        )
+    return jax.make_array_from_callback((height, width), sharding, load_window)
+
+
+def write_sharded(path: str, grid: jax.Array, parallel: bool = False) -> None:
+    """Write a sharded device array straight to a grid file, no gather.
+
+    The reference opens MODE_EXCL and delete-retries if the file exists
+    (src/game_mpi_collective.c:429-436) — net effect is replacement, which is
+    what creating/truncating does.
+    """
+    height, width = grid.shape
+    with open(path, "wb") as f:
+        f.truncate(height * row_stride(width))
+    mm = _file_view(path, width, height, "r+")
+    cells = mm[:, :width]
+
+    def store_window(shard) -> None:
+        rows, cols = shard.index[0], shard.index[1]
+        cells[rows, cols] = np.asarray(shard.data, dtype=np.uint8) + ZERO
+        if cols.indices(width)[1] == width:
+            # East-edge shards own their rows' newline column
+            # (src/game_mpi_collective.c:382-393).
+            mm[rows, width] = NEWLINE
+
+    shards = list(grid.addressable_shards)
+    if parallel:
+        with concurrent.futures.ThreadPoolExecutor() as pool:
+            list(pool.map(store_window, shards))
+    else:
+        for shard in shards:
+            store_window(shard)
+    mm.flush()
+
+
+def read_gathered(path: str, width: int, height: int, mesh: Mesh) -> jax.Array:
+    """Master-scatter read: one host parses the file, blocks are scattered
+    (src/game_mpi.c:201-239)."""
+    from gol_tpu.io import text_grid
+
+    host_grid = text_grid.read_grid(path, width, height)
+    return jax.device_put(host_grid, grid_sharding(mesh))
+
+
+def write_gathered(path: str, grid: jax.Array) -> None:
+    """Gather-to-master write (src/game_mpi.c:429-467)."""
+    from gol_tpu.io import text_grid
+
+    text_grid.write_grid(path, np.asarray(jax.device_get(grid), dtype=np.uint8))

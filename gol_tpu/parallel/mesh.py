@@ -79,9 +79,15 @@ def make_mesh(
     if rows is None and cols is None:
         rows, cols = choose_mesh_shape(n)
     elif rows is None:
+        if cols <= 0 or n % cols:
+            raise ValueError(f"cannot infer mesh rows: {n} devices not divisible by cols={cols}")
         rows = n // cols
     elif cols is None:
+        if rows <= 0 or n % rows:
+            raise ValueError(f"cannot infer mesh cols: {n} devices not divisible by rows={rows}")
         cols = n // rows
+    if rows < 1 or cols < 1:
+        raise ValueError(f"mesh axes must be >= 1, got {rows}x{cols}")
     if rows * cols > n:
         raise ValueError(f"mesh {rows}x{cols} needs {rows * cols} devices, have {n}")
     return jax.make_mesh((rows, cols), MESH_TOPOLOGY_AXES, devices=devices[: rows * cols])

@@ -1,0 +1,160 @@
+"""CLI contract tests: argv semantics, printed lines, output files.
+
+Pins the behaviors catalogued in SURVEY.md §1 L6 and the per-variant print
+contracts (src/game.c:201-203,241; src/game_mpi_collective.c:203,370,450,485;
+src/game_openmp.c:501; src/game_cuda.cu:294-297).
+"""
+
+import numpy as np
+import pytest
+
+from gol_tpu import cli, oracle
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.io import text_grid
+
+
+@pytest.fixture
+def block_file(tmp_path):
+    g = np.zeros((8, 8), np.uint8)
+    g[3:5, 3:5] = 1
+    p = tmp_path / "block.txt"
+    text_grid.write_grid(str(p), g)
+    return str(p), g
+
+
+@pytest.fixture
+def random16(tmp_path):
+    g = text_grid.generate(16, 16, seed=13)
+    p = tmp_path / "rand.txt"
+    text_grid.write_grid(str(p), g)
+    return str(p), g
+
+
+def run_cli(args):
+    return cli.main(args)
+
+
+class TestArgContract:
+    def test_no_args_prints_finished(self, capsys):
+        assert run_cli([]) == 0
+        assert capsys.readouterr().out == "Finished\n"
+
+    def test_openmp_no_args_prints_nothing(self, capsys):
+        # game_openmp.c:501 — the final printf is commented out.
+        assert run_cli(["--variant", "openmp"]) == 0
+        assert capsys.readouterr().out == ""
+
+    def test_two_args_skips_simulation(self, capsys):
+        assert run_cli(["16", "16"]) == 0
+        assert capsys.readouterr().out == "Finished\n"
+
+    def test_atoi_garbage_defaults_to_30(self, capsys, tmp_path):
+        g = text_grid.generate(30, 30, seed=1)
+        p = tmp_path / "g.txt"
+        text_grid.write_grid(str(p), g)
+        assert run_cli(["abc", "xyz", str(p), "--variant", "game",
+                        "--gen-limit", "2", "--output", str(tmp_path / "o.out")]) == 0
+        out = capsys.readouterr().out
+        assert "Generations:\t2" in out
+
+    def test_unknown_variant_fails(self, capsys):
+        with pytest.raises(SystemExit):
+            run_cli(["--variant", "nope"])
+
+
+class TestSerialVariant:
+    def test_block_run_output_and_stdout(self, capsys, block_file, tmp_path, monkeypatch):
+        path, g = block_file
+        monkeypatch.chdir(tmp_path)
+        assert run_cli(["8", "8", path, "--variant", "game"]) == 0
+        out = capsys.readouterr().out
+        # Exact line sequence of src/game.c:201-203,241.
+        assert out.startswith("Finished.\n\nGenerations:\t2\nExecution time:\t")
+        assert out.endswith("msecs\nFinished\n")
+        assert (tmp_path / "game_output.out").read_bytes() == text_grid.encode(g)
+
+    def test_host_flag_matches_device(self, capsys, random16, tmp_path):
+        path, g = random16
+        dev_out = tmp_path / "dev.out"
+        host_out = tmp_path / "host.out"
+        run_cli(["16", "16", path, "--variant", "game", "--gen-limit", "10",
+                 "--output", str(dev_out)])
+        run_cli(["16", "16", path, "--variant", "game", "--gen-limit", "10",
+                 "--host", "--output", str(host_out)])
+        assert dev_out.read_bytes() == host_out.read_bytes()
+
+
+class TestDistributedVariants:
+    @pytest.mark.parametrize("variant", ["mpi", "collective", "async", "openmp"])
+    def test_output_matches_oracle(self, capsys, variant, random16, tmp_path):
+        path, g = random16
+        out_file = tmp_path / f"{variant}.out"
+        assert run_cli(["16", "16", path, "--variant", variant, "--mesh", "2x4",
+                        "--gen-limit", "15", "--output", str(out_file)]) == 0
+        stdout = capsys.readouterr().out
+        want = oracle.run(g, GameConfig(gen_limit=15))
+        assert out_file.read_bytes() == text_grid.encode(want.grid)
+        assert f"Generations:\t{want.generations}" in stdout
+        assert "Reading file:\t" in stdout
+        assert "Writing file:\t" in stdout
+        if variant == "openmp":
+            assert not stdout.rstrip().endswith("Finished")
+        else:
+            assert stdout.rstrip().endswith("Finished")
+
+    def test_force_square_uses_width(self, capsys, random16, tmp_path):
+        # `height = width` before defaulting (src/game_mpi.c:504): passing a
+        # wrong height must still read a 16x16 grid.
+        path, g = random16
+        out_file = tmp_path / "sq.out"
+        assert run_cli(["16", "999", path, "--variant", "collective",
+                        "--mesh", "2x2", "--gen-limit", "5",
+                        "--output", str(out_file)]) == 0
+        want = oracle.run(g, GameConfig(gen_limit=5))
+        assert out_file.read_bytes() == text_grid.encode(want.grid)
+
+    def test_indivisible_mesh_errors_cleanly(self, capsys, random16):
+        path, _ = random16
+        assert run_cli(["16", "16", path, "--variant", "collective",
+                        "--mesh", "3x1"]) == 1
+        assert "does not divide" in capsys.readouterr().err
+
+
+class TestCudaVariant:
+    def test_cuda_accounting_and_output(self, capsys, tmp_path, monkeypatch):
+        lone = np.zeros((8, 8), np.uint8)
+        lone[4, 4] = 1
+        p = tmp_path / "lone.txt"
+        text_grid.write_grid(str(p), lone)
+        monkeypatch.chdir(tmp_path)
+        assert run_cli(["8", "8", str(p), "--variant", "cuda"]) == 0
+        out = capsys.readouterr().out
+        # CUDA convention: empty-exit keeps the pre-evolve grid, reports 0
+        # (src/game_cuda.cu:259-268,294), and prints no I/O timing lines.
+        assert "Generations:\t0" in out
+        assert "Reading file" not in out
+        assert (tmp_path / "cuda_output.out").read_bytes() == text_grid.encode(lone)
+
+
+class TestGenerate:
+    def test_generate_to_file(self, tmp_path):
+        out = tmp_path / "gen.txt"
+        assert run_cli(["generate", "12", "7", "-o", str(out), "--seed", "3"]) == 0
+        g = text_grid.read_grid(str(out), 12, 7)
+        assert g.shape == (7, 12)
+
+    def test_generate_stdout(self, capsys):
+        assert run_cli(["generate", "4", "2", "--seed", "0"]) == 0
+        out = capsys.readouterr().out
+        lines = out.splitlines()
+        assert len(lines) == 2 and all(len(l) == 4 for l in lines)
+
+    def test_generate_then_run_roundtrip(self, tmp_path, capsys):
+        src = tmp_path / "in.txt"
+        dst = tmp_path / "out.txt"
+        run_cli(["generate", "16", "16", "-o", str(src), "--seed", "5"])
+        assert run_cli(["16", "16", str(src), "--variant", "tpu", "--mesh", "2x2",
+                        "--gen-limit", "10", "--output", str(dst)]) == 0
+        g = text_grid.read_grid(str(src), 16, 16)
+        want = oracle.run(g, GameConfig(gen_limit=10))
+        assert dst.read_bytes() == text_grid.encode(want.grid)
