@@ -113,6 +113,8 @@ def _run(args) -> int:
     output_path = args.output or f"./{variant.output_file}"
 
     if args.host:
+        if args.mesh or args.kernel != "lax":
+            raise ValueError("--mesh/--kernel do not apply with --host (oracle runs on the host CPU)")
         return _run_host(args, variant, config, width, height, output_path)
 
     mesh = _parse_mesh_arg(args.mesh, variant.distributed)
@@ -170,12 +172,10 @@ def _generate(args) -> int:
     grid = text_grid.generate(
         args.width, args.height, density=args.density, seed=args.seed
     )
-    data = text_grid.encode(grid)
     if args.output:
-        with open(args.output, "wb") as f:
-            f.write(data)
+        text_grid.write_grid(args.output, grid)
     else:
-        sys.stdout.write(data.decode("ascii"))
+        sys.stdout.write(text_grid.encode(grid).decode("ascii"))
     return 0
 
 

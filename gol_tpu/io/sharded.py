@@ -34,6 +34,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from gol_tpu.io import text_grid
 from gol_tpu.io.text_grid import NEWLINE, ONE, ZERO, row_stride
 from gol_tpu.parallel.mesh import grid_sharding
 
@@ -117,14 +118,10 @@ def write_sharded(path: str, grid: jax.Array, parallel: bool = False) -> None:
 def read_gathered(path: str, width: int, height: int, mesh: Mesh) -> jax.Array:
     """Master-scatter read: one host parses the file, blocks are scattered
     (src/game_mpi.c:201-239)."""
-    from gol_tpu.io import text_grid
-
     host_grid = text_grid.read_grid(path, width, height)
     return jax.device_put(host_grid, grid_sharding(mesh))
 
 
 def write_gathered(path: str, grid: jax.Array) -> None:
     """Gather-to-master write (src/game_mpi.c:429-467)."""
-    from gol_tpu.io import text_grid
-
     text_grid.write_grid(path, np.asarray(jax.device_get(grid), dtype=np.uint8))
