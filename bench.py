@@ -23,16 +23,15 @@ import numpy as np
 TARGET_CELL_UPDATES_PER_SEC_PER_CHIP = 1e11  # BASELINE.md north star
 
 
-def pick_kernel(requested: str | None) -> str:
+def resolve_kernel_name(requested: str | None, size: int, mesh) -> str:
     if requested:
         return requested
-    from gol_tpu.ops import get_kernel
+    from gol_tpu.ops import resolve_kernel
+    from gol_tpu.parallel.mesh import topology_for
 
-    try:
-        get_kernel("pallas")
-        return "pallas"
-    except ValueError:
-        return "lax"
+    topo = topology_for(mesh)
+    local_h, local_w = size // topo.shape[0], size // topo.shape[1]
+    return resolve_kernel("auto", local_h, local_w, topo).name
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -57,7 +56,7 @@ def main(argv: list[str] | None = None) -> int:
         mesh = make_mesh(r, c)
         n_chips = r * c
 
-    kernel = pick_kernel(args.kernel)
+    kernel = resolve_kernel_name(args.kernel, args.size, mesh)
     platform = jax.devices()[0].platform
     print(
         f"bench: {args.size}x{args.size}, gen_limit={args.gen_limit}, "

@@ -113,7 +113,7 @@ def _run(args) -> int:
     output_path = args.output or f"./{variant.output_file}"
 
     if args.host:
-        if args.mesh or args.kernel != "lax":
+        if args.mesh or args.kernel != "auto":
             raise ValueError("--mesh/--kernel do not apply with --host (oracle runs on the host CPU)")
         return _run_host(args, variant, config, width, height, output_path)
 
@@ -197,7 +197,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="which reference program to reproduce (default: the TPU-native flagship)",
     )
     run.add_argument("--mesh", default=None, help="device mesh RxC (default: all devices)")
-    run.add_argument("--kernel", default="lax", help="stencil kernel: lax or pallas")
+    run.add_argument(
+        "--kernel",
+        default="auto",
+        help="stencil kernel: auto (best for the shape/backend), lax, or pallas",
+    )
     run.add_argument("--gen-limit", type=int, default=GameConfig().gen_limit)
     run.add_argument(
         "--similarity-frequency", type=int, default=GameConfig().similarity_frequency

@@ -30,7 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.config import Convention, DEFAULT_CONFIG, GameConfig
-from gol_tpu.ops import get_kernel
+from gol_tpu.ops import Kernel, resolve_kernel
 from gol_tpu.parallel import collectives
 from gol_tpu.parallel.mesh import (
     Topology,
@@ -48,22 +48,35 @@ class EngineResult:
     generations: int  # the count the matching reference variant would print
 
 
-def _evolve(cur: jnp.ndarray, kernel_fn, topology: Topology) -> jnp.ndarray:
-    return kernel_fn(cur, topology)
+def _generation(cur, kernel: Kernel, topology: Topology):
+    """One generation plus its local termination flags.
+
+    With a fused kernel the flags come out of the same memory pass as the
+    stencil; otherwise they are separate (XLA-fused where possible) scans —
+    the similarity compare stays lazy behind the engine's lax.cond.
+    """
+    if kernel.fused is not None:
+        return kernel.fused(cur, topology)
+    new = kernel.step(cur, topology)
+    return new, jnp.any(new), None
 
 
-def _similarity_vote(fire, cur, new, topology: Topology):
+def _similarity_vote(fire, cur, new, similar_local, topology: Topology):
     """Every-Kth-generation consensus that the generations are identical
     (similarity_all, src/game_mpi_collective.c:98-109). Guarded by lax.cond so
     the compare/reduce pass is only paid on firing generations."""
+    if similar_local is None:
+        local = lambda: jnp.all(cur == new)
+    else:
+        local = lambda: similar_local
     return jax.lax.cond(
         fire,
-        lambda: collectives.all_agree(jnp.all(cur == new), topology),
+        lambda: collectives.all_agree(local(), topology),
         lambda: jnp.asarray(False),
     )
 
 
-def _simulate_c(grid, config: GameConfig, topology: Topology, kernel_fn):
+def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel):
     """C-variant loop (src/game.c:177-196, src/game_mpi_collective.c:331-365).
 
     Emptiness is checked at the top of every generation on the current grid;
@@ -79,14 +92,14 @@ def _simulate_c(grid, config: GameConfig, topology: Topology, kernel_fn):
 
     def body(state):
         cur, gen, counter, _, _ = state
-        new = _evolve(cur, kernel_fn, topology)
+        new, alive_local, similar_local = _generation(cur, kernel, topology)
         if config.check_similarity:
             fire = (counter + 1) == freq
-            similar = _similarity_vote(fire, cur, new, topology)
+            similar = _similarity_vote(fire, cur, new, similar_local, topology)
             counter = jnp.where(fire, 0, counter + 1)
         else:
             similar = jnp.asarray(False)
-        alive = collectives.any_flag(jnp.any(new), topology)
+        alive = collectives.any_flag(alive_local, topology)
         gen = jnp.where(similar, gen, gen + 1)
         return (new, gen, counter, alive, similar)
 
@@ -96,7 +109,7 @@ def _simulate_c(grid, config: GameConfig, topology: Topology, kernel_fn):
     return final, gen - 1
 
 
-def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel_fn):
+def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel):
     """CUDA-variant loop (src/game_cuda.cu:222-276).
 
     0-based exclusive bound; no emptiness test before the first evolve; the
@@ -114,14 +127,14 @@ def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel_fn):
 
     def body(state):
         cur, gen, counter, _ = state
-        new = _evolve(cur, kernel_fn, topology)
+        new, alive_local, similar_local = _generation(cur, kernel, topology)
         if config.check_similarity:
             fire = (counter + 1) == freq
-            similar = _similarity_vote(fire, cur, new, topology)
+            similar = _similarity_vote(fire, cur, new, similar_local, topology)
             counter = jnp.where(fire, 0, counter + 1)
         else:
             similar = jnp.asarray(False)
-        empty = jnp.logical_not(collectives.any_flag(jnp.any(new), topology))
+        empty = jnp.logical_not(collectives.any_flag(alive_local, topology))
         stop = similar | empty
         cur = jnp.where(stop, cur, new)  # break precedes the swap (:250,:266)
         gen = jnp.where(stop, gen, gen + 1)
@@ -140,7 +153,7 @@ def make_runner(
     shape: tuple[int, int],
     config: GameConfig = DEFAULT_CONFIG,
     mesh: Mesh | None = None,
-    kernel: str = "lax",
+    kernel: str = "auto",
 ):
     """Compile a ``global_grid -> (global_grid, generations)`` runner.
 
@@ -148,13 +161,13 @@ def make_runner(
     topology/bootstrap step the reference does with MPI_Init + MPI_Cart_create
     (src/game_mpi_collective.c:116-133) happens here, at trace time.
     """
-    kernel_fn = get_kernel(kernel)
     topology = topology_for(mesh)
+    local_h, local_w = validate_grid(shape[0], shape[1], topology)
+    kernel_obj = resolve_kernel(kernel, local_h, local_w, topology)
     simulate = _SIMULATORS[config.convention]
-    validate_grid(shape[0], shape[1], topology)
 
     def local_fn(g):
-        return simulate(g, config, topology, kernel_fn)
+        return simulate(g, config, topology, kernel_obj)
 
     if topology.distributed:
         fn = jax.shard_map(
@@ -180,7 +193,7 @@ def simulate(
     grid,
     config: GameConfig = DEFAULT_CONFIG,
     mesh: Mesh | None = None,
-    kernel: str = "lax",
+    kernel: str = "auto",
 ) -> EngineResult:
     """Run a full simulation and fetch the result to the host."""
     shape = tuple(np.shape(grid))

@@ -1,16 +1,37 @@
 """Compute kernels: the 3x3 Moore stencil in lax and Pallas flavors.
 
-A kernel is a callable ``evolve(cur, topology) -> new`` mapping a shard's
-(h, w) uint8 block to the next generation, owning its own halo strategy:
-the lax kernel wraps locally via rolls or exchanges ghosts via ppermute;
-the Pallas kernel fuses halo handling into its VMEM tiling.
+A kernel owns one generation of compute for a shard's (h, w) uint8 block,
+including its halo strategy (local wrap, ppermute ghosts, or fused DMA).
+
+Two call forms:
+
+- ``step(cur, topology) -> new`` — just the next generation.
+- ``fused(cur, topology) -> (new, any_alive, similar)`` — optionally, the next
+  generation plus the termination flags computed in the same memory pass (the
+  Pallas path; fusing the reference's separate empty/compare kernels,
+  src/game_cuda.cu:76-126, into the evolve pass).
 """
 
 from __future__ import annotations
 
+import dataclasses
+from typing import Callable
+
+import jax
+
 from gol_tpu.ops import stencil_lax
 from gol_tpu.parallel import halo
 from gol_tpu.parallel.mesh import Topology
+
+
+@dataclasses.dataclass(frozen=True)
+class Kernel:
+    """A named evolve implementation with optional fused termination flags."""
+
+    name: str
+    step: Callable  # (cur, Topology) -> new
+    fused: Callable | None = None  # (cur, Topology) -> (new, alive, similar)
+    supports: Callable = lambda height, width, topology: True
 
 
 def lax_evolve(cur, topology: Topology):
@@ -19,15 +40,45 @@ def lax_evolve(cur, topology: Topology):
     return stencil_lax.evolve_torus(cur)
 
 
-def get_kernel(name: str):
-    """Resolve a kernel name to an ``(cur, topology) -> new`` evolve function."""
-    kernels = {"lax": lax_evolve}
+def _registry() -> dict[str, Kernel]:
+    kernels = {"lax": Kernel(name="lax", step=lax_evolve)}
     try:
-        from gol_tpu.ops.stencil_pallas import pallas_evolve
+        from gol_tpu.ops import stencil_pallas
 
-        kernels["pallas"] = pallas_evolve
+        kernels["pallas"] = Kernel(
+            name="pallas",
+            step=lambda cur, topo: stencil_pallas.pallas_step(cur, topo)[0],
+            fused=stencil_pallas.pallas_step,
+            supports=stencil_pallas.supports,
+        )
     except ImportError:  # pragma: no cover - pallas unavailable on some backends
         pass
+    return kernels
+
+
+def get_kernel(name: str) -> Kernel:
+    """Resolve an explicit kernel name (``auto`` is only accepted by
+    ``resolve_kernel``, which needs the shape/topology to choose)."""
+    kernels = _registry()
     if name not in kernels:
         raise ValueError(f"unknown kernel {name!r}; available: {sorted(kernels)}")
     return kernels[name]
+
+
+def resolve_kernel(name: str, height: int, width: int, topology: Topology) -> Kernel:
+    """Pick the best kernel for a concrete shape/topology.
+
+    ``auto`` prefers the Pallas fast path when the compiled kernel supports the
+    shape on this backend, falling back to the always-correct lax path.
+    """
+    if name != "auto":
+        return get_kernel(name)
+    kernels = _registry()
+    pallas = kernels.get("pallas")
+    if (
+        pallas is not None
+        and jax.default_backend() == "tpu"
+        and pallas.supports(height, width, topology)
+    ):
+        return pallas
+    return kernels["lax"]

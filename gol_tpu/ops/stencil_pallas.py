@@ -1,0 +1,186 @@
+"""Pallas VMEM-tiled stencil — the fast path (the CUDA kernel equivalent).
+
+Plays the role of the reference's five device kernels (src/game_cuda.cu:52-148)
+but restructured for TPU rather than translated:
+
+- The CUDA program materializes the toroidal wrap into a ghost border with two
+  halo kernels each generation (src/game_cuda.cu:52-74) and then runs a
+  one-thread-per-cell evolve. Here the grid is processed in row bands: the
+  band plus the two aligned 8-row blocks holding its wrap rows stream into
+  VMEM through Pallas's pipelined BlockSpecs (the same array passed three
+  times with row-shifted index maps — the torus wrap is modular block-index
+  arithmetic), and the column wrap is two lane-rolls of the VMEM-resident
+  band. No ghost cells ever exist in memory.
+- The CUDA program's compare/empty reduction kernels (src/game_cuda.cu:76-126)
+  plus the per-generation 4-byte device->host flag copy (src/game_cuda.cu:
+  259-268) become two scalar flags accumulated in SMEM across the band grid
+  and consumed on-device by the engine's while_loop cond — the host never sees
+  them.
+
+Traffic per generation is ~2 bytes/cell (one read + one write) plus two 8-row
+blocks per band, all double-buffered by the Pallas pipeline so DMA overlaps
+compute. The sequential band grid makes the SMEM flag accumulation race-free.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gol_tpu.parallel.mesh import Topology
+
+# Lane width of the VPU; widths must align for the lane-roll column wrap.
+_LANES = 128
+# Sublane granule for uint8 tiles: every row offset/extent a BlockSpec or DMA
+# touches must be a multiple of this.
+_SUBLANES = 8
+# Target VMEM bytes for one band of uint8 cells — small enough that the int32
+# compute copies and the double-buffered in/out blocks fit beside it, large
+# enough to amortize per-band pipeline overhead.
+_BAND_BYTES = 512 << 10
+
+
+def supports(height: int, width: int, topology: Topology) -> bool:
+    """Shapes the compiled kernel handles; anything else falls back to lax."""
+    return (
+        not topology.distributed
+        and width % _LANES == 0
+        and height % _SUBLANES == 0
+        and height >= _SUBLANES
+    )
+
+
+def _pick_band(height: int, width: int) -> int:
+    """Largest divisor of ``height`` that fits the VMEM window and the uint8
+    sublane granule."""
+    target = max(_SUBLANES, min(height, _BAND_BYTES // max(width, 1)))
+    for band in range(target, _SUBLANES - 1, -1):
+        if height % band == 0 and band % _SUBLANES == 0:
+            return band
+    raise ValueError(f"no {_SUBLANES}-aligned band divides height {height}")
+
+
+def _roll(x: jnp.ndarray, shift: int) -> jnp.ndarray:
+    """Lane-roll along the width axis: the toroidal column wrap.
+
+    ``pltpu.roll`` only takes non-negative shifts; a roll of -1 is width-1.
+    """
+    return pltpu.roll(x, shift % x.shape[1], 1)
+
+
+def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *, band: int):
+    i = pl.program_id(0)
+
+    # Mosaic vectorizes, rotates, and reduces i32 (not i8/i16): cells stay
+    # uint8 in HBM/VMEM storage and widen to int32 only as compute values.
+    mid = main_ref[:].astype(jnp.int32)
+    # The wrap rows ride in as aligned 8-row blocks (sublane slices of size 1
+    # would be misaligned): the row above the band is the LAST row of the
+    # block 8 rows up, the row below is the FIRST row of the next block.
+    # Extract by masked max-reduce over the block.
+    r8 = jax.lax.broadcasted_iota(jnp.int32, (8, mid.shape[1]), 0)
+    top_row = jnp.max(
+        jnp.where(r8 == 7, top_ref[:].astype(jnp.int32), 0), axis=0, keepdims=True
+    )
+    bot_row = jnp.max(
+        jnp.where(r8 == 0, bot_ref[:].astype(jnp.int32), 0), axis=0, keepdims=True
+    )
+    topg = jnp.broadcast_to(top_row, mid.shape)
+    botg = jnp.broadcast_to(bot_row, mid.shape)
+    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
+    # Row shift via sublane rotate, ghost rows patched in at the band edges:
+    # up[r] = mid[r-1] (ghost at r=0), down[r] = mid[r+1] (ghost at r=band-1).
+    up = jnp.where(rows == 0, topg, pltpu.roll(mid, 1, 0))
+    down = jnp.where(rows == band - 1, botg, pltpu.roll(mid, band - 1, 0))
+    counts = (
+        up
+        + _roll(up, 1)
+        + _roll(up, -1)
+        + _roll(mid, 1)
+        + _roll(mid, -1)
+        + down
+        + _roll(down, 1)
+        + _roll(down, -1)
+    )
+    # B3/S23, branchless (src/game_cuda.cu:146).
+    new = jnp.where((counts == 3) | ((counts == 2) & (mid == 1)), 1, 0)
+    out_ref[:] = new.astype(jnp.uint8)
+
+    # max-based reductions sidestep any sum-overflow concern.
+    alive = (jnp.max(new) > 0).astype(jnp.int32)
+    similar = (jnp.max(jnp.abs(new - mid)) == 0).astype(jnp.int32)
+
+    @pl.when(i == 0)
+    def _init():
+        alive_ref[0, 0] = alive
+        similar_ref[0, 0] = similar
+
+    @pl.when(i > 0)
+    def _accumulate():
+        alive_ref[0, 0] = alive_ref[0, 0] | alive
+        similar_ref[0, 0] = similar_ref[0, 0] & similar
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _step(grid: jnp.ndarray, interpret: bool = False):
+    height, width = grid.shape
+    band = _pick_band(height, width)
+    bb = band // _SUBLANES  # band size in 8-row block units
+    nb = height // _SUBLANES  # grid height in 8-row block units
+    new, alive, similar = pl.pallas_call(
+        functools.partial(_band_kernel, band=band),
+        grid=(height // band,),
+        in_specs=[
+            # The band itself...
+            pl.BlockSpec((band, width), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            # ...the 8-row block whose last row wraps in above it...
+            pl.BlockSpec(
+                (_SUBLANES, width),
+                lambda i: ((i * bb - 1) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            # ...and the 8-row block whose first row wraps in below it.
+            pl.BlockSpec(
+                (_SUBLANES, width),
+                lambda i: ((i * bb + bb) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, width), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((height, width), jnp.uint8),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),  # flags accumulate sequentially
+        ),
+        interpret=interpret,
+    )(grid, grid, grid)
+    return new, alive[0, 0] > 0, similar[0, 0] > 0
+
+
+def pallas_step(cur: jnp.ndarray, topology: Topology):
+    """Fused generation step: ``cur -> (new, any_alive, similar)``.
+
+    The flags are this kernel's fusion of the reference's evolve + empty +
+    compare kernels (src/game_cuda.cu:76-148) into a single memory pass.
+    """
+    height, width = cur.shape
+    if not supports(height, width, topology):
+        raise ValueError(
+            f"the pallas kernel requires a single-device grid with height a "
+            f"multiple of {_SUBLANES} and width a multiple of {_LANES}; got "
+            f"{height}x{width} on {topology.shape[0]}x{topology.shape[1]} "
+            f"devices — use kernel='lax' (or 'auto') instead"
+        )
+    interpret = jax.default_backend() != "tpu"
+    return _step(cur, interpret=interpret)

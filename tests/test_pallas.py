@@ -1,0 +1,76 @@
+"""Pallas kernel tests (interpret mode on CPU; the compiled path runs on TPU).
+
+Mirrors the differential-oracle strategy of SURVEY.md §4: the Pallas stencil
+must agree with the NumPy oracle cell-for-cell, and its fused flags must agree
+with the flags the engine would compute separately.
+"""
+
+import numpy as np
+import pytest
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import GameConfig
+from gol_tpu.ops import get_kernel, resolve_kernel
+from gol_tpu.ops.stencil_pallas import _pick_band, _step, supports
+from gol_tpu.parallel.mesh import SINGLE_DEVICE, Topology
+
+import jax.numpy as jnp
+
+
+@pytest.mark.parametrize(
+    "shape", [(8, 128), (16, 128), (128, 128), (64, 256), (24, 384)]
+)
+def test_step_matches_oracle(shape):
+    rng = np.random.default_rng(7)
+    g = rng.integers(0, 2, size=shape, dtype=np.uint8)
+    new, alive, similar = _step(jnp.asarray(g), interpret=True)
+    expect = oracle.evolve(g)
+    np.testing.assert_array_equal(np.asarray(new), expect)
+    assert bool(alive) == bool(expect.any())
+    assert bool(similar) == bool(np.array_equal(expect, g))
+
+
+def test_flags_on_still_life_and_empty():
+    g = np.zeros((16, 128), np.uint8)
+    g[4:6, 4:6] = 1  # block still life
+    _, alive, similar = _step(jnp.asarray(g), interpret=True)
+    assert bool(alive) and bool(similar)
+
+    _, alive, similar = _step(jnp.asarray(np.zeros((16, 128), np.uint8)), interpret=True)
+    assert not bool(alive)
+    assert bool(similar)  # empty -> empty is a fixed point
+
+
+def test_multi_generation_engine_run():
+    """Full while_loop engine with the pallas kernel vs the oracle."""
+    rng = np.random.default_rng(11)
+    g = rng.integers(0, 2, size=(32, 128), dtype=np.uint8)
+    config = GameConfig(gen_limit=50)
+    expect = oracle.run(g, config)
+    got = engine.simulate(g, config, kernel="pallas")
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
+def test_band_picker_divides():
+    for h in (8, 16, 120, 4096, 8192):
+        band = _pick_band(h, 4096)
+        assert h % band == 0 and band % 8 == 0
+
+
+def test_supports_gating():
+    assert supports(4096, 4096, SINGLE_DEVICE)
+    assert not supports(30, 30, SINGLE_DEVICE)  # default grid: lane-misaligned
+    assert not supports(4096, 4096, Topology(shape=(2, 2), axes=("row", "col")))
+
+
+def test_auto_resolution_on_cpu_prefers_lax():
+    # Tests run on CPU, where auto must not pick the (interpret-only) pallas.
+    assert resolve_kernel("auto", 4096, 4096, SINGLE_DEVICE).name == "lax"
+    assert get_kernel("pallas").name == "pallas"
+
+
+def test_distributed_pallas_rejected():
+    topo = Topology(shape=(2, 2), axes=("row", "col"))
+    with pytest.raises(ValueError, match="single-device"):
+        get_kernel("pallas").fused(jnp.zeros((8, 128), jnp.uint8), topo)
