@@ -113,7 +113,9 @@ def _run(args) -> int:
     output_path = args.output or f"./{variant.output_file}"
 
     if args.host:
-        if args.mesh or args.kernel != "auto":
+        # lax is what the host oracle effectively is, so it stays accepted;
+        # forcing an accelerator kernel alongside --host is a contradiction.
+        if args.mesh or args.kernel not in ("auto", "lax"):
             raise ValueError("--mesh/--kernel do not apply with --host (oracle runs on the host CPU)")
         return _run_host(args, variant, config, width, height, output_path)
 
@@ -200,7 +202,8 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument(
         "--kernel",
         default="auto",
-        help="stencil kernel: auto (best for the shape/backend), lax, or pallas",
+        help="stencil kernel: auto (best for the shape/backend), lax, pallas, "
+        "or packed (bitpacked fast path)",
     )
     run.add_argument("--gen-limit", type=int, default=GameConfig().gen_limit)
     run.add_argument(

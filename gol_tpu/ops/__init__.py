@@ -26,12 +26,20 @@ from gol_tpu.parallel.mesh import Topology
 
 @dataclasses.dataclass(frozen=True)
 class Kernel:
-    """A named evolve implementation with optional fused termination flags."""
+    """A named evolve implementation with optional fused termination flags.
+
+    ``encode``/``decode`` let a kernel carry the grid through the generation
+    loop in its own representation (the bitpacked kernel: uint32 words); the
+    engine applies them once at the loop boundary. Both operate on/return the
+    canonical uint8 (H, W) grid.
+    """
 
     name: str
     step: Callable  # (cur, Topology) -> new
     fused: Callable | None = None  # (cur, Topology) -> (new, alive, similar)
     supports: Callable = lambda height, width, topology: True
+    encode: Callable | None = None  # uint8 grid -> carried state
+    decode: Callable | None = None  # carried state -> uint8 grid
 
 
 def lax_evolve(cur, topology: Topology):
@@ -43,13 +51,23 @@ def lax_evolve(cur, topology: Topology):
 def _registry() -> dict[str, Kernel]:
     kernels = {"lax": Kernel(name="lax", step=lax_evolve)}
     try:
-        from gol_tpu.ops import stencil_pallas
+        from gol_tpu.ops import stencil_packed, stencil_pallas
 
         kernels["pallas"] = Kernel(
             name="pallas",
             step=lambda cur, topo: stencil_pallas.pallas_step(cur, topo)[0],
             fused=stencil_pallas.pallas_step,
             supports=stencil_pallas.supports,
+        )
+        kernels["packed"] = Kernel(
+            name="packed",
+            step=lambda cur, topo: stencil_packed.decode(
+                stencil_packed.packed_step(stencil_packed.encode(cur), topo)[0]
+            ),
+            fused=stencil_packed.packed_step,
+            supports=stencil_packed.supports,
+            encode=stencil_packed.encode,
+            decode=stencil_packed.decode,
         )
     except ImportError:  # pragma: no cover - pallas unavailable on some backends
         pass
@@ -74,11 +92,9 @@ def resolve_kernel(name: str, height: int, width: int, topology: Topology) -> Ke
     if name != "auto":
         return get_kernel(name)
     kernels = _registry()
-    pallas = kernels.get("pallas")
-    if (
-        pallas is not None
-        and jax.default_backend() == "tpu"
-        and pallas.supports(height, width, topology)
-    ):
-        return pallas
+    if jax.default_backend() == "tpu":
+        for candidate in ("packed", "pallas"):
+            kernel = kernels.get(candidate)
+            if kernel is not None and kernel.supports(height, width, topology):
+                return kernel
     return kernels["lax"]

@@ -1,0 +1,211 @@
+"""Bitpacked Pallas stencil — 32 cells per word, bit-sliced adder network.
+
+The fastest path, and the one that earns the TPU its keep. Where the
+reference's CUDA kernel spends one thread per cell (src/game_cuda.cu:128-148),
+this kernel packs 32 cells into each uint32 lane element and evolves all of
+them with ~60 bitwise VPU ops per word — a carry-save adder network computing
+all eight neighbor counts bit-parallel:
+
+- Cells live packed as uint32 words along the width axis: bit j of word w is
+  the cell at column ``w*32 + j``. HBM traffic per generation drops to ~2
+  *bits* per cell.
+- West/east neighbors are one-bit shifts within words, with the cross-word
+  (and toroidal cross-row) carry bit delivered by a lane-roll of the word
+  array.
+- Neighbor counts come from a boolean adder tree: per-row 3:2 compressors,
+  then a 4-bit carry-save sum. With count bits N = s0 + 2*b1 + 4*u0 + 8*u1,
+  rule B3/S23 (src/game.c:91-98) collapses to
+  ``new = b1 & ~(u0|u1) & (s0|mid)``.
+- The alive/similar termination flags accumulate in SMEM exactly as in the
+  unpacked Pallas kernel, so the engine's while_loop stays host-free.
+
+Packing/unpacking happens once per run at the engine boundary (the grid state
+carried through the generation loop stays packed); the text-I/O contract is
+untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gol_tpu.parallel.mesh import Topology
+
+_BITS = 32
+_SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
+# Target VMEM bytes for one band of packed words; the ~10 live temporaries of
+# the adder network and the double-buffered in/out blocks sit beside it.
+_BAND_BYTES = 256 << 10
+
+
+def supports(height: int, width: int, topology: Topology) -> bool:
+    # Narrow word arrays (nwords < 128 lanes) are fine: Mosaic's dynamic
+    # rotate operates on the logical shape, verified compiled on v5e down to
+    # a single-word row (64x32 and 512x1152 grids match the oracle).
+    return (
+        not topology.distributed
+        and width % _BITS == 0
+        and height % _SUBLANES == 0
+        and height >= _SUBLANES
+    )
+
+
+def _pick_band(height: int, words: int) -> int:
+    row_bytes = max(words * 4, 1)
+    target = max(_SUBLANES, min(height, _BAND_BYTES // row_bytes))
+    for band in range(target, _SUBLANES - 1, -1):
+        if height % band == 0 and band % _SUBLANES == 0:
+            return band
+    raise ValueError(f"no {_SUBLANES}-aligned band divides height {height}")
+
+
+def encode(grid: jnp.ndarray) -> jnp.ndarray:
+    """uint8 (H, W) cells -> uint32 (H, W/32) words (bit j = column w*32+j)."""
+    height, width = grid.shape
+    bits = grid.reshape(height, width // _BITS, _BITS).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(_BITS, dtype=jnp.uint32))[None, None, :]
+    return jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def decode(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32 (H, W/32) words -> uint8 (H, W) cells."""
+    height, nwords = words.shape
+    shifts = jnp.arange(_BITS, dtype=jnp.uint32)[None, None, :]
+    bits = (words[:, :, None] >> shifts) & jnp.uint32(1)
+    return bits.astype(jnp.uint8).reshape(height, nwords * _BITS)
+
+
+def _west(x: jnp.ndarray) -> jnp.ndarray:
+    """Packed array of each cell's west (column-1) neighbor."""
+    carry = jax.lax.shift_right_logical(
+        pltpu.roll(x, 1, 1), jnp.uint32(_BITS - 1)
+    )
+    return jax.lax.shift_left(x, jnp.uint32(1)) | carry
+
+
+def _east(x: jnp.ndarray) -> jnp.ndarray:
+    """Packed array of each cell's east (column+1) neighbor."""
+    carry = jax.lax.shift_left(
+        pltpu.roll(x, x.shape[1] - 1, 1), jnp.uint32(_BITS - 1)
+    )
+    return jax.lax.shift_right_logical(x, jnp.uint32(1)) | carry
+
+
+def _csa3(a, b, c):
+    """3:2 compressor: sum and carry bitplanes of a+b+c."""
+    axb = a ^ b
+    return axb ^ c, (a & b) | (c & axb)
+
+
+def _evolve_words(up, mid, down):
+    """One generation for packed rows (up/mid/down already row-shifted)."""
+    a0, a1 = _csa3(_west(up), up, _east(up))
+    c0, c1 = _csa3(_west(down), down, _east(down))
+    mw, me = _west(mid), _east(mid)
+    m0, m1 = mw ^ me, mw & me
+    s0, k0 = _csa3(a0, m0, c0)
+    # count4 = a1 + m1 + c1 + k0 = 4*u1 + 2*u0 + b1
+    p, q = a1 ^ m1, a1 & m1
+    r, s = c1 ^ k0, c1 & k0
+    b1, t = p ^ r, p & r
+    u0, u1 = _csa3(q, s, t)[0], (q & s) | (t & (q ^ s))
+    # N = s0 + 2*b1 + 4*u0 + 8*u1; B3/S23: alive iff N==3 or (N==2 and alive).
+    return b1 & ~(u0 | u1) & (s0 | mid)
+
+
+def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *, band: int):
+    i = pl.program_id(0)
+
+    mid = main_ref[:]
+    # Wrap rows arrive as aligned 8-row blocks; extract last/first row by a
+    # masked sum-reduce (single-row sublane slices would be misaligned, and
+    # Mosaic doesn't reduce unsigned vectors — bitcast to i32; the sum is
+    # exact because exactly one row survives the mask).
+    r8 = jax.lax.broadcasted_iota(jnp.int32, (8, mid.shape[1]), 0)
+
+    def _extract(block_ref, row_index):
+        block = jax.lax.bitcast_convert_type(block_ref[:], jnp.int32)
+        row = jnp.sum(jnp.where(r8 == row_index, block, 0), axis=0, keepdims=True)
+        return jax.lax.bitcast_convert_type(row, jnp.uint32)
+
+    top_row = _extract(top_ref, 7)
+    bot_row = _extract(bot_ref, 0)
+    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
+    up = jnp.where(rows == 0, jnp.broadcast_to(top_row, mid.shape), pltpu.roll(mid, 1, 0))
+    down = jnp.where(
+        rows == band - 1, jnp.broadcast_to(bot_row, mid.shape), pltpu.roll(mid, band - 1, 0)
+    )
+
+    new = _evolve_words(up, mid, down)
+    out_ref[:] = new
+
+    alive = jnp.max(jnp.where(new != 0, 1, 0))
+    similar = 1 - jnp.max(jnp.where((new ^ mid) != 0, 1, 0))
+
+    @pl.when(i == 0)
+    def _init():
+        alive_ref[0, 0] = alive
+        similar_ref[0, 0] = similar
+
+    @pl.when(i > 0)
+    def _accumulate():
+        alive_ref[0, 0] = alive_ref[0, 0] | alive
+        similar_ref[0, 0] = similar_ref[0, 0] & similar
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _step(words: jnp.ndarray, interpret: bool = False):
+    height, nwords = words.shape
+    band = _pick_band(height, nwords)
+    bb = band // _SUBLANES
+    nb = height // _SUBLANES
+    new, alive, similar = pl.pallas_call(
+        functools.partial(_band_kernel, band=band),
+        grid=(height // band,),
+        in_specs=[
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (_SUBLANES, nwords),
+                lambda i: ((i * bb - 1) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_SUBLANES, nwords),
+                lambda i: ((i * bb + bb) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((height, nwords), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(words, words, words)
+    return new, alive[0, 0] > 0, similar[0, 0] > 0
+
+
+def packed_step(cur: jnp.ndarray, topology: Topology):
+    """Fused generation step on packed state: ``words -> (words, alive, similar)``."""
+    height, nwords = cur.shape
+    if not supports(height, nwords * _BITS, topology):
+        raise ValueError(
+            f"the packed kernel requires a single-device grid with height a "
+            f"multiple of {_SUBLANES} and width a multiple of {_BITS}; got "
+            f"{height}x{nwords * _BITS} on {topology.shape[0]}x"
+            f"{topology.shape[1]} devices — use kernel='lax' (or 'auto')"
+        )
+    interpret = jax.default_backend() != "tpu"
+    return _step(cur, interpret=interpret)
