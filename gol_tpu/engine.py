@@ -167,6 +167,12 @@ def make_runner(
     topology = topology_for(mesh)
     local_h, local_w = validate_grid(shape[0], shape[1], topology)
     kernel_obj = resolve_kernel(kernel, local_h, local_w, topology)
+    if not kernel_obj.supports(local_h, local_w, topology):
+        raise ValueError(
+            f"kernel {kernel_obj.name!r} does not support a {local_h}x{local_w} "
+            f"local shard on a {topology.shape[0]}x{topology.shape[1]} topology; "
+            f"use kernel='auto' to fall back automatically"
+        )
     simulate = _SIMULATORS[config.convention]
 
     def local_fn(g):

@@ -1,0 +1,118 @@
+"""Bit-sliced Game-of-Life arithmetic on packed uint32 words.
+
+The shared core of every packed path: the Pallas kernel (stencil_pallas's
+sibling stencil_packed), the jnp torus evolve, and the distributed shard step
+all feed the same carry-save adder network. Bit j of word w is the cell at
+column ``w*32 + j``.
+
+The network computes all eight Moore neighbor counts bit-parallel: per-row 3:2
+compressors, then a 4-bit carry-save sum N = s0 + 2*b1 + 4*u0 + 8*u1, under
+which rule B3/S23 (src/game.c:91-98) collapses to
+``new = b1 & ~(u0|u1) & (s0|mid)`` — ~30 bitwise ops for 32 cells.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+BITS = 32
+
+
+def west(x: jnp.ndarray, left_words: jnp.ndarray) -> jnp.ndarray:
+    """Packed array of west (column-1) neighbors.
+
+    ``left_words[w]`` must be word ``w-1`` of the same row — however the
+    caller realizes that (lane roll for a torus, ghost word column for a
+    shard boundary). Shift constants are built at trace time — module-level
+    jnp scalars would be captured constants, which Pallas kernels reject."""
+    return jax.lax.shift_left(x, jnp.uint32(1)) | jax.lax.shift_right_logical(
+        left_words, jnp.uint32(BITS - 1)
+    )
+
+
+def east(x: jnp.ndarray, right_words: jnp.ndarray) -> jnp.ndarray:
+    """Packed array of east (column+1) neighbors (``right_words[w]`` = word w+1)."""
+    return jax.lax.shift_right_logical(x, jnp.uint32(1)) | jax.lax.shift_left(
+        right_words, jnp.uint32(BITS - 1)
+    )
+
+
+def csa3(a, b, c):
+    """3:2 compressor: (sum, carry) bitplanes of a+b+c."""
+    axb = a ^ b
+    return axb ^ c, (a & b) | (c & axb)
+
+
+def rule(uw, uc, ue, mw, me, dw, dc, de, mid):
+    """B3/S23 from the eight packed neighbor arrays and the center cells."""
+    a0, a1 = csa3(uw, uc, ue)
+    c0, c1 = csa3(dw, dc, de)
+    m0, m1 = mw ^ me, mw & me
+    s0, k0 = csa3(a0, m0, c0)
+    # count4 = a1 + m1 + c1 + k0 = 4*u1 + 2*u0 + b1
+    p, q = a1 ^ m1, a1 & m1
+    r, s = c1 ^ k0, c1 & k0
+    b1, t = p ^ r, p & r
+    u0 = q ^ s ^ t
+    u1 = (q & s) | (t & (q ^ s))
+    # N = s0 + 2*b1 + 4*u0 + 8*u1; alive iff N==3 or (N==2 and alive).
+    return b1 & ~(u0 | u1) & (s0 | mid)
+
+
+def evolve_rows(up, mid, down, roll_words):
+    """One generation given the three row-shifted packed arrays.
+
+    ``roll_words(x, shift)`` must return the word array rolled along the word
+    axis (torus wrap across the row ends) — jnp.roll outside kernels,
+    pltpu.roll inside."""
+    def we(x):
+        return west(x, roll_words(x, 1)), east(x, roll_words(x, -1))
+
+    uw, ue = we(up)
+    mw, me = we(mid)
+    dw, de = we(down)
+    return rule(uw, up, ue, mw, me, dw, down, de, mid=mid)
+
+
+def evolve_torus_words(x: jnp.ndarray) -> jnp.ndarray:
+    """Whole-torus packed evolve (jnp level, any backend)."""
+    up = jnp.roll(x, 1, axis=0)
+    down = jnp.roll(x, -1, axis=0)
+    return evolve_rows(up, x, down, lambda a, s: jnp.roll(a, s, axis=1))
+
+
+def evolve_extended(xce: jnp.ndarray) -> jnp.ndarray:
+    """One generation for the interior of a ghost-extended word block.
+
+    ``xce`` is (h+2, nwords+2): one ghost word row above/below and one ghost
+    word column either side (of which only the adjacent bit is consumed by
+    the shift carries). This is the packed analog of the byte-level
+    ``evolve_padded`` (the src/game_mpi.c:73-84 shape)."""
+    h = xce.shape[0] - 2
+
+    def band(r):
+        b = xce[r : r + h, :]
+        x = b[:, 1:-1]
+        return west(x, b[:, :-2]), x, east(x, b[:, 2:])
+
+    uw, uc, ue = band(0)
+    mw, mc, me = band(1)
+    dw, dc, de = band(2)
+    return rule(uw, uc, ue, mw, me, dw, dc, de, mid=mc)
+
+
+def encode(grid: jnp.ndarray) -> jnp.ndarray:
+    """uint8 (H, W) cells -> uint32 (H, W/32) words (bit j = column w*32+j)."""
+    height, width = grid.shape
+    bits = grid.reshape(height, width // BITS, BITS).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(BITS, dtype=jnp.uint32))[None, None, :]
+    return jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def decode(words: jnp.ndarray) -> jnp.ndarray:
+    """uint32 (H, W/32) words -> uint8 (H, W) cells."""
+    height, nwords = words.shape
+    shifts = jnp.arange(BITS, dtype=jnp.uint32)[None, None, :]
+    bits = (words[:, :, None] >> shifts) & jnp.uint32(1)
+    return bits.astype(jnp.uint8).reshape(height, nwords * BITS)

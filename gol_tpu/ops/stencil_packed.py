@@ -33,25 +33,35 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gol_tpu.ops import packed_math
+from gol_tpu.parallel import halo
 from gol_tpu.parallel.mesh import Topology
 
-_BITS = 32
+_BITS = packed_math.BITS
 _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
 # Target VMEM bytes for one band of packed words; the ~10 live temporaries of
 # the adder network and the double-buffered in/out blocks sit beside it.
 _BAND_BYTES = 256 << 10
 
+# Re-exported for the kernel registry: the engine packs/unpacks at the loop
+# boundary through these.
+encode = packed_math.encode
+decode = packed_math.decode
 
-def supports(height: int, width: int, topology: Topology) -> bool:
-    # Narrow word arrays (nwords < 128 lanes) are fine: Mosaic's dynamic
-    # rotate operates on the logical shape, verified compiled on v5e down to
-    # a single-word row (64x32 and 512x1152 grids match the oracle).
-    return (
-        not topology.distributed
-        and width % _BITS == 0
-        and height % _SUBLANES == 0
-        and height >= _SUBLANES
-    )
+
+def supports(height: int, width: int, topology) -> bool:
+    """Packed paths: compiled Pallas single-device, jnp+ppermute distributed.
+
+    Narrow word arrays (nwords < 128 lanes) are fine: Mosaic's dynamic rotate
+    operates on the logical shape, verified compiled on v5e down to a
+    single-word row (64x32 and 512x1152 grids match the oracle). ``width``
+    and ``height`` are the LOCAL shard shape under a mesh.
+    """
+    if width % _BITS != 0:
+        return False
+    if topology.distributed:
+        return True  # jnp-level path, no tiling constraints
+    return height % _SUBLANES == 0 and height >= _SUBLANES
 
 
 def _pick_band(height: int, words: int) -> int:
@@ -61,60 +71,6 @@ def _pick_band(height: int, words: int) -> int:
         if height % band == 0 and band % _SUBLANES == 0:
             return band
     raise ValueError(f"no {_SUBLANES}-aligned band divides height {height}")
-
-
-def encode(grid: jnp.ndarray) -> jnp.ndarray:
-    """uint8 (H, W) cells -> uint32 (H, W/32) words (bit j = column w*32+j)."""
-    height, width = grid.shape
-    bits = grid.reshape(height, width // _BITS, _BITS).astype(jnp.uint32)
-    weights = (jnp.uint32(1) << jnp.arange(_BITS, dtype=jnp.uint32))[None, None, :]
-    return jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
-
-
-def decode(words: jnp.ndarray) -> jnp.ndarray:
-    """uint32 (H, W/32) words -> uint8 (H, W) cells."""
-    height, nwords = words.shape
-    shifts = jnp.arange(_BITS, dtype=jnp.uint32)[None, None, :]
-    bits = (words[:, :, None] >> shifts) & jnp.uint32(1)
-    return bits.astype(jnp.uint8).reshape(height, nwords * _BITS)
-
-
-def _west(x: jnp.ndarray) -> jnp.ndarray:
-    """Packed array of each cell's west (column-1) neighbor."""
-    carry = jax.lax.shift_right_logical(
-        pltpu.roll(x, 1, 1), jnp.uint32(_BITS - 1)
-    )
-    return jax.lax.shift_left(x, jnp.uint32(1)) | carry
-
-
-def _east(x: jnp.ndarray) -> jnp.ndarray:
-    """Packed array of each cell's east (column+1) neighbor."""
-    carry = jax.lax.shift_left(
-        pltpu.roll(x, x.shape[1] - 1, 1), jnp.uint32(_BITS - 1)
-    )
-    return jax.lax.shift_right_logical(x, jnp.uint32(1)) | carry
-
-
-def _csa3(a, b, c):
-    """3:2 compressor: sum and carry bitplanes of a+b+c."""
-    axb = a ^ b
-    return axb ^ c, (a & b) | (c & axb)
-
-
-def _evolve_words(up, mid, down):
-    """One generation for packed rows (up/mid/down already row-shifted)."""
-    a0, a1 = _csa3(_west(up), up, _east(up))
-    c0, c1 = _csa3(_west(down), down, _east(down))
-    mw, me = _west(mid), _east(mid)
-    m0, m1 = mw ^ me, mw & me
-    s0, k0 = _csa3(a0, m0, c0)
-    # count4 = a1 + m1 + c1 + k0 = 4*u1 + 2*u0 + b1
-    p, q = a1 ^ m1, a1 & m1
-    r, s = c1 ^ k0, c1 & k0
-    b1, t = p ^ r, p & r
-    u0, u1 = _csa3(q, s, t)[0], (q & s) | (t & (q ^ s))
-    # N = s0 + 2*b1 + 4*u0 + 8*u1; B3/S23: alive iff N==3 or (N==2 and alive).
-    return b1 & ~(u0 | u1) & (s0 | mid)
 
 
 def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *, band: int):
@@ -140,7 +96,9 @@ def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *,
         rows == band - 1, jnp.broadcast_to(bot_row, mid.shape), pltpu.roll(mid, band - 1, 0)
     )
 
-    new = _evolve_words(up, mid, down)
+    new = packed_math.evolve_rows(
+        up, mid, down, lambda a, s: pltpu.roll(a, s % a.shape[1], 1)
+    )
     out_ref[:] = new
 
     alive = jnp.max(jnp.where(new != 0, 1, 0))
@@ -197,15 +155,42 @@ def _step(words: jnp.ndarray, interpret: bool = False):
     return new, alive[0, 0] > 0, similar[0, 0] > 0
 
 
+def _distributed_step(words: jnp.ndarray, topology: Topology):
+    """Shard-local packed step under shard_map: word-level ppermute halo.
+
+    The reference exchanges byte rows/columns with 16 persistent requests
+    (src/game_mpi.c:340-383); packed, the same two-phase exchange moves word
+    rows and one ghost word column per side (of which only the adjacent bit
+    feeds the shift carries). The column phase runs over the row-extended
+    block, so corner words ride along exactly as in the byte-level exchange
+    (the src/game_cuda.cu:64-74 trick, one level up).
+    """
+    xce = halo.exchange(words, topology)  # (h+2, nwords+2) ghost-extended words
+    new = packed_math.evolve_extended(xce)
+    alive = jnp.any(new != 0)
+    similar = jnp.all(new == words)
+    return new, alive, similar
+
+
 def packed_step(cur: jnp.ndarray, topology: Topology):
-    """Fused generation step on packed state: ``words -> (words, alive, similar)``."""
+    """Fused generation step on packed state: ``words -> (words, alive, similar)``.
+
+    Single device: the compiled Pallas band kernel. Distributed: the jnp
+    adder network around a word-level ppermute halo exchange.
+    """
     height, nwords = cur.shape
     if not supports(height, nwords * _BITS, topology):
         raise ValueError(
-            f"the packed kernel requires a single-device grid with height a "
-            f"multiple of {_SUBLANES} and width a multiple of {_BITS}; got "
+            f"the packed kernel requires width a multiple of {_BITS} and, on "
+            f"a single device, height a multiple of {_SUBLANES}; got "
             f"{height}x{nwords * _BITS} on {topology.shape[0]}x"
             f"{topology.shape[1]} devices — use kernel='lax' (or 'auto')"
         )
-    interpret = jax.default_backend() != "tpu"
-    return _step(cur, interpret=interpret)
+    if topology.distributed:
+        return _distributed_step(cur, topology)
+    if jax.default_backend() != "tpu":
+        # Off-TPU the jnp adder network beats running Mosaic's interpreter;
+        # the kernel body itself is covered by interpret-mode tests.
+        new = packed_math.evolve_torus_words(cur)
+        return new, jnp.any(new != 0), jnp.all(new == cur)
+    return _step(cur)

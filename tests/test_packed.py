@@ -51,6 +51,15 @@ def test_word_boundary_glider():
     np.testing.assert_array_equal(np.asarray(sp.decode(state)), cur)
 
 
+def test_torus_words_matches_oracle():
+    from gol_tpu.ops import packed_math as pm
+
+    rng = np.random.default_rng(2)
+    g = rng.integers(0, 2, size=(32, 256), dtype=np.uint8)
+    got = np.asarray(pm.decode(pm.evolve_torus_words(pm.encode(jnp.asarray(g)))))
+    np.testing.assert_array_equal(got, oracle.evolve(g))
+
+
 def test_engine_run_both_conventions():
     rng = np.random.default_rng(11)
     g = rng.integers(0, 2, size=(32, 128), dtype=np.uint8)
@@ -80,9 +89,35 @@ def test_engine_early_exits():
 def test_shape_gating():
     assert sp.supports(4096, 4096, SINGLE_DEVICE)
     assert not sp.supports(30, 30, SINGLE_DEVICE)  # width not a multiple of 32
-    assert not sp.supports(4096, 4096, Topology(shape=(2, 2), axes=("row", "col")))
+    # Distributed: only the local width must pack; height is unconstrained.
+    assert sp.supports(6, 64, Topology(shape=(2, 2), axes=("row", "col")))
+    assert not sp.supports(6, 48, Topology(shape=(2, 2), axes=("row", "col")))
     with pytest.raises(ValueError, match="packed kernel"):
-        get_kernel("packed").fused(
-            jnp.zeros((8, 4), jnp.uint32),
-            Topology(shape=(2, 2), axes=("row", "col")),
-        )
+        get_kernel("packed").fused(jnp.zeros((12, 4), jnp.uint32), SINGLE_DEVICE)
+
+
+def test_distributed_packed_matches_oracle():
+    from gol_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+    config = GameConfig(gen_limit=60)
+    expect = oracle.run(g, config)
+    got = engine.simulate(g, config, mesh=mesh, kernel="packed")
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
+def test_distributed_packed_glider_crosses_shard_and_word_seams():
+    from gol_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 4)
+    g = np.zeros((64, 256), np.uint8)
+    glider = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8)
+    g[30:33, 62:65] = glider  # straddles the row-shard seam and a col seam
+    config = GameConfig(gen_limit=300)
+    expect = oracle.run(g, config)
+    got = engine.simulate(g, config, mesh=mesh, kernel="packed")
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
