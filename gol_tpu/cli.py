@@ -115,8 +115,11 @@ def _run(args) -> int:
     if args.host:
         # lax is what the host oracle effectively is, so it stays accepted;
         # forcing an accelerator kernel alongside --host is a contradiction.
-        if args.mesh or args.kernel not in ("auto", "lax"):
-            raise ValueError("--mesh/--kernel do not apply with --host (oracle runs on the host CPU)")
+        if args.mesh or args.kernel not in ("auto", "lax") or args.packed_io:
+            raise ValueError(
+                "--mesh/--kernel/--packed-io do not apply with --host "
+                "(oracle runs on the host CPU)"
+            )
         return _run_host(args, variant, config, width, height, output_path)
 
     mesh = _parse_mesh_arg(args.mesh, variant.distributed)
@@ -124,34 +127,146 @@ def _run(args) -> int:
 
     validate_grid(height, width, topology_for(mesh))
 
+    if args.packed_io:
+        if args.snapshot_every:
+            raise ValueError("--packed-io and --snapshot-every are not combinable yet")
+        if args.kernel not in ("auto", "packed"):
+            raise ValueError(
+                f"--packed-io always runs the packed kernel; --kernel "
+                f"{args.kernel!r} contradicts it"
+            )
+        return _run_packed_io(args, variant, config, width, height, output_path, mesh)
+
     t0 = time.perf_counter()
     device_grid = _read_phase(variant, args.input_file, width, height, mesh)
     read_ms = (time.perf_counter() - t0) * 1000
     if variant.io_timings:
         print(f"Reading file:\t{read_ms:.2f} msecs")
 
-    runner = engine.make_runner((height, width), config, mesh, args.kernel)
-    compiled = runner.lower(device_grid).compile()
+    if args.snapshot_every:
+        run_fn = _prepare_segmented(args, variant, config, mesh, device_grid, height, width)
+    else:
+        runner = engine.make_runner((height, width), config, mesh, args.kernel)
+        compiled = runner.lower(device_grid).compile()
+        if args.warmup:
+            # One discarded run: absorbs runtime/program-upload init that
+            # would otherwise land in Execution time (remote-attached
+            # accelerators pay it on the first call, not at compile()).
+            _, g0 = compiled(device_grid)
+            int(g0)
 
-    t0 = time.perf_counter()
-    final, gen = compiled(device_grid)
-    generations = int(gen)  # blocks until the on-device loop finishes
-    exec_ms = (time.perf_counter() - t0) * 1000
+        def run_fn():
+            final, gen = compiled(device_grid)
+            return final, int(gen)  # int() blocks until the loop finishes
 
+    with _profile_trace(args.profile):
+        t0 = time.perf_counter()
+        final, generations = run_fn()
+        exec_ms = (time.perf_counter() - t0) * 1000
+
+    return _report_and_write(
+        variant,
+        generations,
+        exec_ms,
+        lambda: _write_phase(variant, output_path, final),
+    )
+
+
+def _report_and_write(variant, generations, exec_ms, write_fn) -> int:
+    """The reference's printed-output contract, shared by every lane
+    (src/game.c:201-206, src/game_mpi_collective.c:367-450)."""
     if variant.serial_header:
         print("Finished.\n")
     print(f"Generations:\t{generations}")
     print(f"Execution time:\t{exec_ms:.2f} msecs")
-
     t0 = time.perf_counter()
-    _write_phase(variant, output_path, final)
+    write_fn()
     write_ms = (time.perf_counter() - t0) * 1000
     if variant.io_timings:
         print(f"Writing file:\t{write_ms:.2f} msecs")
-
     if variant.final_finished:
         print("Finished")
     return 0
+
+
+def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> int:
+    """The all-packed lane: file -> word state -> file, no uint8 grid ever.
+
+    Timing lines keep the reference contract; the packed read/write go
+    through the native codec (gol_tpu/native/codec.c)."""
+    from gol_tpu.io import packed_io
+
+    t0 = time.perf_counter()
+    words = packed_io.read_packed(args.input_file, width, height, mesh)
+    read_ms = (time.perf_counter() - t0) * 1000
+    if variant.io_timings:
+        print(f"Reading file:\t{read_ms:.2f} msecs")
+
+    runner = engine.make_packed_runner((height, width), config, mesh)
+    compiled = runner.lower(words).compile()
+    if args.warmup:
+        _, g0 = compiled(words)
+        int(g0)
+    with _profile_trace(args.profile):
+        t0 = time.perf_counter()
+        final, gen = compiled(words)
+        generations = int(gen)
+        exec_ms = (time.perf_counter() - t0) * 1000
+
+    return _report_and_write(
+        variant,
+        generations,
+        exec_ms,
+        lambda: packed_io.write_packed(output_path, final, width),
+    )
+
+
+def _profile_trace(profile_dir: str | None):
+    """jax.profiler trace capture — the rich counterpart of the reference's
+    three coarse phase timers (SURVEY.md §5 tracing)."""
+    if not profile_dir:
+        import contextlib
+
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(profile_dir)
+
+
+def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
+    """Build the snapshotting loop with compile and init outside the timer.
+
+    A zero-step segment call compiles the program and uploads it to the
+    device (the --warmup treatment, done unconditionally here so segmented
+    Execution time is comparable to the unsegmented lane, which compiles
+    before its timer too). Each snapshot is a valid input file (the
+    reference's only resume path, output-is-input, src/game.c:25-40 vs
+    :154-165 — here it exists mid-run). Exec time covers the segmented loop
+    including snapshot writes.
+    """
+    import os
+
+    import jax.numpy as jnp
+
+    runner = engine.make_segment_runner((height, width), config, mesh, args.kernel)
+    gen0 = engine._GEN_START[config.convention]
+    _, g, _, _ = runner(device_grid, jnp.int32(gen0), jnp.int32(0), jnp.int32(0))
+    int(g)  # zero-step call: compile + program upload, no simulation
+
+    outdir = args.snapshot_dir or "./snapshots"
+    os.makedirs(outdir, exist_ok=True)
+
+    def run_fn():
+        final, generations = device_grid, 0
+        for generations, final, _stopped in engine.simulate_segments(
+            device_grid, config, mesh, args.kernel, args.snapshot_every
+        ):
+            _write_phase(
+                variant, os.path.join(outdir, f"gen_{generations:06d}.out"), final
+            )
+        return final, generations
+
+    return run_fn
 
 
 def _run_host(args, variant, config, width, height, output_path) -> int:
@@ -212,6 +327,35 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--no-check-similarity", action="store_true")
     run.add_argument("--output", default=None, help="override the output file path")
     run.add_argument("--host", action="store_true", help="run the NumPy oracle on CPU")
+    run.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of the run into DIR",
+    )
+    run.add_argument(
+        "--snapshot-every",
+        type=int,
+        default=None,
+        metavar="N",
+        help="write a resumable grid snapshot every N generations "
+        "(exec time then includes snapshot writes)",
+    )
+    run.add_argument(
+        "--snapshot-dir", default=None, help="snapshot directory (default ./snapshots)"
+    )
+    run.add_argument(
+        "--warmup",
+        action="store_true",
+        help="run the compiled program once, untimed, before the measured run "
+        "(excludes one-time runtime init from Execution time)",
+    )
+    run.add_argument(
+        "--packed-io",
+        action="store_true",
+        help="stream the file directly to/from bitpacked device state via the "
+        "native codec (width must divide by 32 x mesh cols)",
+    )
     run.set_defaults(func=_run)
 
     gen = sub.add_parser("generate", help="emit a random grid (replaces generate.sh)")

@@ -79,19 +79,25 @@ def _similarity_vote(fire, cur, new, similar_local, topology: Topology):
     )
 
 
-def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel):
+def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel, resume=None):
     """C-variant loop (src/game.c:177-196, src/game_mpi_collective.c:331-365).
 
     Emptiness is checked at the top of every generation on the current grid;
     the similarity break does not increment the counter; the reported count is
     ``generation - 1``.
+
+    ``resume`` is ``None`` for a whole run, or ``(gen0, counter0, seg_end)``
+    scalars to execute one segment of a longer run exactly (the loop state a
+    snapshotting driver carries between compiled calls).
     """
     limit = jnp.int32(config.gen_limit)
     freq = jnp.int32(config.similarity_frequency)
+    gen0, counter0, seg_end = resume if resume is not None else (1, 0, limit)
+    bound = jnp.minimum(limit, jnp.int32(seg_end))
 
     def cond(state):
         _, gen, _, alive, similar = state
-        return alive & jnp.logical_not(similar) & (gen <= limit)
+        return alive & jnp.logical_not(similar) & (gen <= bound)
 
     def body(state):
         cur, gen, counter, _, _ = state
@@ -107,12 +113,15 @@ def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel):
         return (new, gen, counter, alive, similar)
 
     alive0 = collectives.any_flag(jnp.any(grid), topology)
-    state0 = (grid, jnp.int32(1), jnp.int32(0), alive0, jnp.asarray(False))
-    final, gen, _, _, _ = jax.lax.while_loop(cond, body, state0)
-    return final, gen - 1
+    state0 = (grid, jnp.int32(gen0), jnp.int32(counter0), alive0, jnp.asarray(False))
+    final, gen, counter, alive, similar = jax.lax.while_loop(cond, body, state0)
+    stopped = jnp.logical_not(alive) | similar | (gen > limit)
+    # Reported count is gen-1 (src/game.c:202); mid-run segments report the
+    # raw resume state instead.
+    return final, gen, counter, stopped
 
 
-def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel):
+def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel, resume=None):
     """CUDA-variant loop (src/game_cuda.cu:222-276).
 
     0-based exclusive bound; no emptiness test before the first evolve; the
@@ -123,10 +132,12 @@ def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel)
     """
     limit = jnp.int32(config.gen_limit)
     freq = jnp.int32(config.similarity_frequency)
+    gen0, counter0, seg_end = resume if resume is not None else (0, 0, limit)
+    bound = jnp.minimum(limit, jnp.int32(seg_end))
 
     def cond(state):
         _, gen, _, stop = state
-        return jnp.logical_not(stop) & (gen < limit)
+        return jnp.logical_not(stop) & (gen < bound)
 
     def body(state):
         cur, gen, counter, _ = state
@@ -143,12 +154,17 @@ def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel)
         gen = jnp.where(stop, gen, gen + 1)
         return (cur, gen, counter, stop)
 
-    state0 = (grid, jnp.int32(0), jnp.int32(0), jnp.asarray(False))
-    final, gen, _, _ = jax.lax.while_loop(cond, body, state0)
-    return final, gen
+    state0 = (grid, jnp.int32(gen0), jnp.int32(counter0), jnp.asarray(False))
+    final, gen, counter, stop = jax.lax.while_loop(cond, body, state0)
+    stopped = stop | (gen >= limit)
+    return final, gen, counter, stopped
 
 
 _SIMULATORS = {Convention.C: _simulate_c, Convention.CUDA: _simulate_cuda}
+
+# Per-convention: (first generation value, reported count from the final gen).
+_GEN_START = {Convention.C: 1, Convention.CUDA: 0}
+_REPORT = {Convention.C: lambda gen: gen - 1, Convention.CUDA: lambda gen: gen}
 
 
 @functools.lru_cache(maxsize=64)
@@ -175,16 +191,18 @@ def make_runner(
         )
     simulate = _SIMULATORS[config.convention]
 
+    report = _REPORT[config.convention]
+
     def local_fn(g):
         # Kernels with their own carried representation (the bitpacked path)
         # convert once at the loop boundary; the generation loop never touches
         # the canonical uint8 grid.
         if kernel_obj.encode is not None:
             g = kernel_obj.encode(g)
-        final, gen = simulate(g, config, topology, kernel_obj)
+        final, gen, _, _ = simulate(g, config, topology, kernel_obj)
         if kernel_obj.decode is not None:
             final = kernel_obj.decode(final)
-        return final, gen
+        return final, report(gen)
 
     if topology.distributed:
         fn = jax.shard_map(
@@ -196,6 +214,127 @@ def make_runner(
     else:
         fn = local_fn
     return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def make_segment_runner(
+    shape: tuple[int, int],
+    config: GameConfig = DEFAULT_CONFIG,
+    mesh: Mesh | None = None,
+    kernel: str = "auto",
+):
+    """Compile a resumable segment: ``(grid, gen0, counter0, seg_end) ->
+    (grid, gen, counter, stopped)``.
+
+    Running segments back-to-back with the carried (gen, counter) state is
+    bit-exact with one whole-run while_loop — the basis for periodic
+    snapshots, which the reference lacks entirely (SURVEY.md §5
+    checkpoint/resume: its only resume path is that the output file is a
+    valid input file).
+    """
+    topology = topology_for(mesh)
+    local_h, local_w = validate_grid(shape[0], shape[1], topology)
+    kernel_obj = resolve_kernel(kernel, local_h, local_w, topology)
+    if not kernel_obj.supports(local_h, local_w, topology):
+        raise ValueError(
+            f"kernel {kernel_obj.name!r} does not support a {local_h}x{local_w} "
+            f"local shard on a {topology.shape[0]}x{topology.shape[1]} topology"
+        )
+    simulate = _SIMULATORS[config.convention]
+
+    def local_fn(g, gen0, counter0, seg_end):
+        if kernel_obj.encode is not None:
+            g = kernel_obj.encode(g)
+        final, gen, counter, stopped = simulate(
+            g, config, topology, kernel_obj, resume=(gen0, counter0, seg_end)
+        )
+        if kernel_obj.decode is not None:
+            final = kernel_obj.decode(final)
+        return final, gen, counter, stopped
+
+    if topology.distributed:
+        fn = jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(P(*topology.axes), P(), P(), P()),
+            out_specs=(P(*topology.axes), P(), P(), P()),
+        )
+    else:
+        fn = local_fn
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def make_packed_runner(
+    shape: tuple[int, int],
+    config: GameConfig = DEFAULT_CONFIG,
+    mesh: Mesh | None = None,
+):
+    """Compile a runner over bitpacked state: ``words -> (words, generations)``.
+
+    ``shape`` is the logical (height, width) grid shape; the operand is its
+    (height, width/32) uint32 word array (io/packed_io.py reads/writes those
+    directly, so the uint8 grid never exists anywhere).
+    """
+    topology = topology_for(mesh)
+    local_h, local_w = validate_grid(shape[0], shape[1], topology)
+    kernel_obj = resolve_kernel("packed", local_h, local_w, topology)
+    if not kernel_obj.supports(local_h, local_w, topology):
+        raise ValueError(
+            f"packed state unsupported for a {local_h}x{local_w} local shard "
+            f"on a {topology.shape[0]}x{topology.shape[1]} topology"
+        )
+    simulate = _SIMULATORS[config.convention]
+    report = _REPORT[config.convention]
+
+    def local_fn(words):
+        final, gen, _, _ = simulate(words, config, topology, kernel_obj)
+        return final, report(gen)
+
+    if topology.distributed:
+        fn = jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=P(*topology.axes),
+            out_specs=(P(*topology.axes), P()),
+        )
+    else:
+        fn = local_fn
+    return jax.jit(fn)
+
+
+def simulate_segments(
+    grid,
+    config: GameConfig = DEFAULT_CONFIG,
+    mesh: Mesh | None = None,
+    kernel: str = "auto",
+    segment: int = 100,
+):
+    """Generator of ``(generations_so_far, device_grid, stopped)`` per segment.
+
+    Semantically identical to one ``simulate`` call (same final grid and
+    reported count) but yields control to the host every ``segment``
+    generations so callers can snapshot, log, or abort. The similarity
+    counter is carried across segments, so exits fire on exactly the same
+    generations as the unsegmented loop.
+    """
+    if segment <= 0:
+        raise ValueError(f"segment must be positive, got {segment}")
+    shape = tuple(np.shape(grid))
+    runner = make_segment_runner(shape, config, mesh, kernel)
+    device_grid = grid if isinstance(grid, jax.Array) else put_grid(grid, mesh)
+    report = _REPORT[config.convention]
+    gen = _GEN_START[config.convention]
+    counter = 0
+    while True:
+        seg_end = gen + segment - (1 if config.convention == Convention.C else 0)
+        device_grid, gen_a, counter_a, stopped_a = runner(
+            device_grid, jnp.int32(gen), jnp.int32(counter), jnp.int32(seg_end)
+        )
+        gen, counter, stopped = int(gen_a), int(counter_a), bool(stopped_a)
+        yield report(gen), device_grid, stopped
+        if stopped:
+            return
 
 
 def put_grid(grid, mesh: Mesh | None = None) -> jax.Array:

@@ -1,0 +1,62 @@
+/* Native text-grid <-> bitpacked-words codec.
+ *
+ * The reference's I/O layer is native C in all six programs (fgetc parse
+ * loops, src/game.c:149-166; MPI-IO byte windows, src/game_mpi_collective.c:
+ * 174-196). This is the TPU build's native counterpart, shaped for the
+ * bitpacked engine: text bytes ('0'/'1' cells, '\n' row terminators) convert
+ * straight to/from uint32 cell words (bit j of word w = column w*32+j),
+ * skipping the 8x larger uint8 cell intermediate entirely.
+ *
+ * Only the byte '1' is a live cell (the text_grid contract: anything else is
+ * dead); unpacking emits '0' + bit. Single-threaded per call: ctypes
+ * releases the GIL, and the Python sharded-I/O layer already fans shards out
+ * over a thread pool.
+ *
+ * Row addressing uses a byte stride so callers can map the
+ * height x (width+1) file layout directly (the '+1' newline column of
+ * src/game_mpi_collective.c:180-186).
+ */
+
+#include <stdint.h>
+
+/* text (rows x >=width chars at `stride` bytes apart) -> words (rows x
+ * width/32). width must be a multiple of 32. */
+void gol_pack_text(const uint8_t *text, int64_t stride, uint32_t *words,
+                   int64_t rows, int64_t width) {
+  const int64_t row_words = width / 32;
+  for (int64_t r = 0; r < rows; ++r) {
+    const uint8_t *src = text + r * stride;
+    uint32_t *dst = words + r * row_words;
+    for (int64_t w = 0; w < row_words; ++w) {
+      uint32_t acc = 0;
+      const uint8_t *chunk = src + w * 32;
+      for (int b = 0; b < 32; ++b) {
+        acc |= (uint32_t)(chunk[b] == '1') << b;
+      }
+      dst[w] = acc;
+    }
+  }
+}
+
+/* words (rows x width/32) -> text rows at `stride` bytes apart; writes the
+ * '\n' terminator after each row iff newline != 0 (east-edge shards own the
+ * newline column, src/game_mpi_collective.c:382-393). */
+void gol_unpack_text(const uint32_t *words, int64_t stride, uint8_t *text,
+                     int64_t rows, int64_t width, int newline) {
+  const int64_t row_words = width / 32;
+  for (int64_t r = 0; r < rows; ++r) {
+    const uint32_t *src = words + r * row_words;
+    uint8_t *dst = text + r * stride;
+    for (int64_t w = 0; w < row_words; ++w) {
+      uint32_t acc = src[w];
+      uint8_t *chunk = dst + w * 32;
+      for (int b = 0; b < 32; ++b) {
+        chunk[b] = (uint8_t)('0' + ((acc >> b) & 1u));
+      }
+    }
+    if (newline) {
+      dst[width] = '\n';
+    }
+  }
+}
+

@@ -1,0 +1,107 @@
+"""Native codec + packed I/O tests.
+
+The C codec must agree byte-for-byte with the numpy fallback and with the
+text-grid contract; the packed I/O lane must round-trip files identically to
+the byte-level sharded I/O.
+"""
+
+import numpy as np
+import pytest
+
+from gol_tpu import cli, native, oracle
+from gol_tpu.config import GameConfig
+from gol_tpu.io import packed_io, text_grid
+from gol_tpu.ops import packed_math
+from gol_tpu.parallel.mesh import make_mesh
+
+import jax.numpy as jnp
+
+
+def test_native_codec_builds():
+    # The image ships a C toolchain; the codec must actually build there.
+    assert native.available()
+
+
+def test_pack_text_matches_encode():
+    rng = np.random.default_rng(1)
+    g = rng.integers(0, 2, size=(16, 96), dtype=np.uint8)
+    text = g + ord("0")
+    words = native.pack_text(text, 96)
+    expect = np.asarray(packed_math.encode(jnp.asarray(g)))
+    np.testing.assert_array_equal(words, expect)
+
+
+def test_pack_text_strict_one():
+    """Only '1' is alive — '3' (odd byte) must pack as dead, like text_grid."""
+    text = np.full((1, 32), ord("0"), np.uint8)
+    text[0, 0] = ord("1")
+    text[0, 1] = ord("3")
+    for words in (native.pack_text(text, 32), native.pack_text(text.copy(order="F").T.T, 32)):
+        assert words[0, 0] == 1  # just bit 0
+
+
+def test_pack_text_strided_window():
+    """Pack through a memmap-style strided view (the newline-column layout)."""
+    rng = np.random.default_rng(2)
+    g = rng.integers(0, 2, size=(8, 64), dtype=np.uint8)
+    raw = np.full((8, 65), ord("\n"), np.uint8)
+    raw[:, :64] = g + ord("0")
+    words = native.pack_text(raw, 64)  # full stride incl newline col
+    expect = np.asarray(packed_math.encode(jnp.asarray(g)))
+    np.testing.assert_array_equal(words, expect)
+
+
+def test_unpack_text_roundtrip():
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, 2, size=(8, 64), dtype=np.uint8)
+    words = np.asarray(packed_math.encode(jnp.asarray(g)))
+    out = np.zeros((8, 65), np.uint8)
+    native.unpack_text(words, out, 64, True)
+    np.testing.assert_array_equal(out[:, :64], g + ord("0"))
+    assert (out[:, 64] == ord("\n")).all()
+
+
+def test_packed_file_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+    g = rng.integers(0, 2, size=(32, 128), dtype=np.uint8)
+    path = tmp_path / "grid.txt"
+    text_grid.write_grid(str(path), g)
+    words = packed_io.read_packed(str(path), 128, 32)
+    np.testing.assert_array_equal(
+        np.asarray(packed_math.decode(jnp.asarray(words))), g
+    )
+    out = tmp_path / "out.txt"
+    packed_io.write_packed(str(out), words, 128)
+    assert out.read_bytes() == path.read_bytes()
+
+
+def test_packed_file_roundtrip_sharded(tmp_path):
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+    path = tmp_path / "grid.txt"
+    text_grid.write_grid(str(path), g)
+    words = packed_io.read_packed(str(path), 256, 64, mesh)
+    assert words.shape == (64, 8)
+    out = tmp_path / "out.txt"
+    packed_io.write_packed(str(out), words, 256)
+    assert out.read_bytes() == path.read_bytes()
+
+
+def test_packed_io_width_validation(tmp_path):
+    with pytest.raises(ValueError, match="divisible by 32"):
+        packed_io.read_packed(str(tmp_path / "x"), 48, 16, None)
+
+
+def test_cli_packed_io_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.default_rng(6)
+    g = rng.integers(0, 2, size=(64, 64), dtype=np.uint8)
+    text_grid.write_grid("in.txt", g)
+    rc = cli.main(
+        ["64", "64", "in.txt", "--variant", "game", "--gen-limit", "25", "--packed-io"]
+    )
+    assert rc == 0
+    expect = oracle.run(g, GameConfig(gen_limit=25))
+    got = text_grid.read_grid("game_output.out", 64, 64)
+    np.testing.assert_array_equal(got, expect.grid)
