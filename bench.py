@@ -15,10 +15,22 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _honor_platform_env() -> None:
+    """Some environments preload jax at interpreter start (sitecustomize),
+    consuming JAX_PLATFORMS before it is set; re-apply it via jax.config
+    (backends initialize lazily, so this works until first device use)."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
 
 TARGET_CELL_UPDATES_PER_SEC_PER_CHIP = 1e11  # BASELINE.md north star
 
@@ -34,6 +46,70 @@ def resolve_kernel_name(requested: str | None, size: int, mesh) -> str:
     return resolve_kernel("auto", local_h, local_w, topo).name
 
 
+def _bench_halo(args) -> int:
+    """p50 latency of one two-phase ppermute halo exchange on the mesh."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gol_tpu.parallel import halo
+    from gol_tpu.parallel.mesh import (
+        MESH_TOPOLOGY_AXES,
+        grid_sharding,
+        make_mesh,
+        topology_for,
+    )
+
+    if args.mesh:
+        r, c = (int(x) for x in args.mesh.split("x"))
+        mesh = make_mesh(r, c)
+    else:
+        mesh = make_mesh()
+    topo = topology_for(mesh)
+    if not topo.distributed:
+        print("bench --halo needs a >1-device mesh "
+              "(try XLA_FLAGS=--xla_force_host_platform_device_count=8)", file=sys.stderr)
+        return 1
+
+    rng = np.random.default_rng(0)
+    grid = rng.integers(0, 2, size=(args.size, args.size), dtype=np.uint8)
+    device_grid = jax.device_put(grid, grid_sharding(mesh))
+
+    @jax.jit
+    def exchange_once(g):
+        ext = jax.shard_map(
+            lambda x: halo.exchange(x, topo),
+            mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec(*MESH_TOPOLOGY_AXES),
+            out_specs=jax.sharding.PartitionSpec(*MESH_TOPOLOGY_AXES),
+        )(g)
+        return jnp.sum(ext.astype(jnp.int32))  # force the exchange
+
+    exchange_once(device_grid).block_until_ready()
+    samples = []
+    for _ in range(max(args.repeats * 10, 30)):
+        t0 = time.perf_counter()
+        int(exchange_once(device_grid))
+        samples.append((time.perf_counter() - t0) * 1e6)
+    p50 = statistics.median(samples)
+    print(f"halo p50 over {len(samples)} runs on {mesh.shape}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "halo_exchange_p50_latency",
+                "value": p50,
+                "unit": "us",
+                # No published halo baseline exists (BASELINE.md): null, not a
+                # fake ratio.
+                "vs_baseline": None,
+            }
+        )
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--size", type=int, default=4096, help="grid side length")
@@ -43,7 +119,17 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("--mesh", default=None, help="RxC device mesh (default: single)")
     parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument(
+        "--halo",
+        action="store_true",
+        help="measure halo-exchange p50 latency (BASELINE.md secondary metric) "
+        "instead of cell throughput; needs a >1-device mesh",
+    )
     args = parser.parse_args(argv)
+    _honor_platform_env()
+
+    if args.halo:
+        return _bench_halo(args)
 
     import jax
 

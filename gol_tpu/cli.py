@@ -52,7 +52,12 @@ def atoi(s: str | None) -> int:
 def _parse_mesh_arg(spec: str | None, distributed: bool):
     import jax
 
+    from gol_tpu.parallel import bootstrap
     from gol_tpu.parallel.mesh import make_mesh
+
+    # MPI_Init analog: joins the pod cluster when a launcher environment is
+    # present, no-op on a single host (gol_tpu/parallel/bootstrap.py).
+    bootstrap.initialize()
 
     if not distributed:
         if spec:
@@ -285,6 +290,24 @@ def _run_host(args, variant, config, width, height, output_path) -> int:
     return 0
 
 
+def _show(args) -> int:
+    """Render a grid file with the reference's VT100 codes (src/game.c:42-58);
+    --animate evolves it live on the host oracle."""
+    from gol_tpu import render
+
+    width, height = atoi(args.width), atoi(args.height)
+    if width <= 0:
+        width = DEFAULT_WIDTH
+    if height <= 0:
+        height = DEFAULT_HEIGHT
+    grid = text_grid.read_grid(args.input_file, width, height)
+    if args.animate:
+        render.animate(grid, args.animate, fps=args.fps)
+    else:
+        render.show(grid)
+    return 0
+
+
 def _generate(args) -> int:
     grid = text_grid.generate(
         args.width, args.height, density=args.density, seed=args.seed
@@ -358,6 +381,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.set_defaults(func=_run)
 
+    shw = sub.add_parser("show", help="render a grid in the terminal (VT100, src/game.c:42-58)")
+    shw.add_argument("width")
+    shw.add_argument("height")
+    shw.add_argument("input_file")
+    shw.add_argument("--animate", type=int, default=0, metavar="N", help="evolve N generations live")
+    shw.add_argument("--fps", type=float, default=10.0)
+    shw.set_defaults(func=_show)
+
     gen = sub.add_parser("generate", help="emit a random grid (replaces generate.sh)")
     gen.add_argument("width", type=int)
     gen.add_argument("height", type=int)
@@ -371,7 +402,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
-    if not argv or argv[0] not in ("run", "generate", "-h", "--help"):
+    if not argv or argv[0] not in ("run", "generate", "show", "-h", "--help"):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)
     try:

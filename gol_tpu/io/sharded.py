@@ -92,8 +92,11 @@ def write_sharded(path: str, grid: jax.Array, parallel: bool = False) -> None:
     what creating/truncating does.
     """
     height, width = grid.shape
-    with open(path, "wb") as f:
-        f.truncate(height * row_stride(width))
+    from gol_tpu.io.packed_io import _create_sized
+
+    # ftruncate-to-size, not open('wb'): multi-host writers must not zero
+    # each other's bytes on a shared filesystem.
+    _create_sized(path, height * row_stride(width))
     mm = _file_view(path, width, height, "r+")
     cells = mm[:, :width]
 

@@ -1,0 +1,59 @@
+"""Multi-host process bootstrap — the ``MPI_Init`` / ``mpiexec -n`` analog.
+
+The reference bootstraps its process group with ``MPI_Init`` + a Cartesian
+communicator (src/game_mpi_collective.c:116-133) launched by ``mpiexec -n <x>``
+(README.md:54-57). On TPU pods the analog is one Python process per host,
+``jax.distributed.initialize`` to form the cluster, and a ``Mesh`` spanning
+every chip; ICI carries the halo/psum traffic and DCN only carries the
+runtime's control plane.
+
+On Cloud TPU the coordinator/process-count/process-id triple is discovered
+from the environment, so ``initialize()`` with no arguments is the whole
+bootstrap. Elsewhere (e.g. a CPU test cluster) pass them explicitly, mirroring
+``mpiexec``'s rank/size.
+
+After initialization, ``gol_tpu.parallel.mesh.make_mesh`` over
+``jax.devices()`` (ALL processes' devices) plus the engine's ``shard_map`` is
+the complete distributed program; per-host I/O in ``io/sharded.py`` and
+``io/packed_io.py`` only touches addressable shards, so no host ever
+materializes the full grid — the property the reference gets from MPI-IO file
+views (src/game_mpi_collective.c:186-196).
+"""
+
+from __future__ import annotations
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join (or form) the multi-host cluster; no-op unless opted in.
+
+    Safe to call unconditionally at CLI start: with no arguments it only
+    initializes when ``GOL_MULTIHOST=1`` is set (the ``mpiexec`` analog is
+    the launcher exporting that), letting JAX auto-discover the coordinator;
+    pass the triple explicitly for manual clusters.
+    """
+    import jax
+
+    if coordinator_address is None and num_processes is None and process_id is None:
+        import os
+
+        # Auto-initialization is explicit opt-in (GOL_MULTIHOST=1): cluster
+        # env vars like TPU_WORKER_HOSTNAMES exist on single-chip setups too
+        # (sometimes holding placeholder text), so their presence alone must
+        # not make a plain run try to form a cluster.
+        if os.environ.get("GOL_MULTIHOST", "") not in ("1", "true"):
+            return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1

@@ -1,0 +1,66 @@
+"""VT100 renderer parity + bootstrap no-op + halo bench smoke."""
+
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from gol_tpu import render
+from gol_tpu.parallel import bootstrap
+
+
+def test_frame_matches_reference_codes():
+    g = np.array([[1, 0], [0, 1]], np.uint8)
+    f = render.frame(g)
+    # Exact escape sequences of src/game.c:42-58: home, reverse-video double
+    # space per live cell, plain double space per dead, next-line per row.
+    assert f == (
+        "\033[H"
+        + "\033[07m  \033[m" + "  " + "\033[E"
+        + "  " + "\033[07m  \033[m" + "\033[E"
+    )
+
+
+def test_animate_runs_and_stops_on_empty():
+    g = np.zeros((8, 8), np.uint8)
+    g[3, 3] = 1  # lone cell dies after one step
+    out = io.StringIO()
+    final = render.animate(g, 10, fps=0, out=out, sleep=lambda s: None)
+    assert not final.any()
+    assert out.getvalue().count("\033[H") == 2  # initial frame + one step
+
+
+def test_bootstrap_noop_without_cluster_env(monkeypatch):
+    for var in (
+        "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+        "TPU_WORKER_HOSTNAMES",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    bootstrap.initialize()  # must not raise or try to form a cluster
+    assert not bootstrap.is_multihost()
+
+
+def test_bench_halo_smoke():
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "JAX_PLATFORMS": "cpu",
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--halo", "--size", "64",
+         "--mesh", "2x4", "--repeats", "1"],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr
+    import json
+
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "halo_exchange_p50_latency"
+    assert line["value"] > 0
