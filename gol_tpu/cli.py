@@ -52,12 +52,7 @@ def atoi(s: str | None) -> int:
 def _parse_mesh_arg(spec: str | None, distributed: bool):
     import jax
 
-    from gol_tpu.parallel import bootstrap
     from gol_tpu.parallel.mesh import make_mesh
-
-    # MPI_Init analog: joins the pod cluster when a launcher environment is
-    # present, no-op on a single host (gol_tpu/parallel/bootstrap.py).
-    bootstrap.initialize()
 
     if not distributed:
         if spec:
@@ -127,9 +122,20 @@ def _run(args) -> int:
             )
         return _run_host(args, variant, config, width, height, output_path)
 
+    if variant.distributed:
+        # MPI_Init analog: joins the pod cluster when GOL_MULTIHOST is set,
+        # no-op otherwise (gol_tpu/parallel/bootstrap.py). Serial variants
+        # never form a cluster, like the reference's non-MPI programs.
+        from gol_tpu.parallel import bootstrap
+
+        bootstrap.initialize()
     mesh = _parse_mesh_arg(args.mesh, variant.distributed)
     from gol_tpu.parallel.mesh import topology_for, validate_grid
 
+    if mesh is not None and not topology_for(mesh).distributed:
+        # A 1x1 mesh IS the single-device engine; dropping the mesh avoids
+        # explicit-sharding annotations leaking into the unsharded kernels.
+        mesh = None
     validate_grid(height, width, topology_for(mesh))
 
     if args.packed_io:

@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu import native
-from gol_tpu.io.text_grid import row_stride
+from gol_tpu.io.text_grid import create_sized, row_stride
 from gol_tpu.ops.packed_math import BITS
 from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
 
@@ -29,21 +29,6 @@ from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
 def words_sharding(mesh: Mesh) -> NamedSharding:
     """Block sharding of the (height, width/32) word array over the mesh."""
     return NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
-
-
-def _create_sized(path: str, size: int) -> None:
-    """Create/size the output file without zeroing existing contents.
-
-    ``open(path, 'wb')`` truncates to zero, which on a shared filesystem
-    races away bytes other hosts already wrote; ``ftruncate`` to the final
-    size is idempotent across processes (the reference's MODE_EXCL
-    delete-and-retry dance, src/game_mpi_collective.c:429-436, solved the
-    same multi-writer problem)."""
-    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
-    try:
-        os.ftruncate(fd, size)
-    finally:
-        os.close(fd)
 
 
 def _check_shape(width: int, mesh: Mesh | None) -> None:
@@ -95,7 +80,7 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
     height, nwords = words.shape
     if nwords * BITS != width:
         raise ValueError(f"width {width} != {nwords} words x {BITS}")
-    _create_sized(path, height * row_stride(width))
+    create_sized(path, height * row_stride(width))
     mm = np.memmap(path, dtype=np.uint8, mode="r+", shape=(height, row_stride(width)))
 
     def store_window(shard) -> None:

@@ -47,7 +47,7 @@ def read_sharded(
     path: str,
     width: int,
     height: int,
-    mesh: Mesh,
+    mesh: Mesh | None,
     parallel: bool = False,
 ) -> jax.Array:
     """Load a grid file directly into a mesh-sharded device array."""
@@ -60,6 +60,8 @@ def read_sharded(
         )
     mm = _file_view(path, width, height, "r")
     cells = mm[:, :width]  # strided view that excludes the newline column
+    if mesh is None:  # single device: one window, plain placement
+        return jax.numpy.asarray((np.asarray(cells) == ONE).astype(np.uint8))
     sharding = grid_sharding(mesh)
 
     def load_window(index) -> np.ndarray:
@@ -92,11 +94,9 @@ def write_sharded(path: str, grid: jax.Array, parallel: bool = False) -> None:
     what creating/truncating does.
     """
     height, width = grid.shape
-    from gol_tpu.io.packed_io import _create_sized
-
     # ftruncate-to-size, not open('wb'): multi-host writers must not zero
     # each other's bytes on a shared filesystem.
-    _create_sized(path, height * row_stride(width))
+    text_grid.create_sized(path, height * row_stride(width))
     mm = _file_view(path, width, height, "r+")
     cells = mm[:, :width]
 
@@ -118,10 +118,12 @@ def write_sharded(path: str, grid: jax.Array, parallel: bool = False) -> None:
     mm.flush()
 
 
-def read_gathered(path: str, width: int, height: int, mesh: Mesh) -> jax.Array:
+def read_gathered(path: str, width: int, height: int, mesh: Mesh | None) -> jax.Array:
     """Master-scatter read: one host parses the file, blocks are scattered
     (src/game_mpi.c:201-239)."""
     host_grid = text_grid.read_grid(path, width, height)
+    if mesh is None:
+        return jax.numpy.asarray(host_grid)
     return jax.device_put(host_grid, grid_sharding(mesh))
 
 

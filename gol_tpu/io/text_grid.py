@@ -22,6 +22,23 @@ ZERO = 0x30  # '0'
 ONE = 0x31  # '1'
 
 
+def create_sized(path: str, size: int) -> None:
+    """Create/size a file without zeroing existing contents.
+
+    ``open(path, 'wb')`` truncates to zero, which on a shared filesystem
+    races away bytes other hosts already wrote; ``ftruncate`` to the final
+    size is idempotent across processes (the reference's MODE_EXCL
+    delete-and-retry dance, src/game_mpi_collective.c:429-436, solved the
+    same multi-writer problem)."""
+    import os
+
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        os.ftruncate(fd, size)
+    finally:
+        os.close(fd)
+
+
 def row_stride(width: int) -> int:
     """Bytes per row on disk: width cells + the newline column."""
     return width + 1

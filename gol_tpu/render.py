@@ -15,7 +15,6 @@ import time
 import numpy as np
 
 from gol_tpu import oracle
-from gol_tpu.config import GameConfig
 
 _HOME = "\033[H"
 _LIVE = "\033[07m  \033[m"  # reverse video, two spaces (src/game.c:51)
@@ -43,7 +42,6 @@ def animate(
     grid: np.ndarray,
     generations: int,
     fps: float = 10.0,
-    config: GameConfig | None = None,
     out=None,
     sleep=time.sleep,
 ) -> np.ndarray:

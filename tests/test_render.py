@@ -32,13 +32,8 @@ def test_animate_runs_and_stops_on_empty():
     assert out.getvalue().count("\033[H") == 2  # initial frame + one step
 
 
-def test_bootstrap_noop_without_cluster_env(monkeypatch):
-    for var in (
-        "COORDINATOR_ADDRESS",
-        "MEGASCALE_COORDINATOR_ADDRESS",
-        "TPU_WORKER_HOSTNAMES",
-    ):
-        monkeypatch.delenv(var, raising=False)
+def test_bootstrap_noop_without_optin(monkeypatch):
+    monkeypatch.delenv("GOL_MULTIHOST", raising=False)
     bootstrap.initialize()  # must not raise or try to form a cluster
     assert not bootstrap.is_multihost()
 
