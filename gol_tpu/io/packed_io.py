@@ -51,7 +51,18 @@ def read_packed(path: str, width: int, height: int, mesh: Mesh | None = None) ->
     nwords = width // BITS
 
     if mesh is None:
-        return jax.numpy.asarray(native.pack_text(mm, width))
+        # Pack row blocks across a thread pool (the codec releases the GIL).
+        out = np.empty((height, nwords), dtype=np.uint32)
+        chunk = max(1, (128 << 20) // max(row_stride(width), 1))
+        starts = range(0, height, chunk)
+
+        def pack_rows(r0: int) -> None:
+            r1 = min(height, r0 + chunk)
+            out[r0:r1] = native.pack_text(mm[r0:r1], width)
+
+        with concurrent.futures.ThreadPoolExecutor() as pool:
+            list(pool.map(pack_rows, starts))
+        return jax.numpy.asarray(out)
 
     sharding = words_sharding(mesh)
 
@@ -89,9 +100,32 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
         w0, w1, _ = wcols.indices(nwords)
         east_edge = w1 == nwords
         window = mm[r0:r1, w0 * BITS : w1 * BITS + (1 if east_edge else 0)]
-        native.unpack_text(
-            np.ascontiguousarray(shard.data), window, (w1 - w0) * BITS, east_edge
-        )
+        data = shard.data
+        # Device->host transfers stream in ~64 MB pieces, the next piece
+        # prefetched while the codec unpacks the current one.
+        chunk_rows = max(1, (64 << 20) // max(data.shape[1] * 4, 1))
+        starts = list(range(0, r1 - r0, chunk_rows))
+        if not starts:
+            return
+
+        def fetch(s):
+            return np.ascontiguousarray(data[s : s + chunk_rows])
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as prefetch:
+            pending = prefetch.submit(fetch, starts[0])
+            for i, s in enumerate(starts):
+                # Queue the next transfer BEFORE blocking on the current one,
+                # so it proceeds while the codec unpacks this block.
+                nxt = (
+                    prefetch.submit(fetch, starts[i + 1])
+                    if i + 1 < len(starts)
+                    else None
+                )
+                block = pending.result()
+                native.unpack_text(
+                    block, window[s : s + block.shape[0]], (w1 - w0) * BITS, east_edge
+                )
+                pending = nxt
 
     shards = list(words.addressable_shards)
     with concurrent.futures.ThreadPoolExecutor() as pool:
