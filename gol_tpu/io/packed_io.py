@@ -31,6 +31,13 @@ def words_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
 
 
+# Host-side pack granularity (text bytes per codec call) and device->host
+# transfer granularity (packed bytes per fetch). Module-level so tests can
+# shrink them to exercise the chunked paths on small grids.
+_READ_CHUNK_BYTES = 128 << 20
+_WRITE_CHUNK_BYTES = 64 << 20
+
+
 def _check_shape(width: int, mesh: Mesh | None) -> None:
     cols = 1 if mesh is None else mesh.shape[COL_AXIS]
     if width % (BITS * cols) != 0:
@@ -53,7 +60,7 @@ def read_packed(path: str, width: int, height: int, mesh: Mesh | None = None) ->
     if mesh is None:
         # Pack row blocks across a thread pool (the codec releases the GIL).
         out = np.empty((height, nwords), dtype=np.uint32)
-        chunk = max(1, (128 << 20) // max(row_stride(width), 1))
+        chunk = max(1, _READ_CHUNK_BYTES // max(row_stride(width), 1))
         starts = range(0, height, chunk)
 
         def pack_rows(r0: int) -> None:
@@ -101,9 +108,11 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
         east_edge = w1 == nwords
         window = mm[r0:r1, w0 * BITS : w1 * BITS + (1 if east_edge else 0)]
         data = shard.data
-        # Device->host transfers stream in ~64 MB pieces, the next piece
-        # prefetched while the codec unpacks the current one.
-        chunk_rows = max(1, (64 << 20) // max(data.shape[1] * 4, 1))
+        # Device->host transfers stream chunk-by-chunk, the next piece
+        # prefetched while the current one is handed to the codec; unpacking
+        # itself fans out over a worker pool (the chunk windows are disjoint
+        # and the codec releases the GIL).
+        chunk_rows = max(1, _WRITE_CHUNK_BYTES // max(data.shape[1] * 4, 1))
         starts = list(range(0, r1 - r0, chunk_rows))
         if not starts:
             return
@@ -111,21 +120,26 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
         def fetch(s):
             return np.ascontiguousarray(data[s : s + chunk_rows])
 
-        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as prefetch:
+        def unpack(block, s):
+            native.unpack_text(
+                block, window[s : s + block.shape[0]], (w1 - w0) * BITS, east_edge
+            )
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as prefetch, \
+                concurrent.futures.ThreadPoolExecutor() as unpackers:
             pending = prefetch.submit(fetch, starts[0])
+            jobs = []
             for i, s in enumerate(starts):
-                # Queue the next transfer BEFORE blocking on the current one,
-                # so it proceeds while the codec unpacks this block.
+                # Queue the next transfer BEFORE blocking on the current one.
                 nxt = (
                     prefetch.submit(fetch, starts[i + 1])
                     if i + 1 < len(starts)
                     else None
                 )
-                block = pending.result()
-                native.unpack_text(
-                    block, window[s : s + block.shape[0]], (w1 - w0) * BITS, east_edge
-                )
+                jobs.append(unpackers.submit(unpack, pending.result(), s))
                 pending = nxt
+            for job in jobs:
+                job.result()
 
     shards = list(words.addressable_shards)
     with concurrent.futures.ThreadPoolExecutor() as pool:

@@ -90,6 +90,23 @@ def test_packed_file_roundtrip_sharded(tmp_path):
     assert out.read_bytes() == path.read_bytes()
 
 
+def test_packed_file_roundtrip_chunked(tmp_path, monkeypatch):
+    """Force the streaming chunk paths (normally >64/128 MB) on a small grid."""
+    monkeypatch.setattr(packed_io, "_READ_CHUNK_BYTES", 5 * 129)  # ~5 rows/chunk
+    monkeypatch.setattr(packed_io, "_WRITE_CHUNK_BYTES", 3 * 16)  # 3 rows/chunk
+    rng = np.random.default_rng(9)
+    g = rng.integers(0, 2, size=(37, 128), dtype=np.uint8)
+    path = tmp_path / "grid.txt"
+    text_grid.write_grid(str(path), g)
+    words = packed_io.read_packed(str(path), 128, 37)
+    np.testing.assert_array_equal(
+        np.asarray(packed_math.decode(jnp.asarray(words))), g
+    )
+    out = tmp_path / "out.txt"
+    packed_io.write_packed(str(out), words, 128)
+    assert out.read_bytes() == path.read_bytes()
+
+
 def test_packed_io_width_validation(tmp_path):
     with pytest.raises(ValueError, match="divisible by 32"):
         packed_io.read_packed(str(tmp_path / "x"), 48, 16, None)

@@ -1,0 +1,99 @@
+"""Known-pattern and determinism tests (SURVEY.md §4d, §5 race-detection).
+
+The reference's manual race avoidance (odd/even MPI request sets,
+cudaDeviceSynchronize discipline) is replaced by XLA's functional model;
+determinism tests assert the property the reference only hoped for: same
+input -> same output bytes, every time, on every kernel and topology.
+"""
+
+import numpy as np
+import pytest
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import GameConfig
+from gol_tpu.parallel.mesh import make_mesh
+
+BLINKER = np.array([[1, 1, 1]], np.uint8)
+PULSAR_QUADRANT = [
+    "..###",
+    ".....",
+    "#....",
+    "#....",
+    "#....",
+    "..###",
+]
+LWSS = np.array(
+    [
+        [0, 1, 1, 1, 1],
+        [1, 0, 0, 0, 1],
+        [0, 0, 0, 0, 1],
+        [1, 0, 0, 1, 0],
+    ],
+    np.uint8,
+)
+R_PENTOMINO = np.array([[0, 1, 1], [1, 1, 0], [0, 1, 0]], np.uint8)
+
+
+def _place(height, width, pattern, at):
+    g = np.zeros((height, width), np.uint8)
+    r, c = at
+    g[r : r + pattern.shape[0], c : c + pattern.shape[1]] = pattern
+    return g
+
+
+def test_blinker_period_two():
+    g = _place(16, 32, BLINKER, (8, 8))
+    one = oracle.evolve(g)
+    two = oracle.evolve(one)
+    assert not np.array_equal(one, g)
+    np.testing.assert_array_equal(two, g)
+    # Oscillators never trigger the similarity (fixed-point) exit.
+    res = engine.simulate(g, GameConfig(gen_limit=30))
+    assert res.generations == 30
+
+
+def test_lwss_translates():
+    """A lightweight spaceship translates 2 cells every 4 generations."""
+    g = _place(32, 64, LWSS, (12, 30))
+    four = g
+    for _ in range(4):
+        four = oracle.evolve(four)
+    shifted = [np.roll(g, s, axis=a) for a in (0, 1) for s in (2, -2)]
+    assert any(np.array_equal(four, s) for s in shifted)
+    assert four.sum() == g.sum()  # still a 9-cell ship, not debris
+
+
+@pytest.mark.parametrize("kernel", ["lax", "packed"])
+def test_r_pentomino_long_run(kernel):
+    """Chaotic growth for 300 generations, engine vs oracle, torus wrap hit."""
+    g = _place(64, 64, R_PENTOMINO, (30, 30))
+    config = GameConfig(gen_limit=300)
+    expect = oracle.run(g, config)
+    got = engine.simulate(g, config, kernel=kernel)
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
+@pytest.mark.parametrize("kernel", ["lax", "packed"])
+def test_rectangular_grids(kernel):
+    rng = np.random.default_rng(31)
+    g = rng.integers(0, 2, size=(16, 96), dtype=np.uint8)
+    config = GameConfig(gen_limit=50)
+    expect = oracle.run(g, config)
+    got = engine.simulate(g, config, kernel=kernel)
+    np.testing.assert_array_equal(got.grid, expect.grid)
+
+
+@pytest.mark.parametrize(
+    "kernel,mesh_shape", [("lax", None), ("packed", None), ("packed", (2, 4))]
+)
+def test_determinism(kernel, mesh_shape):
+    """Same input -> same output bytes, run twice (SURVEY.md §5)."""
+    mesh = make_mesh(*mesh_shape) if mesh_shape else None
+    rng = np.random.default_rng(37)
+    g = rng.integers(0, 2, size=(32, 128), dtype=np.uint8)
+    config = GameConfig(gen_limit=40)
+    a = engine.simulate(g, config, mesh=mesh, kernel=kernel)
+    b = engine.simulate(g, config, mesh=mesh, kernel=kernel)
+    np.testing.assert_array_equal(a.grid, b.grid)
+    assert a.generations == b.generations
