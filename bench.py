@@ -138,6 +138,20 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--mesh", default=None, help="RxC device mesh (default: single)")
     parser.add_argument("--repeats", type=int, default=3)
     parser.add_argument(
+        "--verify",
+        action="store_true",
+        help="after timing, check the final grid against the NumPy oracle "
+        "(implied by --config 1; slow for large grids)",
+    )
+    parser.add_argument(
+        "--config",
+        type=int,
+        choices=range(1, 6),
+        help="BASELINE.md config preset (overrides size/mesh/gen-limit): "
+        "1=512^2 oracle-checked, 2=4096^2 single chip, 3=8192^2 2x2 mesh, "
+        "4=16384^2 similarity path, 5=65536^2 4x4 mesh 10000 gens",
+    )
+    parser.add_argument(
         "--halo",
         action="store_true",
         help="measure halo-exchange p50 latency (BASELINE.md secondary metric) "
@@ -145,6 +159,30 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
     _honor_platform_env()
+
+    if args.config:
+        # (size, mesh, gen_limit); mesh None = single device. Configs needing
+        # more devices than available fall back to fewer mesh cells loudly.
+        preset = {
+            1: (512, None, 1000),
+            2: (4096, None, 1000),
+            3: (8192, "2x2", 1000),
+            4: (16384, None, 1000),
+            5: (65536, "4x4", 10000),
+        }[args.config]
+        args.size, args.mesh, args.gen_limit = preset
+        import jax
+
+        n = len(jax.devices())
+        if args.mesh:
+            r, c = (int(x) for x in args.mesh.split("x"))
+            if r * c > n:
+                print(
+                    f"config {args.config} wants a {args.mesh} mesh but only "
+                    f"{n} device(s) are attached; running single-device",
+                    file=sys.stderr,
+                )
+                args.mesh = None
 
     if args.halo:
         return _bench_halo(args)
@@ -196,6 +234,19 @@ def main(argv: list[str] | None = None) -> int:
             f"  run {i}: {elapsed * 1000:.1f} ms, {generations} generations",
             file=sys.stderr,
         )
+
+    if args.verify or args.config == 1:
+        from gol_tpu import oracle
+
+        expect = oracle.run(grid, config)
+        final_np = np.asarray(jax.device_get(final), dtype=np.uint8)
+        ok = (
+            np.array_equal(final_np, expect.grid)
+            and generations == expect.generations
+        )
+        print(f"oracle check: {'OK' if ok else 'MISMATCH'}", file=sys.stderr)
+        if not ok:
+            return 1
 
     cell_updates = args.size * args.size * generations
     value = cell_updates / best_s / n_chips
