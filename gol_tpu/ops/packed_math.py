@@ -102,6 +102,47 @@ def evolve_extended(xce: jnp.ndarray) -> jnp.ndarray:
     return rule(uw, uc, ue, mw, me, dw, dc, de, mid=mc)
 
 
+def evolve_ghost(words, top, bot, gwest, geast):
+    """One generation of an (h, nwords) shard from separate ghost operands.
+
+    ``top``/``bot`` are the ghost word rows (1, nwords); ``gwest``/``geast``
+    are the per-extended-row ghost carry words (h+2,), covering rows -1..h so
+    the corner bits ride along (the two-phase trick, src/game_cuda.cu:64-74).
+    Only bit 31 of ``gwest`` and bit 0 of ``geast`` are consumed — they carry
+    exactly the boundary *bit* column the reference moves with its derived
+    column datatype (src/game_mpi.c:335-338), not whole ghost words.
+    """
+    h = words.shape[0]
+    xr = jnp.concatenate([top, words, bot], axis=0)  # (h+2, nwords)
+
+    def band(r):
+        x = xr[r : r + h, :]
+        left = jnp.roll(x, 1, axis=1).at[:, 0].set(gwest[r : r + h])
+        right = jnp.roll(x, -1, axis=1).at[:, -1].set(geast[r : r + h])
+        return west(x, left), x, east(x, right)
+
+    uw, uc, ue = band(0)
+    mw, mc, me = band(1)
+    dw, dc, de = band(2)
+    return rule(uw, uc, ue, mw, me, dw, dc, de, mid=mc)
+
+
+def pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(n,) uint32 {0,1} -> (ceil(n/32),) packed words (bit k%32 of word k/32)."""
+    n = bits.shape[0]
+    pad = (-n) % BITS
+    b = jnp.pad(bits, (0, pad)).reshape(-1, BITS)
+    weights = (jnp.uint32(1) << jnp.arange(BITS, dtype=jnp.uint32))[None, :]
+    return jnp.sum(b * weights, axis=1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Inverse of ``pack_bits``: (nw,) words -> (n,) uint32 {0,1} bits."""
+    shifts = jnp.arange(BITS, dtype=jnp.uint32)[None, :]
+    bits = (words[:, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(-1)[:n]
+
+
 def encode(grid: jnp.ndarray) -> jnp.ndarray:
     """uint8 (H, W) cells -> uint32 (H, W/32) words (bit j = column w*32+j)."""
     height, width = grid.shape

@@ -35,7 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.ops import packed_math
 from gol_tpu.parallel import halo
-from gol_tpu.parallel.mesh import Topology
+from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS, Topology
 
 _BITS = packed_math.BITS
 _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
@@ -155,28 +155,195 @@ def _step(words: jnp.ndarray, interpret: bool = False):
     return new, alive[0, 0] > 0, similar[0, 0] > 0
 
 
-def _distributed_step(words: jnp.ndarray, topology: Topology):
-    """Shard-local packed step under shard_map: word-level ppermute halo.
+def exchange_packed(words: jnp.ndarray, topology: Topology):
+    """Two-phase packed halo: word rows N/S, bit-packed columns E/W.
 
-    The reference exchanges byte rows/columns with 16 persistent requests
-    (src/game_mpi.c:340-383); packed, the same two-phase exchange moves word
-    rows and one ghost word column per side (of which only the adjacent bit
-    feeds the shift carries). The column phase runs over the row-extended
-    block, so corner words ride along exactly as in the byte-level exchange
-    (the src/game_cuda.cu:64-74 trick, one level up).
+    The reference exchanges byte rows plus exact boundary-byte columns via a
+    derived MPI_Type_vector datatype (src/game_mpi.c:335-338). Packed, the
+    N/S rows are already bit-minimal (one word row per side); the E/W
+    exchange sends the boundary *bit column* packed into (h+2)/32 words —
+    32x less traffic than shipping whole ghost word columns. The column
+    phase covers the row-extended range so corner bits ride along (the
+    src/game_cuda.cu:64-74 trick).
+
+    Returns ``(top, bot, gwest, geast)``: ghost word rows (1, nwords) and
+    per-extended-row carry words (h+2,) with the neighbor bit pre-positioned
+    at bit 31 (west) / bit 0 (east) for direct use by the shift carries.
     """
-    xce = halo.exchange(words, topology)  # (h+2, nwords+2) ghost-extended words
-    new = packed_math.evolve_extended(xce)
-    alive = jnp.any(new != 0)
-    similar = jnp.all(new == words)
-    return new, alive, similar
+    h, _ = words.shape
+    rows, _cols = topology.shape
+    row_axis = ROW_AXIS if topology.distributed else None
+    top, bot = halo.ghost_slices(words, 0, row_axis, rows)
+    # Boundary bit columns over the row-extended block (h+2 bits each).
+    west_col, east_col = halo.boundary_columns(words, top, bot)
+    gwest_bits, geast_bits = halo.exchange_columns(
+        west_col & jnp.uint32(1),
+        east_col >> jnp.uint32(_BITS - 1),
+        topology,
+        transform=(
+            packed_math.pack_bits,
+            lambda w: packed_math.unpack_bits(w, h + 2),
+        ),
+    )
+    return top, bot, gwest_bits << jnp.uint32(_BITS - 1), geast_bits
+
+
+def _dist_band_kernel(
+    main_ref,
+    top_ref,
+    bot_ref,
+    gtop_ref,
+    gbot_ref,
+    gup_ref,
+    gmid_ref,
+    gdown_ref,
+    out_ref,
+    alive_ref,
+    similar_ref,
+    *,
+    band: int,
+    nbands: int,
+):
+    """Band kernel for one mesh shard: ghost rows/carries arrive as operands.
+
+    Same VMEM-banded adder network as ``_band_kernel``, but the torus wrap at
+    the shard edges comes from the ppermute'd ghosts instead of modular block
+    indexing — the Pallas analog of the reference running its hand-written
+    kernels in every MPI variant (src/game_mpi.c:73-84).
+    """
+    i = pl.program_id(0)
+    mid = main_ref[:]
+    nwords = mid.shape[1]
+    r8 = jax.lax.broadcasted_iota(jnp.int32, (8, nwords), 0)
+
+    def _extract(block_ref, row_index):
+        block = jax.lax.bitcast_convert_type(block_ref[:], jnp.int32)
+        row = jnp.sum(jnp.where(r8 == row_index, block, 0), axis=0, keepdims=True)
+        return jax.lax.bitcast_convert_type(row, jnp.uint32)
+
+    # Interior bands take their wrap rows from the adjacent 8-row blocks; the
+    # first/last band take the shard's ppermute'd ghost rows instead.
+    top_row = jnp.where(i == 0, _extract(gtop_ref, 7), _extract(top_ref, 7))
+    bot_row = jnp.where(i == nbands - 1, _extract(gbot_ref, 0), _extract(bot_ref, 0))
+    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
+    up = jnp.where(
+        rows == 0, jnp.broadcast_to(top_row, mid.shape), pltpu.roll(mid, 1, 0)
+    )
+    down = jnp.where(
+        rows == band - 1,
+        jnp.broadcast_to(bot_row, mid.shape),
+        pltpu.roll(mid, band - 1, 0),
+    )
+
+    lanes = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 1)
+
+    def _carries(x, g_ref):
+        # g_ref rows align with x's rows; lane 0 = ghost west carry (bit 31),
+        # lane 1 = ghost east carry (bit 0). The word rolled in across the
+        # shard seam is replaced by the neighbor's carry word.
+        gw = jnp.broadcast_to(g_ref[:, 0:1], x.shape)
+        ge = jnp.broadcast_to(g_ref[:, 1:2], x.shape)
+        left = jnp.where(lanes == 0, gw, pltpu.roll(x, 1 % nwords, 1))
+        right = jnp.where(
+            lanes == nwords - 1, ge, pltpu.roll(x, (nwords - 1) % nwords, 1)
+        )
+        return packed_math.west(x, left), packed_math.east(x, right)
+
+    uw, ue = _carries(up, gup_ref)
+    mw, me = _carries(mid, gmid_ref)
+    dw, de = _carries(down, gdown_ref)
+    new = packed_math.rule(uw, up, ue, mw, me, dw, down, de, mid=mid)
+    out_ref[:] = new
+
+    alive = jnp.max(jnp.where(new != 0, 1, 0))
+    similar = 1 - jnp.max(jnp.where((new ^ mid) != 0, 1, 0))
+
+    @pl.when(i == 0)
+    def _init():
+        alive_ref[0, 0] = alive
+        similar_ref[0, 0] = similar
+
+    @pl.when(i > 0)
+    def _accumulate():
+        alive_ref[0, 0] = alive_ref[0, 0] | alive
+        similar_ref[0, 0] = similar_ref[0, 0] & similar
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _dist_step_pallas(words, gtop8, gbot8, gup, gmid, gdown, interpret=False):
+    height, nwords = words.shape
+    band = _pick_band(height, nwords)
+    bb = band // _SUBLANES
+    nb = height // _SUBLANES
+    nbands = height // band
+    new, alive, similar = pl.pallas_call(
+        functools.partial(_dist_band_kernel, band=band, nbands=nbands),
+        grid=(nbands,),
+        in_specs=[
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (_SUBLANES, nwords),
+                lambda i: ((i * bb - 1) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_SUBLANES, nwords),
+                lambda i: ((i * bb + bb) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((height, nwords), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(words, words, words, gtop8, gbot8, gup, gmid, gdown)
+    return new, alive[0, 0] > 0, similar[0, 0] > 0
+
+
+def _distributed_step(words: jnp.ndarray, topology: Topology):
+    """Shard-local packed step under shard_map.
+
+    The halo is the two-phase ppermute exchange (word rows N/S, bit columns
+    E/W); the stencil is the compiled Pallas band kernel whenever the shard
+    height tiles (h % 8 == 0), with the jnp adder network as the fallback for
+    odd shard heights. Either way the hot loop under a mesh runs the same
+    carry-save network as the single-device path.
+    """
+    h, _nwords = words.shape
+    top, bot, gwest, geast = exchange_packed(words, topology)
+    if h % _SUBLANES == 0:
+        gtop8, gbot8, gup, gmid, gdown = halo.assemble_band_ghosts(
+            top, bot, gwest, geast
+        )
+        interpret = jax.default_backend() != "tpu"
+        return _dist_step_pallas(
+            words, gtop8, gbot8, gup, gmid, gdown, interpret=interpret
+        )
+    new = packed_math.evolve_ghost(words, top, bot, gwest, geast)
+    return new, jnp.any(new != 0), jnp.all(new == words)
 
 
 def packed_step(cur: jnp.ndarray, topology: Topology):
     """Fused generation step on packed state: ``words -> (words, alive, similar)``.
 
-    Single device: the compiled Pallas band kernel. Distributed: the jnp
-    adder network around a word-level ppermute halo exchange.
+    Single device: the compiled Pallas band kernel. Distributed: the same
+    band kernel fed ppermute'd ghost rows and bit-column carries (jnp adder
+    network only for odd shard heights).
     """
     height, nwords = cur.shape
     if not supports(height, nwords * _BITS, topology):

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from gol_tpu.parallel.mesh import Topology
+from gol_tpu.parallel import halo
+from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS, Topology
 
 # Lane width of the VPU; widths must align for the lane-roll column wrap.
 _LANES = 128
@@ -45,10 +46,13 @@ _BAND_BYTES = 512 << 10
 
 
 def supports(height: int, width: int, topology: Topology) -> bool:
-    """Shapes the compiled kernel handles; anything else falls back to lax."""
+    """Shapes the compiled kernel handles; anything else falls back to lax.
+
+    ``height``/``width`` are the LOCAL shard shape under a mesh — the
+    distributed path runs the same band kernel fed ppermute'd ghosts.
+    """
     return (
-        not topology.distributed
-        and width % _LANES == 0
+        width % _LANES == 0
         and height % _SUBLANES == 0
         and height >= _SUBLANES
     )
@@ -168,19 +172,169 @@ def _step(grid: jnp.ndarray, interpret: bool = False):
     return new, alive[0, 0] > 0, similar[0, 0] > 0
 
 
+def _dist_band_kernel(
+    main_ref,
+    top_ref,
+    bot_ref,
+    gtop_ref,
+    gbot_ref,
+    gup_ref,
+    gmid_ref,
+    gdown_ref,
+    out_ref,
+    alive_ref,
+    similar_ref,
+    *,
+    band: int,
+    nbands: int,
+):
+    """Band kernel for one mesh shard: ghost rows/columns arrive as operands.
+
+    The same VMEM band stencil as ``_band_kernel``, with the torus wrap at
+    shard edges taken from the ppermute'd ghosts — the reference runs its
+    hand-written evolve in every MPI variant the same way
+    (src/game_mpi.c:73-84 over ghost cells).
+    """
+    i = pl.program_id(0)
+    mid = main_ref[:].astype(jnp.int32)
+    width = mid.shape[1]
+    r8 = jax.lax.broadcasted_iota(jnp.int32, (8, width), 0)
+
+    def _extract(block_ref, row_index):
+        return jnp.max(
+            jnp.where(r8 == row_index, block_ref[:].astype(jnp.int32), 0),
+            axis=0,
+            keepdims=True,
+        )
+
+    top_row = jnp.where(i == 0, _extract(gtop_ref, 7), _extract(top_ref, 7))
+    bot_row = jnp.where(i == nbands - 1, _extract(gbot_ref, 0), _extract(bot_ref, 0))
+    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
+    up = jnp.where(
+        rows == 0, jnp.broadcast_to(top_row, mid.shape), pltpu.roll(mid, 1, 0)
+    )
+    down = jnp.where(
+        rows == band - 1,
+        jnp.broadcast_to(bot_row, mid.shape),
+        pltpu.roll(mid, band - 1, 0),
+    )
+
+    lanes = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 1)
+
+    def _west_east(x, g_ref):
+        # g_ref rows align with x's rows; lane 0 = ghost west byte, lane 1 =
+        # ghost east byte. The lane rolled in across the shard seam is
+        # replaced by the neighbor's boundary column.
+        g = g_ref[:].astype(jnp.int32)
+        gw = jnp.broadcast_to(g[:, 0:1], x.shape)
+        ge = jnp.broadcast_to(g[:, 1:2], x.shape)
+        w = jnp.where(lanes == 0, gw, _roll(x, 1))
+        e = jnp.where(lanes == width - 1, ge, _roll(x, -1))
+        return w, e
+
+    uw, ue = _west_east(up, gup_ref)
+    mw, me = _west_east(mid, gmid_ref)
+    dw, de = _west_east(down, gdown_ref)
+    counts = up + uw + ue + mw + me + down + dw + de
+    new = jnp.where((counts == 3) | ((counts == 2) & (mid == 1)), 1, 0)
+    out_ref[:] = new.astype(jnp.uint8)
+
+    alive = (jnp.max(new) > 0).astype(jnp.int32)
+    similar = (jnp.max(jnp.abs(new - mid)) == 0).astype(jnp.int32)
+
+    @pl.when(i == 0)
+    def _init():
+        alive_ref[0, 0] = alive
+        similar_ref[0, 0] = similar
+
+    @pl.when(i > 0)
+    def _accumulate():
+        alive_ref[0, 0] = alive_ref[0, 0] | alive
+        similar_ref[0, 0] = similar_ref[0, 0] & similar
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _dist_step(grid, gtop8, gbot8, gup, gmid, gdown, interpret=False):
+    height, width = grid.shape
+    band = _pick_band(height, width)
+    bb = band // _SUBLANES
+    nb = height // _SUBLANES
+    nbands = height // band
+    new, alive, similar = pl.pallas_call(
+        functools.partial(_dist_band_kernel, band=band, nbands=nbands),
+        grid=(nbands,),
+        in_specs=[
+            pl.BlockSpec((band, width), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (_SUBLANES, width),
+                lambda i: ((i * bb - 1) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_SUBLANES, width),
+                lambda i: ((i * bb + bb) % nb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((_SUBLANES, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, width), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((height, width), jnp.uint8),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(grid, grid, grid, gtop8, gbot8, gup, gmid, gdown)
+    return new, alive[0, 0] > 0, similar[0, 0] > 0
+
+
+def _distributed_step(cur: jnp.ndarray, topology: Topology):
+    """Shard-local byte step: ppermute ghost rows + exact boundary columns.
+
+    N/S ghosts are whole rows; E/W ghosts are the boundary *byte columns*
+    over the row-extended range (corners ride along, src/game_cuda.cu:64-74)
+    — exactly the bytes the reference's derived column datatype moves
+    (src/game_mpi.c:335-338).
+    """
+    rows, _cols = topology.shape
+    row_axis = ROW_AXIS if topology.distributed else None
+    top, bot = halo.ghost_slices(cur, 0, row_axis, rows)
+    west_col, east_col = halo.boundary_columns(cur, top, bot)
+    gwest, geast = halo.exchange_columns(west_col, east_col, topology)
+    gtop8, gbot8, gup, gmid, gdown = halo.assemble_band_ghosts(
+        top, bot, gwest, geast
+    )
+    interpret = jax.default_backend() != "tpu"
+    return _dist_step(cur, gtop8, gbot8, gup, gmid, gdown, interpret=interpret)
+
+
 def pallas_step(cur: jnp.ndarray, topology: Topology):
     """Fused generation step: ``cur -> (new, any_alive, similar)``.
 
     The flags are this kernel's fusion of the reference's evolve + empty +
     compare kernels (src/game_cuda.cu:76-148) into a single memory pass.
+    Under a mesh the same band kernel runs per shard, fed ppermute'd ghosts.
     """
     height, width = cur.shape
     if not supports(height, width, topology):
         raise ValueError(
-            f"the pallas kernel requires a single-device grid with height a "
-            f"multiple of {_SUBLANES} and width a multiple of {_LANES}; got "
+            f"the pallas kernel requires a (local shard) height a multiple of "
+            f"{_SUBLANES} and width a multiple of {_LANES}; got "
             f"{height}x{width} on {topology.shape[0]}x{topology.shape[1]} "
             f"devices — use kernel='lax' (or 'auto') instead"
         )
+    if topology.distributed:
+        return _distributed_step(cur, topology)
     interpret = jax.default_backend() != "tpu"
     return _step(cur, interpret=interpret)

@@ -29,26 +29,83 @@ import jax.numpy as jnp
 from gol_tpu.parallel.mesh import Topology, ROW_AXIS, COL_AXIS
 
 
-def _ring_perms(size: int) -> tuple[list, list]:
+def ring_perms(size: int) -> tuple[list, list]:
     forward = [(i, (i + 1) % size) for i in range(size)]
     backward = [(i, (i - 1) % size) for i in range(size)]
     return forward, backward
 
 
-def _extend(x: jnp.ndarray, axis: int, axis_name: str | None, size: int) -> jnp.ndarray:
-    """Add the two ghost slices along ``axis`` (torus wrap across shards)."""
+def ghost_slices(
+    x: jnp.ndarray, axis: int, axis_name: str | None, size: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The two 1-wide ghost slices along ``axis`` (torus wrap across shards)."""
     first = jax.lax.slice_in_dim(x, 0, 1, axis=axis)
     last = jax.lax.slice_in_dim(x, x.shape[axis] - 1, x.shape[axis], axis=axis)
     if axis_name is None or size == 1:
         # Wrap is local: my own far edge is my ghost (src/game_cuda.cu:52-74).
-        ghost_before, ghost_after = last, first
-    else:
-        forward, backward = _ring_perms(size)
-        # Sending my last slice "forward" delivers my predecessor's last slice
-        # to me: the ghost before my first row/col.
-        ghost_before = jax.lax.ppermute(last, axis_name, forward)
-        ghost_after = jax.lax.ppermute(first, axis_name, backward)
+        return last, first
+    forward, backward = ring_perms(size)
+    # Sending my last slice "forward" delivers my predecessor's last slice
+    # to me: the ghost before my first row/col.
+    ghost_before = jax.lax.ppermute(last, axis_name, forward)
+    ghost_after = jax.lax.ppermute(first, axis_name, backward)
+    return ghost_before, ghost_after
+
+
+def _extend(x: jnp.ndarray, axis: int, axis_name: str | None, size: int) -> jnp.ndarray:
+    """Add the two ghost slices along ``axis`` (torus wrap across shards)."""
+    ghost_before, ghost_after = ghost_slices(x, axis, axis_name, size)
     return jnp.concatenate([ghost_before, x, ghost_after], axis=axis)
+
+
+def boundary_columns(x: jnp.ndarray, top: jnp.ndarray, bot: jnp.ndarray):
+    """West/east boundary columns over the row-extended range (h+2 each).
+
+    Built after the row phase so the ghost rows' corner cells ride along in
+    the column exchange (the src/game_cuda.cu:64-74 two-phase trick).
+    """
+    west = jnp.concatenate([top[:, 0], x[:, 0], bot[:, 0]])
+    east = jnp.concatenate([top[:, -1], x[:, -1], bot[:, -1]])
+    return west, east
+
+
+def exchange_columns(west_col, east_col, topology: Topology, transform=None):
+    """Column-phase exchange: returns the (ghost_west, ghost_east) columns.
+
+    ``transform=(pack, unpack)`` optionally compresses the wire format (the
+    packed path ships bit columns, 32x smaller than its word columns — the
+    exact-boundary analog of the reference's derived column datatype,
+    src/game_mpi.c:335-338).
+    """
+    cols = topology.shape[1]
+    if not (topology.distributed and cols > 1):
+        # Torus wrap is local: my own far edge is my ghost.
+        return east_col, west_col
+    pack, unpack = transform if transform is not None else (lambda v: v, lambda v: v)
+    forward, backward = ring_perms(cols)
+    ghost_west = unpack(jax.lax.ppermute(pack(east_col), COL_AXIS, forward))
+    ghost_east = unpack(jax.lax.ppermute(pack(west_col), COL_AXIS, backward))
+    return ghost_west, ghost_east
+
+
+def assemble_band_ghosts(top, bot, gwest, geast):
+    """Ghost operand set for a per-shard band kernel.
+
+    Returns ``(gtop8, gbot8, gup, gmid, gdown)``: the ghost rows embedded in
+    8-row-aligned blocks (the 32-bit sublane granule — ghost above in row 7,
+    ghost below in row 0), and the per-row (west, east) carry columns for the
+    up/mid/down shifted arrays. ``gwest``/``geast`` cover extended rows -1..h,
+    so shard row q's up-row carries sit at index q, mid at q+1, down at q+2 —
+    the subtle alignment both band kernels share.
+    """
+    h = gwest.shape[0] - 2
+    zeros7 = jnp.zeros((7, top.shape[1]), top.dtype)
+    gtop8 = jnp.concatenate([zeros7, top], axis=0)
+    gbot8 = jnp.concatenate([bot, zeros7], axis=0)
+    gup = jnp.stack([gwest[0:h], geast[0:h]], axis=1)
+    gmid = jnp.stack([gwest[1 : h + 1], geast[1 : h + 1]], axis=1)
+    gdown = jnp.stack([gwest[2 : h + 2], geast[2 : h + 2]], axis=1)
+    return gtop8, gbot8, gup, gmid, gdown
 
 
 def exchange(local: jnp.ndarray, topology: Topology) -> jnp.ndarray:

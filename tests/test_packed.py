@@ -109,6 +109,76 @@ def test_distributed_packed_matches_oracle():
     assert got.generations == expect.generations
 
 
+def test_dist_kernel_local_wrap_matches_oracle():
+    """The distributed band kernel with local-wrap ghosts == the torus.
+
+    On CPU this runs interpret mode; on TPU it validates the Mosaic-compiled
+    distributed kernel on one chip (the ghosts of a 1-shard torus are the
+    local edge wraps, src/game_cuda.cu:52-74).
+    """
+    rng = np.random.default_rng(21)
+    for shape in [(64, 256), (16, 32), (24, 96)]:
+        g = rng.integers(0, 2, size=shape, dtype=np.uint8)
+        new, alive, similar = sp._distributed_step(
+            sp.encode(jnp.asarray(g)), SINGLE_DEVICE
+        )
+        expect = oracle.evolve(g)
+        np.testing.assert_array_equal(np.asarray(sp.decode(new)), expect)
+        assert bool(alive) == bool(expect.any())
+        assert bool(similar) == bool(np.array_equal(expect, g))
+
+
+def test_distributed_packed_runs_pallas_kernel(monkeypatch):
+    """The mesh path's hot loop is the Pallas band kernel, not the jnp net."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    calls = []
+    real = sp._dist_step_pallas
+
+    def spy(*args, **kwargs):
+        calls.append(args[0].shape)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(sp, "_dist_step_pallas", spy)
+    engine.make_runner.cache_clear()
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+    got = engine.simulate(g, GameConfig(gen_limit=5), mesh=mesh, kernel="packed")
+    expect = oracle.run(g, GameConfig(gen_limit=5))
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert calls and calls[0] == (32, 2)  # 32-row, 2-word local shard
+    engine.make_runner.cache_clear()
+
+
+def test_distributed_packed_odd_height_falls_back_to_jnp():
+    """Shard heights that don't tile (h % 8 != 0) use the jnp ghost path."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 2)
+    rng = np.random.default_rng(9)
+    g = rng.integers(0, 2, size=(12, 64), dtype=np.uint8)  # 6-row shards
+    config = GameConfig(gen_limit=30)
+    expect = oracle.run(g, config)
+    got = engine.simulate(g, config, mesh=mesh, kernel="packed")
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
+def test_distributed_packed_single_word_shards():
+    """One uint32 word per shard row: both carries come from ghost bits."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(13)
+    g = rng.integers(0, 2, size=(16, 128), dtype=np.uint8)  # 8x32 shards
+    config = GameConfig(gen_limit=30)
+    expect = oracle.run(g, config)
+    got = engine.simulate(g, config, mesh=mesh, kernel="packed")
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
 def test_distributed_packed_glider_crosses_shard_and_word_seams():
     from gol_tpu.parallel.mesh import make_mesh
 
