@@ -122,6 +122,97 @@ def _bench_halo(args) -> int:
     return 0
 
 
+def _bench_compare(args) -> int:
+    """Kernel-only throughput table: every single-chip evolve path.
+
+    Quantifies the cost gap between the compiled Pallas band kernels, the
+    distributed-style kernel (ghost operands, local wrap — the per-chip proxy
+    for pod throughput), and the jnp fallbacks, at a fixed generation count
+    with no termination machinery (the reference's pure-evolve cost,
+    src/game_cuda.cu:234-236).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import packed_math, stencil_lax
+    from gol_tpu.ops import stencil_packed as sp
+    from gol_tpu.ops import stencil_pallas as spl
+    from gol_tpu.parallel.mesh import SINGLE_DEVICE
+
+    size = args.size
+    # Marginal measurement: the tunnel adds ~80ms fixed dispatch per compiled
+    # call, so each path is timed at g1 and 3*g1 generations and the rate is
+    # taken from the difference.
+    g1 = min(args.gen_limit, 500)
+    g2 = 3 * g1
+    rng = np.random.default_rng(42)
+    grid = rng.integers(0, 2, size=(size, size), dtype=np.uint8)
+    on_tpu = jax.default_backend() == "tpu"
+
+    def loop(step, gens):
+        def run(state):
+            final = jax.lax.fori_loop(0, gens, lambda i, s: step(s), state)
+            # Return a scalar tied to the final state: over the axon tunnel,
+            # block_until_ready on a device array can return before the work
+            # completes — fetching a scalar is the reliable sync.
+            return final[0, 0]
+
+        return jax.jit(run)
+
+    paths = {
+        "packed-jnp": (packed_math.evolve_torus_words, "words"),
+        "packed-dist-kernel": (
+            lambda w: sp._distributed_step(w, SINGLE_DEVICE)[0],
+            "words",
+        ),
+        "lax": (stencil_lax.evolve_torus, "grid"),
+    }
+    if on_tpu:
+        paths["packed-pallas"] = (lambda w: sp._step(w)[0], "words")
+        paths["pallas-byte"] = (lambda g: spl._step(g)[0], "grid")
+
+    device_grid = jnp.asarray(grid)
+    device_words = jax.jit(sp.encode)(device_grid)
+    device_words.block_until_ready()
+
+    results = {}
+    for name, (step, rep) in sorted(paths.items()):
+        state0 = device_words if rep == "words" else device_grid
+        best = {}
+        for gens in (g1, g2):
+            run = loop(step, gens)
+            int(run(state0))  # compile + warm
+            best[gens] = float("inf")
+            for _ in range(args.repeats):
+                t0 = time.perf_counter()
+                int(run(state0))
+                best[gens] = min(best[gens], time.perf_counter() - t0)
+        marginal_s = max(best[g2] - best[g1], 1e-9) / (g2 - g1)
+        rate = size * size / marginal_s
+        results[name] = rate
+        print(
+            f"  {name:20s} {marginal_s * 1e3:8.3f} ms/gen  {rate:.3e} cells/s",
+            file=sys.stderr,
+        )
+
+    fast = results.get("packed-pallas") or results["packed-dist-kernel"]
+    speedup = fast / results["packed-jnp"]
+    print(
+        json.dumps(
+            {
+                "metric": "packed_pallas_vs_jnp_speedup",
+                "value": speedup,
+                "unit": "x",
+                "vs_baseline": None,
+                "detail": {k: v for k, v in sorted(results.items())},
+                "size": size,
+                "generations": [g1, g2],
+            }
+        )
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -157,6 +248,12 @@ def main(argv: list[str] | None = None) -> int:
         help="measure halo-exchange p50 latency (BASELINE.md secondary metric) "
         "instead of cell throughput; needs a >1-device mesh",
     )
+    parser.add_argument(
+        "--compare",
+        action="store_true",
+        help="kernel-only table: every single-chip evolve path at --size "
+        "(Pallas band kernels vs jnp fallbacks vs lax)",
+    )
     args = parser.parse_args(argv)
     _honor_platform_env()
 
@@ -183,6 +280,14 @@ def main(argv: list[str] | None = None) -> int:
                     file=sys.stderr,
                 )
                 args.mesh = None
+
+    if args.compare:
+        # After --config unpacking so presets apply to the table too.
+        if args.size % 32 != 0:
+            print(f"--compare needs --size divisible by 32 (the packed word "
+                  f"width), got {args.size}", file=sys.stderr)
+            return 1
+        return _bench_compare(args)
 
     if args.halo:
         return _bench_halo(args)

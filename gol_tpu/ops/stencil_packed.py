@@ -35,7 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.ops import packed_math
 from gol_tpu.parallel import halo
-from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS, Topology
+from gol_tpu.parallel.mesh import ROW_AXIS, Topology
 
 _BITS = packed_math.BITS
 _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8

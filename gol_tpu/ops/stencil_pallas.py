@@ -32,7 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.parallel import halo
-from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS, Topology
+from gol_tpu.parallel.mesh import ROW_AXIS, Topology
 
 # Lane width of the VPU; widths must align for the lane-roll column wrap.
 _LANES = 128
