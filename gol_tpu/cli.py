@@ -139,8 +139,6 @@ def _run(args) -> int:
     validate_grid(height, width, topology_for(mesh))
 
     if args.packed_io:
-        if args.snapshot_every:
-            raise ValueError("--packed-io and --snapshot-every are not combinable yet")
         if args.kernel not in ("auto", "packed"):
             raise ValueError(
                 f"--packed-io always runs the packed kernel; --kernel "
@@ -213,15 +211,22 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
     if variant.io_timings:
         print(f"Reading file:\t{read_ms:.2f} msecs")
 
-    runner = engine.make_packed_runner((height, width), config, mesh)
-    compiled = runner.lower(words).compile()
-    if args.warmup:
-        _, g0 = compiled(words)
-        int(g0)
+    if args.snapshot_every:
+        run_fn = _prepare_packed_segmented(args, config, mesh, words, height, width)
+    else:
+        runner = engine.make_packed_runner((height, width), config, mesh)
+        compiled = runner.lower(words).compile()
+        if args.warmup:
+            _, g0 = compiled(words)
+            int(g0)
+
+        def run_fn():
+            final, gen = compiled(words)
+            return final, int(gen)
+
     with _profile_trace(args.profile):
         t0 = time.perf_counter()
-        final, gen = compiled(words)
-        generations = int(gen)
+        final, generations = run_fn()
         exec_ms = (time.perf_counter() - t0) * 1000
 
     return _report_and_write(
@@ -229,6 +234,25 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
         generations,
         exec_ms,
         lambda: packed_io.write_packed(output_path, final, width),
+    )
+
+
+def _prepare_packed_segmented(args, config, mesh, words, height, width):
+    """Snapshotting loop over word state: every snapshot is written through
+    the packed codec and is itself a valid (packed-readable) input file —
+    the reference's resume property at packed-lane scale."""
+    from gol_tpu.io import packed_io
+
+    runner = engine.make_packed_segment_runner((height, width), config, mesh)
+    return _snapshot_loop(
+        args,
+        config,
+        runner,
+        words,
+        lambda: engine.simulate_packed_segments(
+            words, (height, width), config, mesh, args.snapshot_every
+        ),
+        lambda path, state: packed_io.write_packed(path, state, width),
     )
 
 
@@ -244,8 +268,8 @@ def _profile_trace(profile_dir: str | None):
     return jax.profiler.trace(profile_dir)
 
 
-def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
-    """Build the snapshotting loop with compile and init outside the timer.
+def _snapshot_loop(args, config, runner, state0, segments, write_snapshot):
+    """Shared snapshotting driver: compile and init outside the timer.
 
     A zero-step segment call compiles the program and uploads it to the
     device (the --warmup treatment, done unconditionally here so segmented
@@ -259,25 +283,36 @@ def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
 
     import jax.numpy as jnp
 
-    runner = engine.make_segment_runner((height, width), config, mesh, args.kernel)
     gen0 = engine._GEN_START[config.convention]
-    _, g, _, _ = runner(device_grid, jnp.int32(gen0), jnp.int32(0), jnp.int32(0))
+    _, g, _, _ = runner(state0, jnp.int32(gen0), jnp.int32(0), jnp.int32(0))
     int(g)  # zero-step call: compile + program upload, no simulation
 
     outdir = args.snapshot_dir or "./snapshots"
     os.makedirs(outdir, exist_ok=True)
 
     def run_fn():
-        final, generations = device_grid, 0
-        for generations, final, _stopped in engine.simulate_segments(
-            device_grid, config, mesh, args.kernel, args.snapshot_every
-        ):
-            _write_phase(
-                variant, os.path.join(outdir, f"gen_{generations:06d}.out"), final
+        final, generations = state0, 0
+        for generations, final, _stopped in segments():
+            write_snapshot(
+                os.path.join(outdir, f"gen_{generations:06d}.out"), final
             )
         return final, generations
 
     return run_fn
+
+
+def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
+    runner = engine.make_segment_runner((height, width), config, mesh, args.kernel)
+    return _snapshot_loop(
+        args,
+        config,
+        runner,
+        device_grid,
+        lambda: engine.simulate_segments(
+            device_grid, config, mesh, args.kernel, args.snapshot_every
+        ),
+        lambda path, state: _write_phase(variant, path, state),
+    )
 
 
 def _run_host(args, variant, config, width, height, output_path) -> int:

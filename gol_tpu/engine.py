@@ -167,6 +167,81 @@ _GEN_START = {Convention.C: 1, Convention.CUDA: 0}
 _REPORT = {Convention.C: lambda gen: gen - 1, Convention.CUDA: lambda gen: gen}
 
 
+def _build_runner(
+    shape: tuple[int, int],
+    config: GameConfig,
+    mesh: Mesh | None,
+    kernel: str,
+    *,
+    segmented: bool,
+    packed_state: bool,
+):
+    """Shared scaffold of the four runner factories: topology/kernel
+    validation, the simulate wrapper, and the shard_map lowering.
+
+    ``packed_state`` runners take/return the (height, width/32) uint32 word
+    array and never touch the uint8 grid; otherwise kernels with their own
+    carried representation convert once at the loop boundary. ``segmented``
+    runners take/return the resume scalars for snapshotting drivers.
+    """
+    topology = topology_for(mesh)
+    local_h, local_w = validate_grid(shape[0], shape[1], topology)
+    kernel_obj = resolve_kernel("packed" if packed_state else kernel,
+                                local_h, local_w, topology)
+    if not kernel_obj.supports(local_h, local_w, topology):
+        raise ValueError(
+            f"kernel {kernel_obj.name!r} does not support a {local_h}x{local_w} "
+            f"local shard on a {topology.shape[0]}x{topology.shape[1]} topology; "
+            f"use kernel='auto' to fall back automatically"
+        )
+    simulate = _SIMULATORS[config.convention]
+    report = _REPORT[config.convention]
+    encode = None if packed_state else kernel_obj.encode
+    decode = None if packed_state else kernel_obj.decode
+
+    if segmented:
+
+        def local_fn(g, gen0, counter0, seg_end):
+            if encode is not None:
+                g = encode(g)
+            final, gen, counter, stopped = simulate(
+                g, config, topology, kernel_obj, resume=(gen0, counter0, seg_end)
+            )
+            if decode is not None:
+                final = decode(final)
+            return final, gen, counter, stopped
+
+        in_specs = (P(*topology.axes), P(), P(), P())
+        out_specs = (P(*topology.axes), P(), P(), P())
+    else:
+
+        def local_fn(g):
+            if encode is not None:
+                g = encode(g)
+            final, gen, _, _ = simulate(g, config, topology, kernel_obj)
+            if decode is not None:
+                final = decode(final)
+            return final, report(gen)
+
+        in_specs = P(*topology.axes)
+        out_specs = (P(*topology.axes), P())
+
+    if topology.distributed:
+        fn = jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            # vma tracking does not yet thread through pallas_call kernel
+            # constants, so the check is off for the Pallas-bearing kernels
+            # (the JAX-documented workaround) but kept for the lax path.
+            check_vma=kernel_obj.name == "lax",
+        )
+    else:
+        fn = local_fn
+    return jax.jit(fn)
+
+
 @functools.lru_cache(maxsize=64)
 def make_runner(
     shape: tuple[int, int],
@@ -180,44 +255,8 @@ def make_runner(
     topology/bootstrap step the reference does with MPI_Init + MPI_Cart_create
     (src/game_mpi_collective.c:116-133) happens here, at trace time.
     """
-    topology = topology_for(mesh)
-    local_h, local_w = validate_grid(shape[0], shape[1], topology)
-    kernel_obj = resolve_kernel(kernel, local_h, local_w, topology)
-    if not kernel_obj.supports(local_h, local_w, topology):
-        raise ValueError(
-            f"kernel {kernel_obj.name!r} does not support a {local_h}x{local_w} "
-            f"local shard on a {topology.shape[0]}x{topology.shape[1]} topology; "
-            f"use kernel='auto' to fall back automatically"
-        )
-    simulate = _SIMULATORS[config.convention]
-
-    report = _REPORT[config.convention]
-
-    def local_fn(g):
-        # Kernels with their own carried representation (the bitpacked path)
-        # convert once at the loop boundary; the generation loop never touches
-        # the canonical uint8 grid.
-        if kernel_obj.encode is not None:
-            g = kernel_obj.encode(g)
-        final, gen, _, _ = simulate(g, config, topology, kernel_obj)
-        if kernel_obj.decode is not None:
-            final = kernel_obj.decode(final)
-        return final, report(gen)
-
-    if topology.distributed:
-        fn = jax.shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=P(*topology.axes),
-            out_specs=(P(*topology.axes), P()),
-            # vma tracking does not yet thread through pallas_call kernel
-            # constants, so the check is off for the Pallas-bearing kernels
-            # (the JAX-documented workaround) but kept for the lax path.
-            check_vma=kernel_obj.name == "lax",
-        )
-    else:
-        fn = local_fn
-    return jax.jit(fn)
+    return _build_runner(shape, config, mesh, kernel,
+                         segmented=False, packed_state=False)
 
 
 @functools.lru_cache(maxsize=64)
@@ -236,37 +275,8 @@ def make_segment_runner(
     checkpoint/resume: its only resume path is that the output file is a
     valid input file).
     """
-    topology = topology_for(mesh)
-    local_h, local_w = validate_grid(shape[0], shape[1], topology)
-    kernel_obj = resolve_kernel(kernel, local_h, local_w, topology)
-    if not kernel_obj.supports(local_h, local_w, topology):
-        raise ValueError(
-            f"kernel {kernel_obj.name!r} does not support a {local_h}x{local_w} "
-            f"local shard on a {topology.shape[0]}x{topology.shape[1]} topology"
-        )
-    simulate = _SIMULATORS[config.convention]
-
-    def local_fn(g, gen0, counter0, seg_end):
-        if kernel_obj.encode is not None:
-            g = kernel_obj.encode(g)
-        final, gen, counter, stopped = simulate(
-            g, config, topology, kernel_obj, resume=(gen0, counter0, seg_end)
-        )
-        if kernel_obj.decode is not None:
-            final = kernel_obj.decode(final)
-        return final, gen, counter, stopped
-
-    if topology.distributed:
-        fn = jax.shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=(P(*topology.axes), P(), P(), P()),
-            out_specs=(P(*topology.axes), P(), P(), P()),
-            check_vma=kernel_obj.name == "lax",
-        )
-    else:
-        fn = local_fn
-    return jax.jit(fn)
+    return _build_runner(shape, config, mesh, kernel,
+                         segmented=True, packed_state=False)
 
 
 @functools.lru_cache(maxsize=64)
@@ -281,35 +291,44 @@ def make_packed_runner(
     (height, width/32) uint32 word array (io/packed_io.py reads/writes those
     directly, so the uint8 grid never exists anywhere).
     """
-    topology = topology_for(mesh)
-    local_h, local_w = validate_grid(shape[0], shape[1], topology)
-    kernel_obj = resolve_kernel("packed", local_h, local_w, topology)
-    if not kernel_obj.supports(local_h, local_w, topology):
-        raise ValueError(
-            f"packed state unsupported for a {local_h}x{local_w} local shard "
-            f"on a {topology.shape[0]}x{topology.shape[1]} topology"
-        )
-    simulate = _SIMULATORS[config.convention]
+    return _build_runner(shape, config, mesh, "packed",
+                         segmented=False, packed_state=True)
+
+
+@functools.lru_cache(maxsize=64)
+def make_packed_segment_runner(
+    shape: tuple[int, int],
+    config: GameConfig = DEFAULT_CONFIG,
+    mesh: Mesh | None = None,
+):
+    """Compile a resumable segment over bitpacked word state.
+
+    The packed analog of ``make_segment_runner``: ``(words, gen0, counter0,
+    seg_end) -> (words, gen, counter, stopped)``; composing the packed-I/O
+    lane with snapshots keeps the output-is-valid-input resume property
+    (src/game.c:25-40 vs :154-165) at scales where only the packed lane is
+    practical.
+    """
+    return _build_runner(shape, config, mesh, "packed",
+                         segmented=True, packed_state=True)
+
+
+def _iter_segments(runner, state, config: GameConfig, segment: int):
+    """Drive a segment runner to completion, yielding after every segment."""
+    if segment <= 0:
+        raise ValueError(f"segment must be positive, got {segment}")
     report = _REPORT[config.convention]
-
-    def local_fn(words):
-        final, gen, _, _ = simulate(words, config, topology, kernel_obj)
-        return final, report(gen)
-
-    if topology.distributed:
-        fn = jax.shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=P(*topology.axes),
-            out_specs=(P(*topology.axes), P()),
-            # vma tracking does not yet thread through pallas_call kernel
-            # constants, so the check is off for the Pallas-bearing kernels
-            # (the JAX-documented workaround) but kept for the lax path.
-            check_vma=kernel_obj.name == "lax",
+    gen = _GEN_START[config.convention]
+    counter = 0
+    while True:
+        seg_end = gen + segment - (1 if config.convention == Convention.C else 0)
+        state, gen_a, counter_a, stopped_a = runner(
+            state, jnp.int32(gen), jnp.int32(counter), jnp.int32(seg_end)
         )
-    else:
-        fn = local_fn
-    return jax.jit(fn)
+        gen, counter, stopped = int(gen_a), int(counter_a), bool(stopped_a)
+        yield report(gen), state, stopped
+        if stopped:
+            return
 
 
 def simulate_segments(
@@ -327,23 +346,28 @@ def simulate_segments(
     counter is carried across segments, so exits fire on exactly the same
     generations as the unsegmented loop.
     """
-    if segment <= 0:
-        raise ValueError(f"segment must be positive, got {segment}")
     shape = tuple(np.shape(grid))
     runner = make_segment_runner(shape, config, mesh, kernel)
     device_grid = grid if isinstance(grid, jax.Array) else put_grid(grid, mesh)
-    report = _REPORT[config.convention]
-    gen = _GEN_START[config.convention]
-    counter = 0
-    while True:
-        seg_end = gen + segment - (1 if config.convention == Convention.C else 0)
-        device_grid, gen_a, counter_a, stopped_a = runner(
-            device_grid, jnp.int32(gen), jnp.int32(counter), jnp.int32(seg_end)
-        )
-        gen, counter, stopped = int(gen_a), int(counter_a), bool(stopped_a)
-        yield report(gen), device_grid, stopped
-        if stopped:
-            return
+    yield from _iter_segments(runner, device_grid, config, segment)
+
+
+def simulate_packed_segments(
+    words,
+    shape: tuple[int, int],
+    config: GameConfig = DEFAULT_CONFIG,
+    mesh: Mesh | None = None,
+    segment: int = 100,
+):
+    """Packed-state counterpart of ``simulate_segments``.
+
+    ``shape`` is the logical (height, width); ``words`` its (height,
+    width/32) uint32 array (from io/packed_io.read_packed). Yields the word
+    state, which every consumer writes back through packed_io — the uint8
+    grid never exists.
+    """
+    runner = make_packed_segment_runner(shape, config, mesh)
+    yield from _iter_segments(runner, words, config, segment)
 
 
 def put_grid(grid, mesh: Mesh | None = None) -> jax.Array:
