@@ -1,0 +1,70 @@
+"""Worker process for the 2-process multi-host test.
+
+The ``mpiexec -n 2`` analog (README.md:54-57): each OS process joins the
+cluster through ``bootstrap.initialize`` (MPI_Init,
+src/game_mpi_collective.c:116-118), contributes its own CPU device to the
+('row', 'col') mesh, reads only its addressable file windows, runs the
+engine's shard_map program (halo ppermute + psum votes riding the gloo
+cross-process collectives), and writes only its addressable windows of the
+shared output file — no process ever holds the full grid.
+
+Invoked by tests/test_multihost.py as:
+    python multihost_worker.py <port> <process_id> <num_processes> <workdir>
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    port, pid, nprocs, workdir = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from gol_tpu.parallel import bootstrap
+
+    bootstrap.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert bootstrap.is_multihost()
+
+    from gol_tpu import engine
+    from gol_tpu.config import GameConfig
+    from gol_tpu.io import sharded
+    from gol_tpu.parallel.mesh import make_mesh
+
+    height = width = 64
+    config = GameConfig(gen_limit=40)
+    # One device per process: the mesh's col axis IS the process boundary,
+    # so the E/W halo ppermute crosses processes every generation.
+    mesh = make_mesh(1, nprocs)
+
+    for kernel in ("lax", "packed"):
+        device_grid = sharded.read_sharded(
+            os.path.join(workdir, "input.txt"), width, height, mesh
+        )
+        runner = engine.make_runner((height, width), config, mesh, kernel)
+        final, gen = runner(device_grid)
+        generations = int(gen)
+        sharded.write_sharded(os.path.join(workdir, f"out_{kernel}.txt"), final)
+        if pid == 0:
+            with open(os.path.join(workdir, f"gens_{kernel}.txt"), "w") as f:
+                f.write(str(generations))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Two real OS processes form a cluster and run the collective program.
+
+The reference's multi-node operation is only exercised by actually running
+``mpiexec -n <x>`` (README.md:50-57); this is that, for the TPU build: two
+processes join via jax.distributed (bootstrap.initialize = MPI_Init), each
+contributes its CPU device to the mesh, the halo ppermute and psum votes ride
+the gloo cross-process collectives, and each process reads/writes ONLY its
+addressable windows of the shared files (the MPI-IO file-view property,
+src/game_mpi_collective.c:186-196).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+from gol_tpu import oracle
+from gol_tpu.config import GameConfig
+from gol_tpu.io import text_grid
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster_matches_oracle(tmp_path):
+    g = text_grid.generate(64, 64, seed=3)
+    text_grid.write_grid(str(tmp_path / "input.txt"), g)
+    port = _free_port()
+
+    env = dict(os.environ)
+    # The workers form their own 2-device world; the parent's 8-virtual-CPU
+    # flag must not multiply each worker's device count.
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(port), str(pid), "2", str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        # Never leak workers: a hung/died peer leaves the other blocked in a
+        # gloo collective forever.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker rc={p.returncode}:\n{out[-3000:]}"
+
+    expect = oracle.run(g, GameConfig(gen_limit=40))
+    for kernel in ("lax", "packed"):
+        got = text_grid.read_grid(str(tmp_path / f"out_{kernel}.txt"), 64, 64)
+        gens = int((tmp_path / f"gens_{kernel}.txt").read_text())
+        np.testing.assert_array_equal(np.asarray(got), expect.grid)
+        assert gens == expect.generations
