@@ -316,19 +316,26 @@ def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
 
 
 def _run_host(args, variant, config, width, height, output_path) -> int:
-    """--host: the NumPy oracle path, no accelerator involved."""
+    """--host: the NumPy oracle path, no accelerator involved.
+
+    Prints exactly the lines the variant would print on device — including
+    the Reading/Writing lines of io_timings variants
+    (src/game_mpi_collective.c:200-203,447-450) — so host-vs-device output
+    is line-for-line comparable."""
+    t0 = time.perf_counter()
     grid = text_grid.read_grid(args.input_file, width, height)
+    read_ms = (time.perf_counter() - t0) * 1000
+    if variant.io_timings:
+        print(f"Reading file:\t{read_ms:.2f} msecs")
     t0 = time.perf_counter()
     result = oracle.run(grid, config)
     exec_ms = (time.perf_counter() - t0) * 1000
-    if variant.serial_header:
-        print("Finished.\n")
-    print(f"Generations:\t{result.generations}")
-    print(f"Execution time:\t{exec_ms:.2f} msecs")
-    text_grid.write_grid(output_path, result.grid)
-    if variant.final_finished:
-        print("Finished")
-    return 0
+    return _report_and_write(
+        variant,
+        result.generations,
+        exec_ms,
+        lambda: text_grid.write_grid(output_path, result.grid),
+    )
 
 
 def _show(args) -> int:
@@ -412,7 +419,8 @@ def build_parser() -> argparse.ArgumentParser:
         "--warmup",
         action="store_true",
         help="run the compiled program once, untimed, before the measured run "
-        "(excludes one-time runtime init from Execution time)",
+        "(excludes one-time runtime init from Execution time); implicit with "
+        "--snapshot-every, whose zero-step compile call does the same",
     )
     run.add_argument(
         "--packed-io",

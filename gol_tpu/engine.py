@@ -189,10 +189,15 @@ def _build_runner(
     kernel_obj = resolve_kernel("packed" if packed_state else kernel,
                                 local_h, local_w, topology)
     if not kernel_obj.supports(local_h, local_w, topology):
+        hint = (
+            "packed state has no fallback — use the unpacked lane"
+            if packed_state
+            else "use kernel='auto' to fall back automatically"
+        )
         raise ValueError(
             f"kernel {kernel_obj.name!r} does not support a {local_h}x{local_w} "
-            f"local shard on a {topology.shape[0]}x{topology.shape[1]} topology; "
-            f"use kernel='auto' to fall back automatically"
+            f"local shard on a {topology.shape[0]}x{topology.shape[1]} "
+            f"topology; {hint}"
         )
     simulate = _SIMULATORS[config.convention]
     report = _REPORT[config.convention]

@@ -101,6 +101,12 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
     create_sized(path, height * row_stride(width))
     mm = np.memmap(path, dtype=np.uint8, mode="r+", shape=(height, row_stride(width)))
 
+    # One unpack pool shared by every shard (bounded by core count): nesting
+    # a fresh pool per shard would scale threads as shards x default_workers.
+    unpack_pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=os.cpu_count() or 4
+    )
+
     def store_window(shard) -> None:
         rows, wcols = shard.index[0], shard.index[1]
         r0, r1, _ = rows.indices(height)
@@ -110,8 +116,8 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
         data = shard.data
         # Device->host transfers stream chunk-by-chunk, the next piece
         # prefetched while the current one is handed to the codec; unpacking
-        # itself fans out over a worker pool (the chunk windows are disjoint
-        # and the codec releases the GIL).
+        # itself fans out over the shared worker pool (the chunk windows are
+        # disjoint and the codec releases the GIL).
         chunk_rows = max(1, _WRITE_CHUNK_BYTES // max(data.shape[1] * 4, 1))
         starts = list(range(0, r1 - r0, chunk_rows))
         if not starts:
@@ -125,8 +131,7 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
                 block, window[s : s + block.shape[0]], (w1 - w0) * BITS, east_edge
             )
 
-        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as prefetch, \
-                concurrent.futures.ThreadPoolExecutor() as unpackers:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as prefetch:
             pending = prefetch.submit(fetch, starts[0])
             jobs = []
             for i, s in enumerate(starts):
@@ -136,12 +141,15 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
                     if i + 1 < len(starts)
                     else None
                 )
-                jobs.append(unpackers.submit(unpack, pending.result(), s))
+                jobs.append(unpack_pool.submit(unpack, pending.result(), s))
                 pending = nxt
             for job in jobs:
                 job.result()
 
     shards = list(words.addressable_shards)
-    with concurrent.futures.ThreadPoolExecutor() as pool:
-        list(pool.map(store_window, shards))
+    try:
+        with concurrent.futures.ThreadPoolExecutor() as pool:
+            list(pool.map(store_window, shards))
+    finally:
+        unpack_pool.shutdown()
     mm.flush()

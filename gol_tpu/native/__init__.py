@@ -79,6 +79,10 @@ def pack_text(text: np.ndarray, width: int) -> np.ndarray:
     if width % 32:
         raise ValueError(f"width {width} not a multiple of 32")
     rows, stride = text.shape
+    if stride < width:
+        # Guard the raw-pointer C call: a too-narrow array would be an
+        # out-of-bounds read in C rather than a Python error.
+        raise ValueError(f"text has {stride} columns, needs >= width {width}")
     out = np.empty((rows, width // 32), dtype=np.uint32)
     lib = _load()
     if lib is not None and text.strides[1] == 1:
@@ -98,6 +102,14 @@ def unpack_text(words: np.ndarray, out: np.ndarray, width: int, newline: bool) -
     if width % 32:
         raise ValueError(f"width {width} not a multiple of 32")
     rows = words.shape[0]
+    # Guard the raw-pointer C call against out-of-bounds writes.
+    if words.shape[1] != width // 32:
+        raise ValueError(f"words has {words.shape[1]} columns, needs {width // 32}")
+    if out.shape[0] < rows or out.shape[1] < width + (1 if newline else 0):
+        raise ValueError(
+            f"out shape {out.shape} too small for {rows} rows x width {width}"
+            f"{' + newline' if newline else ''}"
+        )
     lib = _load()
     if lib is not None and out.strides[1] == 1 and words.flags.c_contiguous:
         lib.gol_unpack_text(

@@ -39,6 +39,9 @@ from gol_tpu.parallel.mesh import ROW_AXIS, Topology
 
 _BITS = packed_math.BITS
 _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
+# Word-count cap: ~10 live uint32 temporaries mean even the minimum 8-row band
+# costs ~320*nwords bytes of VMEM (see stencil_pallas._MAX_WIDTH).
+_MAX_WORDS = 128 << 10
 # Target VMEM bytes for one band of packed words; the ~10 live temporaries of
 # the adder network and the double-buffered in/out blocks sit beside it.
 _BAND_BYTES = 256 << 10
@@ -57,10 +60,10 @@ def supports(height: int, width: int, topology) -> bool:
     single-word row (64x32 and 512x1152 grids match the oracle). ``width``
     and ``height`` are the LOCAL shard shape under a mesh.
     """
-    if width % _BITS != 0:
+    if width % _BITS != 0 or width // _BITS > _MAX_WORDS:
         return False
     if topology.distributed:
-        return True  # jnp-level path, no tiling constraints
+        return True  # odd heights fall to the jnp path, no tiling constraints
     return height % _SUBLANES == 0 and height >= _SUBLANES
 
 

@@ -43,6 +43,10 @@ _SUBLANES = 8
 # compute copies and the double-buffered in/out blocks fit beside it, large
 # enough to amortize per-band pipeline overhead.
 _BAND_BYTES = 512 << 10
+# Width cap: the kernel widens to int32 with ~10 live temporaries, so even the
+# minimum 8-row band costs ~320*width bytes of VMEM; beyond this the compiled
+# kernel could exceed VMEM while the band picker still finds a "fitting" band.
+_MAX_WIDTH = 128 << 10
 
 
 def supports(height: int, width: int, topology: Topology) -> bool:
@@ -53,6 +57,7 @@ def supports(height: int, width: int, topology: Topology) -> bool:
     """
     return (
         width % _LANES == 0
+        and width <= _MAX_WIDTH
         and height % _SUBLANES == 0
         and height >= _SUBLANES
     )

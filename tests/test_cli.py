@@ -83,6 +83,28 @@ class TestSerialVariant:
                  "--host", "--output", str(host_out)])
         assert dev_out.read_bytes() == host_out.read_bytes()
 
+    @pytest.mark.parametrize("variant", ["game", "collective", "openmp", "cuda"])
+    def test_host_prints_same_line_set_as_device(
+        self, capsys, variant, random16, tmp_path
+    ):
+        """--host emits exactly the lines the device lane prints — including
+        Reading/Writing for io_timings variants
+        (src/game_mpi_collective.c:200-203,447-450)."""
+        path, g = random16
+
+        def lines(extra):
+            run_cli(
+                ["16", "16", path, "--variant", variant, "--gen-limit", "5",
+                 "--output", str(tmp_path / "o.out")] + extra
+            )
+            # Timing values differ run to run; compare the line *labels*.
+            return [
+                line.split("\t")[0]
+                for line in capsys.readouterr().out.splitlines()
+            ]
+
+        assert lines([]) == lines(["--host"])
+
 
 class TestDistributedVariants:
     @pytest.mark.parametrize("variant", ["mpi", "collective", "async", "openmp"])
