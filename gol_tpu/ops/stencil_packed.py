@@ -40,8 +40,9 @@ from gol_tpu.parallel.mesh import ROW_AXIS, Topology
 _BITS = packed_math.BITS
 _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
 # Word-count cap: ~10 live uint32 temporaries mean even the minimum 8-row band
-# costs ~320*nwords bytes of VMEM (see stencil_pallas._MAX_WIDTH).
-_MAX_WORDS = 128 << 10
+# costs ~320*nwords bytes of VMEM. Empirical limit on v5e: 32768 words
+# (width 2^20) compiles and matches the oracle, 65536 VMEM-OOMs at compile.
+_MAX_WORDS = 32 << 10
 # Target VMEM bytes for one band of packed words; the ~10 live temporaries of
 # the adder network and the double-buffered in/out blocks sit beside it.
 _BAND_BYTES = 256 << 10

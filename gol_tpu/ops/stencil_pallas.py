@@ -44,9 +44,9 @@ _SUBLANES = 8
 # enough to amortize per-band pipeline overhead.
 _BAND_BYTES = 512 << 10
 # Width cap: the kernel widens to int32 with ~10 live temporaries, so even the
-# minimum 8-row band costs ~320*width bytes of VMEM; beyond this the compiled
-# kernel could exceed VMEM while the band picker still finds a "fitting" band.
-_MAX_WIDTH = 128 << 10
+# minimum 8-row band costs ~320*width bytes of VMEM. Empirical limit on v5e:
+# 65536 compiles and matches the oracle, 98304 VMEM-OOMs at compile.
+_MAX_WIDTH = 64 << 10
 
 
 def supports(height: int, width: int, topology: Topology) -> bool:

@@ -163,3 +163,67 @@ class TestCudaConvention:
 def test_rejects_non_2d():
     with pytest.raises(ValueError):
         oracle.run(np.zeros((2, 2, 2), dtype=np.uint8))
+
+
+class TestCudaConventionExternalGroundTruth:
+    """The cuda accounting pinned by an independent C reimplementation of the
+    binary's host loop (src/game_cuda.cu:213-276), compiled at test time —
+    the external ground truth the image's missing nvcc would have provided."""
+
+    @pytest.fixture(scope="class")
+    def c_binary(self, tmp_path_factory):
+        import os
+        import shutil
+        import subprocess
+
+        cc = next((c for c in ("cc", "gcc", "clang") if shutil.which(c)), None)
+        if cc is None:
+            pytest.skip("no C toolchain on PATH")
+        src = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".claude", "skills", "verify", "cuda_host_loop.c",
+        )
+        exe = str(tmp_path_factory.mktemp("cbin") / "cuda_host_loop")
+        subprocess.run([cc, "-std=c99", "-O2", "-o", exe, src], check=True)
+        return exe
+
+    @pytest.mark.parametrize(
+        "case", ["random", "still_life", "lone_cell", "all_dead"]
+    )
+    def test_matches_oracle_and_engine(self, c_binary, case, tmp_path, monkeypatch):
+        import subprocess
+
+        from gol_tpu import engine
+        from gol_tpu.io import text_grid
+
+        monkeypatch.chdir(tmp_path)
+        if case == "random":
+            g = np.asarray(text_grid.generate(48, 48, seed=9))
+        else:
+            g = np.zeros((16, 16), np.uint8)
+            if case == "still_life":
+                g[4:6, 4:6] = 1
+            elif case == "lone_cell":
+                g[8, 8] = 1
+        text_grid.write_grid("in.txt", g)
+        h, w = g.shape
+        p = subprocess.run(
+            [c_binary, str(w), str(h), "in.txt", "60"],
+            capture_output=True, text=True, check=True,
+        )
+        c_gens = int(
+            [l for l in p.stdout.splitlines() if l.startswith("Generations")][0]
+            .split("\t")[1]
+        )
+        c_bytes = open("cuda_output.out", "rb").read()
+
+        config = GameConfig(gen_limit=60, convention=Convention.CUDA)
+        expect = oracle.run(g, config)
+        text_grid.write_grid("oracle.out", expect.grid)
+        assert c_gens == expect.generations
+        assert c_bytes == open("oracle.out", "rb").read()
+
+        got = engine.simulate(g, config)
+        assert got.generations == c_gens
+        text_grid.write_grid("engine.out", got.grid)
+        assert c_bytes == open("engine.out", "rb").read()
