@@ -45,7 +45,10 @@ _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
 _MAX_WORDS = 32 << 10
 # Target VMEM bytes for one band of packed words; the ~10 live temporaries of
 # the adder network and the double-buffered in/out blocks sit beside it.
-_BAND_BYTES = 256 << 10
+# Scoped VMEM is 16MB on v5e and total usage scales at ~8x the band: 1MB
+# measures fastest (1.49e12 cells/s marginal at 16384^2, +11% over 256KB);
+# 2MB OOMs the scoped allocator.
+_BAND_BYTES = 1 << 20
 
 # Re-exported for the kernel registry: the engine packs/unpacks at the loop
 # boundary through these.
