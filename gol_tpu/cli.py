@@ -357,12 +357,16 @@ def _show(args) -> int:
 
 
 def _generate(args) -> int:
-    grid = text_grid.generate(
-        args.width, args.height, density=args.density, seed=args.seed
-    )
     if args.output:
-        text_grid.write_grid(args.output, grid)
+        # Streamed: north-star-sized grids (65536^2 = 4 GB of text) generate
+        # in O(chunk) host memory.
+        text_grid.generate_to_file(
+            args.output, args.width, args.height, density=args.density, seed=args.seed
+        )
     else:
+        grid = text_grid.generate(
+            args.width, args.height, density=args.density, seed=args.seed
+        )
         sys.stdout.write(text_grid.encode(grid).decode("ascii"))
     return 0
 

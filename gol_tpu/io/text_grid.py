@@ -104,3 +104,27 @@ def generate(
     """
     rng = np.random.default_rng(seed)
     return (rng.random((height, width)) < density).astype(np.uint8)
+
+
+def generate_to_file(
+    path: str,
+    width: int,
+    height: int,
+    density: float = 0.5,
+    seed: int | None = None,
+    chunk_rows: int = 4096,
+) -> None:
+    """Stream a random grid straight to its file, a row block at a time.
+
+    Identical bytes to ``write_grid(path, generate(...))`` (pinned by test)
+    but with O(chunk) host memory — at 65536^2 the whole-array route is a
+    4 GB text buffer plus the RNG intermediates, this is ~256 MB peak.
+    """
+    rng = np.random.default_rng(seed)
+    mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=(height, row_stride(width)))
+    for r0 in range(0, height, chunk_rows):
+        r1 = min(height, r0 + chunk_rows)
+        block = (rng.random((r1 - r0, width)) < density).astype(np.uint8)
+        mm[r0:r1, :width] = block + ZERO
+        mm[r0:r1, width] = NEWLINE
+    mm.flush()

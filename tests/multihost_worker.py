@@ -63,6 +63,23 @@ def main() -> int:
         if pid == 0:
             with open(os.path.join(workdir, f"gens_{kernel}.txt"), "w") as f:
                 f.write(str(generations))
+
+    # The packed-I/O lane (C3's MPI-IO at word granularity): each process
+    # packs/unpacks only its addressable file windows, word state end to end.
+    from gol_tpu.io import packed_io
+
+    words = packed_io.read_packed(
+        os.path.join(workdir, "input.txt"), width, height, mesh
+    )
+    runner = engine.make_packed_runner((height, width), config, mesh)
+    final_words, gen = runner(words)
+    generations = int(gen)
+    packed_io.write_packed(
+        os.path.join(workdir, "out_packedio.txt"), final_words, width
+    )
+    if pid == 0:
+        with open(os.path.join(workdir, "gens_packedio.txt"), "w") as f:
+            f.write(str(generations))
     return 0
 
 

@@ -62,3 +62,13 @@ def test_generate_deterministic_with_seed():
     a = text_grid.generate(12, 12, seed=42)
     b = text_grid.generate(12, 12, seed=42)
     assert np.array_equal(a, b)
+
+
+def test_generate_to_file_matches_whole_array_route(tmp_path):
+    """Streamed generation writes byte-identical files to the in-memory
+    route for the same seed (the RNG stream is consumed in the same order)."""
+    whole = tmp_path / "whole.txt"
+    streamed = tmp_path / "streamed.txt"
+    text_grid.write_grid(str(whole), text_grid.generate(96, 40, seed=7))
+    text_grid.generate_to_file(str(streamed), 96, 40, seed=7, chunk_rows=16)
+    assert whole.read_bytes() == streamed.read_bytes()

@@ -68,8 +68,8 @@ def test_two_process_cluster_matches_oracle(tmp_path):
         assert p.returncode == 0, f"worker rc={p.returncode}:\n{out[-3000:]}"
 
     expect = oracle.run(g, GameConfig(gen_limit=40))
-    for kernel in ("lax", "packed"):
-        got = text_grid.read_grid(str(tmp_path / f"out_{kernel}.txt"), 64, 64)
-        gens = int((tmp_path / f"gens_{kernel}.txt").read_text())
+    for lane in ("lax", "packed", "packedio"):
+        got = text_grid.read_grid(str(tmp_path / f"out_{lane}.txt"), 64, 64)
+        gens = int((tmp_path / f"gens_{lane}.txt").read_text())
         np.testing.assert_array_equal(np.asarray(got), expect.grid)
         assert gens == expect.generations
