@@ -112,14 +112,18 @@ def generate_to_file(
     height: int,
     density: float = 0.5,
     seed: int | None = None,
-    chunk_rows: int = 4096,
+    chunk_rows: int | None = None,
 ) -> None:
     """Stream a random grid straight to its file, a row block at a time.
 
     Identical bytes to ``write_grid(path, generate(...))`` (pinned by test)
     but with O(chunk) host memory — at 65536^2 the whole-array route is a
-    4 GB text buffer plus the RNG intermediates, this is ~256 MB peak.
+    4 GB text buffer plus the RNG intermediates; the chunk size scales
+    inversely with width so the float64 RNG intermediate (the largest
+    per-chunk allocation, 8 bytes/cell) stays ~256 MB at any width.
     """
+    if chunk_rows is None:
+        chunk_rows = max(1, (256 << 20) // max(width * 8, 1))
     rng = np.random.default_rng(seed)
     mm = np.memmap(path, dtype=np.uint8, mode="w+", shape=(height, row_stride(width)))
     for r0 in range(0, height, chunk_rows):
