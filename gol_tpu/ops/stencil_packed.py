@@ -3,8 +3,9 @@
 The fastest path, and the one that earns the TPU its keep. Where the
 reference's CUDA kernel spends one thread per cell (src/game_cuda.cu:128-148),
 this kernel packs 32 cells into each uint32 lane element and evolves all of
-them with ~60 bitwise VPU ops per word — a carry-save adder network computing
-all eight neighbor counts bit-parallel:
+them with ~34 bitwise VPU ops per word — a carry-save adder network computing
+all eight neighbor counts bit-parallel, sharing each row's horizontal sums
+with all three output rows it feeds:
 
 - Cells live packed as uint32 words along the width axis: bit j of word w is
   the cell at column ``w*32 + j``. HBM traffic per generation drops to ~2
@@ -12,10 +13,10 @@ all eight neighbor counts bit-parallel:
 - West/east neighbors are one-bit shifts within words, with the cross-word
   (and toroidal cross-row) carry bit delivered by a lane-roll of the word
   array.
-- Neighbor counts come from a boolean adder tree: per-row 3:2 compressors,
-  then a 4-bit carry-save sum. With count bits N = s0 + 2*b1 + 4*u0 + 8*u1,
-  rule B3/S23 (src/game.c:91-98) collapses to
-  ``new = b1 & ~(u0|u1) & (s0|mid)``.
+- Neighbor counts come from a boolean adder tree that shares work across
+  rows: each row's horizontal triple sum is computed once
+  (``packed_math.row_sums``) and re-ranked by row shifts for the vertical
+  combine (``packed_math.combine``) — ~28 bitwise ops + 6 rolls per word.
 - The alive/similar termination flags accumulate in SMEM exactly as in the
   unpacked Pallas kernel, so the engine's while_loop stays host-free.
 
@@ -80,6 +81,35 @@ def _pick_band(height: int, words: int) -> int:
     raise ValueError(f"no {_SUBLANES}-aligned band divides height {height}")
 
 
+def _vertical_combine(s0, s1, m0, m1, mid, t0, t1, b0, b1, band):
+    """Finish a band: re-rank the per-row horizontal sums by row shifts.
+
+    ``t*``/``b*`` are the wrap rows' (1, nwords) triple-sum planes; interior
+    rows take the adjacent row's planes via a sublane roll. Shared by the
+    single-device and mesh band kernels, which differ only in how wrap rows
+    and seam carries are sourced.
+    """
+    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
+
+    def shift_down(plane, wrap_row):
+        return jnp.where(
+            rows == 0, jnp.broadcast_to(wrap_row, mid.shape), pltpu.roll(plane, 1, 0)
+        )
+
+    def shift_up(plane, wrap_row):
+        return jnp.where(
+            rows == band - 1,
+            jnp.broadcast_to(wrap_row, mid.shape),
+            pltpu.roll(plane, band - 1, 0),
+        )
+
+    return packed_math.combine(
+        shift_down(s0, t0), shift_down(s1, t1),
+        shift_up(s0, b0), shift_up(s1, b1),
+        m0, m1, mid,
+    )
+
+
 def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *, band: int):
     i = pl.program_id(0)
 
@@ -97,15 +127,18 @@ def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *,
 
     top_row = _extract(top_ref, 7)
     bot_row = _extract(bot_ref, 0)
-    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
-    up = jnp.where(rows == 0, jnp.broadcast_to(top_row, mid.shape), pltpu.roll(mid, 1, 0))
-    down = jnp.where(
-        rows == band - 1, jnp.broadcast_to(bot_row, mid.shape), pltpu.roll(mid, band - 1, 0)
-    )
+    nwords = mid.shape[1]
 
-    new = packed_math.evolve_rows(
-        up, mid, down, lambda a, s: pltpu.roll(a, s % a.shape[1], 1)
-    )
+    def hs(x):
+        left = pltpu.roll(x, 1 % nwords, 1)
+        right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+        return packed_math.row_sums(x, left, right)
+
+    # Horizontal triple sums once per row; the wrap rows' sums are 1-row work.
+    m0, m1, s0, s1 = hs(mid)
+    _, _, t0, t1 = hs(top_row)
+    _, _, b0, b1 = hs(bot_row)
+    new = _vertical_combine(s0, s1, m0, m1, mid, t0, t1, b0, b1, band)
     out_ref[:] = new
 
     alive = jnp.max(jnp.where(new != 0, 1, 0))
@@ -229,37 +262,32 @@ def _dist_band_kernel(
         return jax.lax.bitcast_convert_type(row, jnp.uint32)
 
     # Interior bands take their wrap rows from the adjacent 8-row blocks; the
-    # first/last band take the shard's ppermute'd ghost rows instead.
+    # first/last band take the shard's ppermute'd ghost rows instead. The wrap
+    # rows' seam carries are gup[0] (carries of the row above band row 0) and
+    # gdown[band-1] (carries of the row below the band's last row) — right for
+    # interior and edge bands alike, since assemble_band_ghosts builds the
+    # carry columns over the full extended row range.
     top_row = jnp.where(i == 0, _extract(gtop_ref, 7), _extract(top_ref, 7))
     bot_row = jnp.where(i == nbands - 1, _extract(gbot_ref, 0), _extract(bot_ref, 0))
-    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
-    up = jnp.where(
-        rows == 0, jnp.broadcast_to(top_row, mid.shape), pltpu.roll(mid, 1, 0)
-    )
-    down = jnp.where(
-        rows == band - 1,
-        jnp.broadcast_to(bot_row, mid.shape),
-        pltpu.roll(mid, band - 1, 0),
-    )
 
-    lanes = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 1)
-
-    def _carries(x, g_ref):
-        # g_ref rows align with x's rows; lane 0 = ghost west carry (bit 31),
-        # lane 1 = ghost east carry (bit 0). The word rolled in across the
-        # shard seam is replaced by the neighbor's carry word.
-        gw = jnp.broadcast_to(g_ref[:, 0:1], x.shape)
-        ge = jnp.broadcast_to(g_ref[:, 1:2], x.shape)
+    def _hs(x, gwest, geast):
+        # Seam patch: the word rolled in across the shard seam is replaced by
+        # the neighbor's carry word (lane 0 = ghost west, bit 31 pre-positioned;
+        # last lane = ghost east, bit 0).
+        lanes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        gw = jnp.broadcast_to(gwest, x.shape)
+        ge = jnp.broadcast_to(geast, x.shape)
         left = jnp.where(lanes == 0, gw, pltpu.roll(x, 1 % nwords, 1))
         right = jnp.where(
             lanes == nwords - 1, ge, pltpu.roll(x, (nwords - 1) % nwords, 1)
         )
-        return packed_math.west(x, left), packed_math.east(x, right)
+        return packed_math.row_sums(x, left, right)
 
-    uw, ue = _carries(up, gup_ref)
-    mw, me = _carries(mid, gmid_ref)
-    dw, de = _carries(down, gdown_ref)
-    new = packed_math.rule(uw, up, ue, mw, me, dw, down, de, mid=mid)
+    # Horizontal triple sums once per row (mid block + the two wrap rows).
+    m0, m1, s0, s1 = _hs(mid, gmid_ref[:, 0:1], gmid_ref[:, 1:2])
+    _, _, t0, t1 = _hs(top_row, gup_ref[0:1, 0:1], gup_ref[0:1, 1:2])
+    _, _, b0, b1 = _hs(bot_row, gdown_ref[band - 1 :, 0:1], gdown_ref[band - 1 :, 1:2])
+    new = _vertical_combine(s0, s1, m0, m1, mid, t0, t1, b0, b1, band)
     out_ref[:] = new
 
     alive = jnp.max(jnp.where(new != 0, 1, 0))
