@@ -234,9 +234,8 @@ def _dist_band_kernel(
     bot_ref,
     gtop_ref,
     gbot_ref,
-    gup_ref,
     gmid_ref,
-    gdown_ref,
+    gwrap_ref,
     out_ref,
     alive_ref,
     similar_ref,
@@ -263,10 +262,10 @@ def _dist_band_kernel(
 
     # Interior bands take their wrap rows from the adjacent 8-row blocks; the
     # first/last band take the shard's ppermute'd ghost rows instead. The wrap
-    # rows' seam carries are gup[0] (carries of the row above band row 0) and
-    # gdown[band-1] (carries of the row below the band's last row) — right for
-    # interior and edge bands alike, since assemble_band_ghosts builds the
-    # carry columns over the full extended row range.
+    # rows' seam carries arrive as this band's gwrap row — four scalars
+    # (west/east for the row above and the row below), right for interior and
+    # edge bands alike, since assemble_band_ghosts builds them from the carry
+    # column over the full extended row range.
     top_row = jnp.where(i == 0, _extract(gtop_ref, 7), _extract(top_ref, 7))
     bot_row = jnp.where(i == nbands - 1, _extract(gbot_ref, 0), _extract(bot_ref, 0))
 
@@ -283,10 +282,11 @@ def _dist_band_kernel(
         )
         return packed_math.row_sums(x, left, right)
 
-    # Horizontal triple sums once per row (mid block + the two wrap rows).
+    # Horizontal triple sums once per row (mid block + the two wrap rows; the
+    # wrap rows' four seam carries are SMEM scalars).
     m0, m1, s0, s1 = _hs(mid, gmid_ref[:, 0:1], gmid_ref[:, 1:2])
-    _, _, t0, t1 = _hs(top_row, gup_ref[0:1, 0:1], gup_ref[0:1, 1:2])
-    _, _, b0, b1 = _hs(bot_row, gdown_ref[band - 1 :, 0:1], gdown_ref[band - 1 :, 1:2])
+    _, _, t0, t1 = _hs(top_row, gwrap_ref[i, 0], gwrap_ref[i, 1])
+    _, _, b0, b1 = _hs(bot_row, gwrap_ref[i, 2], gwrap_ref[i, 3])
     new = _vertical_combine(s0, s1, m0, m1, mid, t0, t1, b0, b1, band)
     out_ref[:] = new
 
@@ -305,7 +305,7 @@ def _dist_band_kernel(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _dist_step_pallas(words, gtop8, gbot8, gup, gmid, gdown, interpret=False):
+def _dist_step_pallas(words, gtop8, gbot8, gmid, gwrap, interpret=False):
     height, nwords = words.shape
     band = _pick_band(height, nwords)
     bb = band // _SUBLANES
@@ -329,8 +329,9 @@ def _dist_step_pallas(words, gtop8, gbot8, gup, gmid, gdown, interpret=False):
             pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            # The whole per-band wrap-carry table sits in SMEM (nbands x 4
+            # scalars); each band reads its row by program id.
+            pl.BlockSpec((nbands, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=(
             pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -346,7 +347,7 @@ def _dist_step_pallas(words, gtop8, gbot8, gup, gmid, gdown, interpret=False):
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(words, words, words, gtop8, gbot8, gup, gmid, gdown)
+    )(words, words, words, gtop8, gbot8, gmid, gwrap)
     return new, alive[0, 0] > 0, similar[0, 0] > 0
 
 
@@ -359,15 +360,15 @@ def _distributed_step(words: jnp.ndarray, topology: Topology):
     odd shard heights. Either way the hot loop under a mesh runs the same
     carry-save network as the single-device path.
     """
-    h, _nwords = words.shape
+    h, nwords = words.shape
     top, bot, gwest, geast = exchange_packed(words, topology)
     if h % _SUBLANES == 0:
-        gtop8, gbot8, gup, gmid, gdown = halo.assemble_band_ghosts(
-            top, bot, gwest, geast
+        gtop8, gbot8, gmid, gwrap = halo.assemble_band_ghosts(
+            top, bot, gwest, geast, _pick_band(h, nwords)
         )
         interpret = jax.default_backend() != "tpu"
         return _dist_step_pallas(
-            words, gtop8, gbot8, gup, gmid, gdown, interpret=interpret
+            words, gtop8, gbot8, gmid, gwrap, interpret=interpret
         )
     new = packed_math.evolve_ghost(words, top, bot, gwest, geast)
     return new, jnp.any(new != 0), jnp.all(new == words)

@@ -98,23 +98,22 @@ def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *,
     bot_row = jnp.max(
         jnp.where(r8 == 0, bot_ref[:].astype(jnp.int32), 0), axis=0, keepdims=True
     )
-    topg = jnp.broadcast_to(top_row, mid.shape)
-    botg = jnp.broadcast_to(bot_row, mid.shape)
+    # Each row's horizontal sums once (pair m = w+e, triple s = w+x+e); the
+    # vertical combine re-ranks s by row shifts, wrap rows patched in at the
+    # band edges (same row-sum-sharing shape as the packed kernel).
+    def hs(x):
+        m = _roll(x, 1) + _roll(x, -1)
+        return m, m + x
+
+    m, s = hs(mid)
+    _, ts = hs(top_row)
+    _, bs = hs(bot_row)
     rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
-    # Row shift via sublane rotate, ghost rows patched in at the band edges:
-    # up[r] = mid[r-1] (ghost at r=0), down[r] = mid[r+1] (ghost at r=band-1).
-    up = jnp.where(rows == 0, topg, pltpu.roll(mid, 1, 0))
-    down = jnp.where(rows == band - 1, botg, pltpu.roll(mid, band - 1, 0))
-    counts = (
-        up
-        + _roll(up, 1)
-        + _roll(up, -1)
-        + _roll(mid, 1)
-        + _roll(mid, -1)
-        + down
-        + _roll(down, 1)
-        + _roll(down, -1)
+    up = jnp.where(rows == 0, jnp.broadcast_to(ts, mid.shape), pltpu.roll(s, 1, 0))
+    down = jnp.where(
+        rows == band - 1, jnp.broadcast_to(bs, mid.shape), pltpu.roll(s, band - 1, 0)
     )
+    counts = up + down + m
     # B3/S23, branchless (src/game_cuda.cu:146).
     new = jnp.where((counts == 3) | ((counts == 2) & (mid == 1)), 1, 0)
     out_ref[:] = new.astype(jnp.uint8)
@@ -183,9 +182,8 @@ def _dist_band_kernel(
     bot_ref,
     gtop_ref,
     gbot_ref,
-    gup_ref,
     gmid_ref,
-    gdown_ref,
+    gwrap_ref,
     out_ref,
     alive_ref,
     similar_ref,
@@ -198,7 +196,8 @@ def _dist_band_kernel(
     The same VMEM band stencil as ``_band_kernel``, with the torus wrap at
     shard edges taken from the ppermute'd ghosts — the reference runs its
     hand-written evolve in every MPI variant the same way
-    (src/game_mpi.c:73-84 over ghost cells).
+    (src/game_mpi.c:73-84 over ghost cells). Seam bytes for the two wrap rows
+    ride in as this band's gwrap row (west/east for the row above and below).
     """
     i = pl.program_id(0)
     mid = main_ref[:].astype(jnp.int32)
@@ -214,33 +213,27 @@ def _dist_band_kernel(
 
     top_row = jnp.where(i == 0, _extract(gtop_ref, 7), _extract(top_ref, 7))
     bot_row = jnp.where(i == nbands - 1, _extract(gbot_ref, 0), _extract(bot_ref, 0))
-    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
-    up = jnp.where(
-        rows == 0, jnp.broadcast_to(top_row, mid.shape), pltpu.roll(mid, 1, 0)
-    )
-    down = jnp.where(
-        rows == band - 1,
-        jnp.broadcast_to(bot_row, mid.shape),
-        pltpu.roll(mid, band - 1, 0),
-    )
 
-    lanes = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 1)
-
-    def _west_east(x, g_ref):
-        # g_ref rows align with x's rows; lane 0 = ghost west byte, lane 1 =
-        # ghost east byte. The lane rolled in across the shard seam is
-        # replaced by the neighbor's boundary column.
-        g = g_ref[:].astype(jnp.int32)
-        gw = jnp.broadcast_to(g[:, 0:1], x.shape)
-        ge = jnp.broadcast_to(g[:, 1:2], x.shape)
+    def _hs(x, gw_col, ge_col):
+        # Horizontal sums with the seam patch: the lane rolled in across the
+        # shard seam is replaced by the neighbor's boundary byte.
+        lanes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        gw = jnp.broadcast_to(gw_col.astype(jnp.int32), x.shape)
+        ge = jnp.broadcast_to(ge_col.astype(jnp.int32), x.shape)
         w = jnp.where(lanes == 0, gw, _roll(x, 1))
         e = jnp.where(lanes == width - 1, ge, _roll(x, -1))
-        return w, e
+        m = w + e
+        return m, m + x
 
-    uw, ue = _west_east(up, gup_ref)
-    mw, me = _west_east(mid, gmid_ref)
-    dw, de = _west_east(down, gdown_ref)
-    counts = up + uw + ue + mw + me + down + dw + de
+    m, s = _hs(mid, gmid_ref[:, 0:1], gmid_ref[:, 1:2])
+    _, ts = _hs(top_row, gwrap_ref[i, 0], gwrap_ref[i, 1])
+    _, bs = _hs(bot_row, gwrap_ref[i, 2], gwrap_ref[i, 3])
+    rows = jax.lax.broadcasted_iota(jnp.int32, mid.shape, 0)
+    up = jnp.where(rows == 0, jnp.broadcast_to(ts, mid.shape), pltpu.roll(s, 1, 0))
+    down = jnp.where(
+        rows == band - 1, jnp.broadcast_to(bs, mid.shape), pltpu.roll(s, band - 1, 0)
+    )
+    counts = up + down + m
     new = jnp.where((counts == 3) | ((counts == 2) & (mid == 1)), 1, 0)
     out_ref[:] = new.astype(jnp.uint8)
 
@@ -259,7 +252,7 @@ def _dist_band_kernel(
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _dist_step(grid, gtop8, gbot8, gup, gmid, gdown, interpret=False):
+def _dist_step(grid, gtop8, gbot8, gmid, gwrap, interpret=False):
     height, width = grid.shape
     band = _pick_band(height, width)
     bb = band // _SUBLANES
@@ -283,8 +276,9 @@ def _dist_step(grid, gtop8, gbot8, gup, gmid, gdown, interpret=False):
             pl.BlockSpec((_SUBLANES, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((_SUBLANES, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((band, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            # The whole per-band wrap-carry table sits in SMEM (nbands x 4
+            # scalars); each band reads its row by program id.
+            pl.BlockSpec((nbands, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=(
             pl.BlockSpec((band, width), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -300,7 +294,7 @@ def _dist_step(grid, gtop8, gbot8, gup, gmid, gdown, interpret=False):
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(grid, grid, grid, gtop8, gbot8, gup, gmid, gdown)
+    )(grid, grid, grid, gtop8, gbot8, gmid, gwrap)
     return new, alive[0, 0] > 0, similar[0, 0] > 0
 
 
@@ -317,11 +311,14 @@ def _distributed_step(cur: jnp.ndarray, topology: Topology):
     top, bot = halo.ghost_slices(cur, 0, row_axis, rows)
     west_col, east_col = halo.boundary_columns(cur, top, bot)
     gwest, geast = halo.exchange_columns(west_col, east_col, topology)
-    gtop8, gbot8, gup, gmid, gdown = halo.assemble_band_ghosts(
-        top, bot, gwest, geast
+    gtop8, gbot8, gmid, gwrap = halo.assemble_band_ghosts(
+        top, bot, gwest, geast, _pick_band(*cur.shape)
     )
     interpret = jax.default_backend() != "tpu"
-    return _dist_step(cur, gtop8, gbot8, gup, gmid, gdown, interpret=interpret)
+    # The four seam bytes per band ride in SMEM, which holds 32-bit scalars.
+    return _dist_step(
+        cur, gtop8, gbot8, gmid, gwrap.astype(jnp.int32), interpret=interpret
+    )
 
 
 def pallas_step(cur: jnp.ndarray, topology: Topology):

@@ -88,24 +88,34 @@ def exchange_columns(west_col, east_col, topology: Topology, transform=None):
     return ghost_west, ghost_east
 
 
-def assemble_band_ghosts(top, bot, gwest, geast):
-    """Ghost operand set for a per-shard band kernel.
+def assemble_band_ghosts(top, bot, gwest, geast, band):
+    """Ghost operand set for a per-shard band kernel of ``band``-row bands.
 
-    Returns ``(gtop8, gbot8, gup, gmid, gdown)``: the ghost rows embedded in
+    Returns ``(gtop8, gbot8, gmid, gwrap)``: the ghost rows embedded in
     8-row-aligned blocks (the 32-bit sublane granule — ghost above in row 7,
-    ghost below in row 0), and the per-row (west, east) carry columns for the
-    up/mid/down shifted arrays. ``gwest``/``geast`` cover extended rows -1..h,
-    so shard row q's up-row carries sit at index q, mid at q+1, down at q+2 —
-    the subtle alignment both band kernels share.
+    ghost below in row 0), the per-row (west, east) carry columns for the
+    shard's own rows, and per-band wrap-row carries. ``gwest``/``geast``
+    cover extended rows -1..h, so shard row q's carries sit at index q+1;
+    band i's wrap rows are extended rows i*band (above) and i*band+band+1
+    (below), giving ``gwrap[i] = (west_top, east_top, west_bot, east_bot)``
+    — the kernel reads only those four carries per band, so shipping whole
+    per-row columns for the up/down planes would be 2*(band-1) unread rows.
     """
     h = gwest.shape[0] - 2
+    if h % band != 0:
+        # Out-of-range gathers clamp silently in JAX; a partial last band
+        # would read its bottom wrap carries from the wrong row.
+        raise ValueError(f"band {band} must divide the shard height {h}")
     zeros7 = jnp.zeros((7, top.shape[1]), top.dtype)
     gtop8 = jnp.concatenate([zeros7, top], axis=0)
     gbot8 = jnp.concatenate([bot, zeros7], axis=0)
-    gup = jnp.stack([gwest[0:h], geast[0:h]], axis=1)
     gmid = jnp.stack([gwest[1 : h + 1], geast[1 : h + 1]], axis=1)
-    gdown = jnp.stack([gwest[2 : h + 2], geast[2 : h + 2]], axis=1)
-    return gtop8, gbot8, gup, gmid, gdown
+    starts = jnp.arange(0, h, band)  # band i's top wrap row, extended index
+    gwrap = jnp.stack(
+        [gwest[starts], geast[starts], gwest[starts + band + 1], geast[starts + band + 1]],
+        axis=1,
+    )
+    return gtop8, gbot8, gmid, gwrap
 
 
 def exchange(local: jnp.ndarray, topology: Topology) -> jnp.ndarray:
