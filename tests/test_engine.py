@@ -156,3 +156,36 @@ def test_choose_mesh_shape():
 def test_validate_grid_local_shape():
     topo = topology_for(make_mesh(2, 4))
     assert validate_grid(16, 32, topo) == (8, 8)
+
+
+class TestBlockTermination:
+    """Pins the blocked C-convention loop (engine._simulate_c_block): exits
+    landing on every offset within the 16-generation vote block must report
+    oracle-identical generation counts and grids."""
+
+    @pytest.mark.parametrize("gen_limit", [1, 15, 16, 17, 31, 33, 48])
+    def test_bound_straddles_blocks(self, gen_limit):
+        g = text_grid.generate(64, 64, seed=5)  # soup: no early exit
+        cfg = GameConfig(gen_limit=gen_limit)
+        got = engine.simulate(g, cfg, kernel="packed")
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations == gen_limit
+        assert np.array_equal(got.grid, want.grid)
+
+    # Seeds chosen (by oracle search) so the early exits land on 12 distinct
+    # offsets within the 16-generation block, both exit kinds represented.
+    @pytest.mark.parametrize(
+        "seed,density,exit_gen",
+        [
+            (60, 0.08, 17), (10, 0.28, 194), (4, 0.08, 3), (149, 0.18, 68),
+            (34, 0.28, 149), (108, 0.08, 6), (218, 0.28, 119), (64, 0.08, 8),
+            (119, 0.38, 122), (0, 0.08, 11), (88, 0.08, 29), (58, 0.28, 110),
+        ],
+    )
+    def test_early_exits_at_varied_block_offsets(self, seed, density, exit_gen):
+        g = text_grid.generate(32, 32, seed=seed, density=density)
+        cfg = GameConfig(gen_limit=200)
+        got = engine.simulate(g, cfg, kernel="packed")
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations == exit_gen, (seed, density)
+        assert np.array_equal(got.grid, want.grid), (seed, density)
