@@ -228,7 +228,14 @@ def main(argv: list[str] | None = None) -> int:
         "--kernel", default=None, help="auto | lax | pallas | packed (default: best)"
     )
     parser.add_argument("--mesh", default=None, help="RxC device mesh (default: single)")
-    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument(
+        "--repeats",
+        type=int,
+        default=5,
+        help="timed runs; the metric takes the best (the remote-attach tunnel "
+        "adds tens of ms of per-call dispatch jitter, so more repeats tighten "
+        "the min)",
+    )
     parser.add_argument(
         "--verify",
         action="store_true",

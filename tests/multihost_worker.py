@@ -48,9 +48,12 @@ def main() -> int:
 
     height = width = 64
     config = GameConfig(gen_limit=40)
-    # One device per process: the mesh's col axis IS the process boundary,
-    # so the E/W halo ppermute crosses processes every generation.
-    mesh = make_mesh(1, nprocs)
+    # One device per process: mesh axes ARE process boundaries, so the halo
+    # ppermute crosses processes every generation — E/W only for a 1xN world,
+    # both axes for a 2x2 world (the full Cartesian topology of
+    # src/game_mpi_collective.c:125-133 with one rank per host).
+    rows = 2 if nprocs == 4 else 1
+    mesh = make_mesh(rows, nprocs // rows)
 
     for kernel in ("lax", "packed"):
         device_grid = sharded.read_sharded(

@@ -29,13 +29,19 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_cluster_matches_oracle(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_process_cluster_matches_oracle(tmp_path, nprocs):
+    """2 processes = 1x2 mesh (E/W halo crosses processes); 4 = 2x2 mesh
+    (both halo axes cross processes — the full Cartesian topology)."""
     g = text_grid.generate(64, 64, seed=3)
     text_grid.write_grid(str(tmp_path / "input.txt"), g)
     port = _free_port()
 
     env = dict(os.environ)
-    # The workers form their own 2-device world; the parent's 8-virtual-CPU
+    # The workers form their own n-device world; the parent's 8-virtual-CPU
     # flag must not multiply each worker's device count.
     env["XLA_FLAGS"] = " ".join(
         f
@@ -44,18 +50,18 @@ def test_two_process_cluster_matches_oracle(tmp_path):
     )
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(port), str(pid), "2", str(tmp_path)],
+            [sys.executable, _WORKER, str(port), str(pid), str(nprocs), str(tmp_path)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=360)
             outs.append(out)
     finally:
         # Never leak workers: a hung/died peer leaves the other blocked in a
