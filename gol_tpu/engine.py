@@ -113,17 +113,46 @@ def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound):
     def body(state):
         cur, gen, counter, alive, similar = state
         t = jnp.minimum(jnp.int32(K), bound - gen + 1)
-
-        def sub(i, carry):
-            cur, a_vec, s_vec = carry
-            new, alive_local, similar_local = _generation(cur, kernel, topology)
-            a_vec = a_vec.at[i].set(alive_local.astype(jnp.int32))
-            if config.check_similarity:
-                s_vec = s_vec.at[i].set(similar_local.astype(jnp.int32))
-            return new, a_vec, s_vec
-
         zeros = jnp.zeros((K,), jnp.int32)
-        cur, a_vec, s_vec = jax.lax.fori_loop(0, t, sub, (cur, zeros, zeros))
+
+        def single_gen(slot_base):
+            # One generation, flags recorded at slot_base + i.
+            def sub(i, carry):
+                cur, a_vec, s_vec = carry
+                new, alive_local, similar_local = _generation(cur, kernel, topology)
+                a_vec = a_vec.at[slot_base + i].set(alive_local.astype(jnp.int32))
+                if config.check_similarity:
+                    s_vec = s_vec.at[slot_base + i].set(similar_local.astype(jnp.int32))
+                return new, a_vec, s_vec
+
+            return sub
+
+        if kernel.fused_multi is not None:
+            # Temporally-blocked passes (T generations per kernel call; the
+            # runner factory strips fused_multi when the shape/topology
+            # can't), then a single-generation tail for the t % T remainder.
+            # Flags land at vector slots T*j..T*j+T-1 / t-rem..t-1, so the
+            # scalar replay below is oblivious to the grouping.
+            T = kernel.multi_gens
+
+            def sub_multi(j, carry):
+                cur, a_vec, s_vec = carry
+                new, a_flags, s_flags = kernel.fused_multi(cur, topology)
+                a_vec = jax.lax.dynamic_update_slice(a_vec, a_flags, (T * j,))
+                if config.check_similarity:
+                    s_vec = jax.lax.dynamic_update_slice(s_vec, s_flags, (T * j,))
+                return new, a_vec, s_vec
+
+            cur, a_vec, s_vec = jax.lax.fori_loop(
+                0, t // T, sub_multi, (cur, zeros, zeros)
+            )
+            cur, a_vec, s_vec = jax.lax.fori_loop(
+                0, t % T, single_gen(t - (t % T)), (cur, a_vec, s_vec)
+            )
+        else:
+            cur, a_vec, s_vec = jax.lax.fori_loop(
+                0, t, single_gen(0), (cur, zeros, zeros)
+            )
         # One vector vote per block instead of one scalar vote per generation.
         # (On a single device the collectives pass the int32 vectors through;
         # normalize to bool so the while carry keeps one dtype.) The
@@ -299,6 +328,15 @@ def _build_runner(
     report = _REPORT[config.convention]
     encode = None if packed_state else kernel_obj.encode
     decode = None if packed_state else kernel_obj.decode
+    if kernel_obj.fused_multi is not None and (
+        config.convention != Convention.C
+        or not kernel_obj.supports_multi(local_h, local_w, topology)
+    ):
+        # The temporally-blocked pass only serves the blocked C-convention
+        # loop (CUDA's break-before-swap keeps pre-step state, which a fused
+        # multi-pass would have overwritten) and only where the kernel
+        # supports it.
+        kernel_obj = dataclasses.replace(kernel_obj, fused_multi=None)
 
     if segmented:
 

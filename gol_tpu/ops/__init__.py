@@ -40,6 +40,11 @@ class Kernel:
     supports: Callable = lambda height, width, topology: True
     encode: Callable | None = None  # uint8 grid -> carried state
     decode: Callable | None = None  # carried state -> uint8 grid
+    # Optional temporally-blocked pass: (cur, Topology) -> (new_after_T_gens,
+    # alive_vec, similar_vec) with int32 (multi_gens,) per-generation flags.
+    fused_multi: Callable | None = None
+    multi_gens: int = 1
+    supports_multi: Callable = lambda height, width, topology: False
 
 
 def lax_evolve(cur, topology: Topology):
@@ -68,6 +73,9 @@ def _registry() -> dict[str, Kernel]:
             supports=stencil_packed.supports,
             encode=stencil_packed.encode,
             decode=stencil_packed.decode,
+            fused_multi=stencil_packed.packed_step_multi,
+            multi_gens=stencil_packed.TEMPORAL_GENS,
+            supports_multi=stencil_packed.supports_multi,
         )
     except ImportError:  # pragma: no cover - pallas unavailable on some backends
         pass

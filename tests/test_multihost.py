@@ -15,6 +15,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from gol_tpu import oracle
 from gol_tpu.config import GameConfig
@@ -27,9 +28,6 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
-
-
-import pytest
 
 
 @pytest.mark.parametrize("nprocs", [2, 4])

@@ -191,3 +191,35 @@ def test_distributed_packed_glider_crosses_shard_and_word_seams():
     got = engine.simulate(g, config, mesh=mesh, kernel="packed")
     np.testing.assert_array_equal(got.grid, expect.grid)
     assert got.generations == expect.generations
+
+
+@pytest.mark.parametrize("shape", [(8, 32), (32, 128), (64, 256), (48, 96)])
+def test_temporal_kernel_matches_oracle(shape):
+    """The T=4 temporal Pallas band kernel in interpret mode: roll-seam
+    garbage must never reach the interior, per-generation flags must match
+    the oracle for every fused generation."""
+    rng = np.random.default_rng(17)
+    g = rng.integers(0, 2, size=shape, dtype=np.uint8)
+    new_w, alive, similar = sp._step_t(sp.encode(jnp.asarray(g)), interpret=True)
+    states = [g]
+    for _ in range(sp.TEMPORAL_GENS):
+        states.append(oracle.evolve(states[-1]))
+    np.testing.assert_array_equal(np.asarray(sp.decode(new_w)), states[-1])
+    for t in range(sp.TEMPORAL_GENS):
+        assert int(alive[t]) == int(states[t + 1].any()), t
+        assert int(similar[t]) == int(np.array_equal(states[t + 1], states[t])), t
+
+
+def test_temporal_kernel_still_life_and_empty_flags():
+    # Still life: similar flags all set from gen 1; lone cell: dead after gen
+    # 1, alive flags 0 throughout, grid stays empty (fixed point).
+    g = np.zeros((16, 64), np.uint8)
+    g[4:6, 4:6] = 1
+    new_w, alive, similar = sp._step_t(sp.encode(jnp.asarray(g)), interpret=True)
+    np.testing.assert_array_equal(np.asarray(sp.decode(new_w)), g)
+    assert all(int(a) == 1 for a in alive) and all(int(s) == 1 for s in similar)
+    g = np.zeros((16, 64), np.uint8)
+    g[3, 3] = 1
+    new_w, alive, similar = sp._step_t(sp.encode(jnp.asarray(g)), interpret=True)
+    assert not np.asarray(sp.decode(new_w)).any()
+    assert all(int(a) == 0 for a in alive)
