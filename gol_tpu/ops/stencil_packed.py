@@ -209,7 +209,10 @@ TEMPORAL_GENS = 4
 _BANDT_BYTES = 512 << 10
 
 
-def _bandt_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *, band: int):
+def _bandt_kernel(
+    main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref,
+    *, band: int, interior=None,
+):
     """TEMPORAL_GENS generations per VMEM pass (temporal blocking).
 
     Each generation is computed over the full (band+16)-row extended block
@@ -219,6 +222,11 @@ def _bandt_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *
     up to 8 fused generations. Per-generation flags accumulate in SMEM so
     the engine's blocked termination replay stays per-generation exact
     (mid-pass exits are fixed points — see engine._simulate_c_block).
+
+    ``interior`` = (row_lo, row_hi, col_lo, col_hi), absolute over the whole
+    array: when the array is a ghost-extended shard block (the distributed
+    temporal pass), the flags must see only the shard's own cells — ghost
+    rows/columns hold neighbor data and frontier garbage.
     """
     i = pl.program_id(0)
     x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
@@ -238,12 +246,23 @@ def _bandt_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *
         )
 
     prev = main_ref[:]
+    mask = None
+    if interior is not None:
+        row_lo, row_hi, col_lo, col_hi = interior
+        r = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 0) + i * band
+        c = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
+        mask = (r >= row_lo) & (r < row_hi) & (c >= col_lo) & (c < col_hi)
     flags = []
     for _ in range(TEMPORAL_GENS):
         x = evolve_full(x)
         g = x[8 : band + 8]
-        alive = jnp.max(jnp.where(g != 0, 1, 0))
-        similar = 1 - jnp.max(jnp.where((g ^ prev) != 0, 1, 0))
+        live = g != 0
+        diff = (g ^ prev) != 0
+        if mask is not None:
+            live = mask & live
+            diff = mask & diff
+        alive = jnp.max(jnp.where(live, 1, 0))
+        similar = 1 - jnp.max(jnp.where(diff, 1, 0))
         flags.append((alive, similar))
         prev = g
     out_ref[:] = prev
@@ -261,15 +280,15 @@ def _bandt_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *
             similar_ref[0, t] = similar_ref[0, t] & similar
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _step_t(words: jnp.ndarray, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("interpret", "interior"))
+def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
     height, nwords = words.shape
     band = _pick_band(height, nwords, _BANDT_BYTES)
     bb = band // _SUBLANES
     nb = height // _SUBLANES
     T = TEMPORAL_GENS
     new, alive, similar = pl.pallas_call(
-        functools.partial(_bandt_kernel, band=band),
+        functools.partial(_bandt_kernel, band=band, interior=interior),
         grid=(height // band,),
         in_specs=[
             pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -310,14 +329,72 @@ _MAX_WORDS_T = 4 << 10
 
 
 def supports_multi(height: int, width: int, topology) -> bool:
-    """The temporally-blocked pass: single device only (one ppermute'd ghost
-    row per side cannot feed multiple generations), same shape rules as
-    ``supports`` plus a VMEM-driven width cap."""
+    """The temporally-blocked pass: same shape rules as ``supports`` plus a
+    VMEM-driven width cap; distributed shards additionally need the
+    8-aligned Pallas height (the deep-halo assembly has no jnp-network
+    escape hatch for odd heights — those fall back to the per-generation
+    fused path)."""
+    if width // _BITS > _MAX_WORDS_T or not supports(height, width, topology):
+        return False
+    if not topology.distributed:
+        return True
+    return height % _SUBLANES == 0 and height >= 2 * TEMPORAL_GENS
+
+
+def exchange_packed_deep(words: jnp.ndarray, topology: Topology) -> jnp.ndarray:
+    """Deep two-phase halo feeding TEMPORAL_GENS generations at once.
+
+    The wide-ghost-zone trade on the reference's per-generation 16-request
+    exchange (src/game_mpi.c:340-401): TEMPORAL_GENS ghost word rows N/S,
+    then whole ghost word *columns* E/W over the row-extended range (corners
+    ride along, the src/game_cuda.cu:64-74 trick). One exchange per
+    TEMPORAL_GENS generations — 4x fewer, larger messages, a win where
+    halos are latency-bound. The 32-bit ghost word column carries enough
+    cross-seam context because the invalid frontier advances one bit per
+    generation from its far edge (32 >> TEMPORAL_GENS).
+
+    Returns the (h + 2*TEMPORAL_GENS, nwords + 2) extended block.
+    """
+    rows, _cols = topology.shape
+    row_axis = ROW_AXIS if topology.distributed else None
+    top, bot = halo.ghost_slices(words, 0, row_axis, rows, depth=TEMPORAL_GENS)
+    xr = jnp.concatenate([top, words, bot], axis=0)
+    gwest, geast = halo.exchange_columns(xr[:, 0], xr[:, -1], topology)
+    return jnp.concatenate([gwest[:, None], xr, geast[:, None]], axis=1)
+
+
+def _jnp_multi(state, prev0, interior):
+    """The T-generation jnp flag loop shared by both off-TPU branches:
+    evolve ``state`` T times, reading flags from its ``interior`` slice
+    against the previous interior generation."""
+    alive, similar, prev = [], [], prev0
+    for _ in range(TEMPORAL_GENS):
+        state = packed_math.evolve_torus_words(state)
+        g = state[interior]
+        alive.append(jnp.any(g != 0))
+        similar.append(jnp.all(g == prev))
+        prev = g
     return (
-        not topology.distributed
-        and width // _BITS <= _MAX_WORDS_T
-        and supports(height, width, topology)
+        prev,
+        jnp.stack(alive).astype(jnp.int32),
+        jnp.stack(similar).astype(jnp.int32),
     )
+
+
+def _distributed_step_multi(words: jnp.ndarray, topology: Topology):
+    """Shard-local temporal pass: deep halo, then TEMPORAL_GENS generations
+    on the ghost-extended block with flags masked to the shard interior."""
+    T = TEMPORAL_GENS
+    h, nwords = words.shape
+    xe = exchange_packed_deep(words, topology)
+    if jax.default_backend() != "tpu":
+        # Identical math at jnp level: torus rolls over the extended block
+        # wrap garbage only into the invalid frontier (never the interior).
+        return _jnp_multi(
+            xe, words, (slice(T, T + h), slice(1, nwords + 1))
+        )
+    new_ext, a_vec, s_vec = _step_t(xe, interior=(T, T + h, 1, nwords + 1))
+    return new_ext[T : T + h, 1 : nwords + 1], a_vec, s_vec
 
 
 def packed_step_multi(cur: jnp.ndarray, topology: Topology):
@@ -325,25 +402,18 @@ def packed_step_multi(cur: jnp.ndarray, topology: Topology):
     ``words -> (words_T, alive_vec, similar_vec)``.
 
     Flag vectors are int32 ``(TEMPORAL_GENS,)``, one entry per generation in
-    order — exactly what the engine's blocked replay consumes. Off-TPU this
-    is TEMPORAL_GENS jnp evolves (identical math); on TPU it is the
-    temporally-blocked band kernel.
+    order — exactly what the engine's blocked replay consumes. Off-TPU the
+    compute is the jnp adder network (identical math); on TPU it is the
+    temporally-blocked band kernel. Distributed shards run the deep-halo
+    form (one exchange per TEMPORAL_GENS generations).
     """
     height, nwords = cur.shape
     if not supports_multi(height, nwords * _BITS, topology):
-        raise ValueError("packed_step_multi requires a single-device supported shape")
+        raise ValueError("packed_step_multi requires a supported shape/topology")
+    if topology.distributed:
+        return _distributed_step_multi(cur, topology)
     if jax.default_backend() != "tpu":
-        alive, similar, prev = [], [], cur
-        for _ in range(TEMPORAL_GENS):
-            g = packed_math.evolve_torus_words(prev)
-            alive.append(jnp.any(g != 0))
-            similar.append(jnp.all(g == prev))
-            prev = g
-        return (
-            prev,
-            jnp.stack(alive).astype(jnp.int32),
-            jnp.stack(similar).astype(jnp.int32),
-        )
+        return _jnp_multi(cur, cur, (slice(None), slice(None)))
     return _step_t(cur)
 
 

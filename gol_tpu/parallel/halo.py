@@ -36,11 +36,13 @@ def ring_perms(size: int) -> tuple[list, list]:
 
 
 def ghost_slices(
-    x: jnp.ndarray, axis: int, axis_name: str | None, size: int
+    x: jnp.ndarray, axis: int, axis_name: str | None, size: int, depth: int = 1
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """The two 1-wide ghost slices along ``axis`` (torus wrap across shards)."""
-    first = jax.lax.slice_in_dim(x, 0, 1, axis=axis)
-    last = jax.lax.slice_in_dim(x, x.shape[axis] - 1, x.shape[axis], axis=axis)
+    """The two ``depth``-wide ghost slices along ``axis`` (torus wrap across
+    shards). ``depth > 1`` is the wide-ghost-zone trade: one exchange feeds
+    ``depth`` generations (shard extent must be >= depth)."""
+    first = jax.lax.slice_in_dim(x, 0, depth, axis=axis)
+    last = jax.lax.slice_in_dim(x, x.shape[axis] - depth, x.shape[axis], axis=axis)
     if axis_name is None or size == 1:
         # Wrap is local: my own far edge is my ghost (src/game_cuda.cu:52-74).
         return last, first

@@ -223,3 +223,42 @@ def test_temporal_kernel_still_life_and_empty_flags():
     new_w, alive, similar = sp._step_t(sp.encode(jnp.asarray(g)), interpret=True)
     assert not np.asarray(sp.decode(new_w)).any()
     assert all(int(a) == 0 for a in alive)
+
+
+@pytest.mark.parametrize("shape", [(16, 64), (32, 128), (48, 96)])
+def test_distributed_temporal_kernel_interpret(shape):
+    """The deep-halo temporal form: ghost-extended block + interior-masked
+    flags, compiled via interpret mode (local torus wrap = 1x1 topology)."""
+    rng = np.random.default_rng(23)
+    g = rng.integers(0, 2, size=shape, dtype=np.uint8)
+    h, nwords = shape[0], shape[1] // 32
+    T = sp.TEMPORAL_GENS
+    xe = sp.exchange_packed_deep(sp.encode(jnp.asarray(g)), SINGLE_DEVICE)
+    assert xe.shape == (h + 2 * T, nwords + 2)
+    new_ext, alive, similar = sp._step_t(
+        xe, interpret=True, interior=(T, T + h, 1, nwords + 1)
+    )
+    got = np.asarray(sp.decode(new_ext[T : T + h, 1 : nwords + 1]))
+    states = [g]
+    for _ in range(T):
+        states.append(oracle.evolve(states[-1]))
+    np.testing.assert_array_equal(got, states[-1])
+    for t in range(T):
+        assert int(alive[t]) == int(states[t + 1].any()), t
+        assert int(similar[t]) == int(np.array_equal(states[t + 1], states[t])), t
+
+
+def test_distributed_temporal_flags_ignore_ghosts():
+    # A lone block near the seam: ghost rows/columns hold live neighbor
+    # copies, but masked flags must still report the interior truth.
+    g = np.zeros((16, 64), np.uint8)
+    g[0:2, 0:2] = 1  # still life touching the wrap seam
+    xe = sp.exchange_packed_deep(sp.encode(jnp.asarray(g)), SINGLE_DEVICE)
+    T = sp.TEMPORAL_GENS
+    new_ext, alive, similar = sp._step_t(
+        xe, interpret=True, interior=(T, T + 16, 1, 3)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sp.decode(new_ext[T : T + 16, 1 : 3])), g
+    )
+    assert all(int(a) == 1 for a in alive) and all(int(s) == 1 for s in similar)
