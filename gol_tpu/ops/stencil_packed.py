@@ -198,14 +198,14 @@ def _step(words: jnp.ndarray, interpret: bool = False):
 # Temporal blocking: generations fused per VMEM pass, and the band target for
 # that kernel's larger live set. The 8-row aligned wrap blocks over-fetch far
 # more halo than one generation needs (16 ghost rows support up to 8 fused
-# generations). Measured on v5e at 16384^2 the T=4 pass ranges from parity
-# with the single-gen kernel (compute-bound states) to ~1.3x (HBM-bound
-# states; the attached chip's effective throughput drifts ~2x between
-# sessions, so interleaved A/B was used); at 65536^2 — where HBM traffic
-# weighs heaviest — it is a consistent 1.3x (config-5 execution 35s -> 26.4s).
-# Bands below ~256 rows lose ~10% to per-band grid overhead; 512KB keeps the
-# band >= 64 rows through the width cap below.
-TEMPORAL_GENS = 4
+# generations), so T=8 uses the whole validity budget: vs the single-gen
+# kernel the T=4 pass measured parity-to-1.3x and T=8 adds another ~2% at
+# 16384^2 (compute-bound) and ~11% at 65536^2 (HBM-weighted) — net-of-
+# dispatch interleaved A/B on v5e, chain-length differencing to cancel the
+# attach tunnel's ~90ms fixed round trip. Bands below ~256 rows lose ~10%
+# to per-band grid overhead; 512KB keeps the band >= 64 rows through the
+# width cap below.
+TEMPORAL_GENS = 8
 _BANDT_BYTES = 512 << 10
 
 
@@ -348,7 +348,7 @@ def exchange_packed_deep(words: jnp.ndarray, topology: Topology) -> jnp.ndarray:
     exchange (src/game_mpi.c:340-401): TEMPORAL_GENS ghost word rows N/S,
     then whole ghost word *columns* E/W over the row-extended range (corners
     ride along, the src/game_cuda.cu:64-74 trick). One exchange per
-    TEMPORAL_GENS generations — 4x fewer, larger messages, a win where
+    TEMPORAL_GENS generations — TEMPORAL_GENS-times fewer, larger messages, a win where
     halos are latency-bound. The 32-bit ghost word column carries enough
     cross-seam context because the invalid frontier advances one bit per
     generation from its far edge (32 >> TEMPORAL_GENS).

@@ -195,7 +195,7 @@ def test_distributed_packed_glider_crosses_shard_and_word_seams():
 
 @pytest.mark.parametrize("shape", [(8, 32), (32, 128), (64, 256), (48, 96)])
 def test_temporal_kernel_matches_oracle(shape):
-    """The T=4 temporal Pallas band kernel in interpret mode: roll-seam
+    """The temporal Pallas band kernel in interpret mode: roll-seam
     garbage must never reach the interior, per-generation flags must match
     the oracle for every fused generation."""
     rng = np.random.default_rng(17)
