@@ -202,11 +202,12 @@ def _step(words: jnp.ndarray, interpret: bool = False):
 # kernel the T=4 pass measured parity-to-1.3x and T=8 adds another ~2% at
 # 16384^2 (compute-bound) and ~11% at 65536^2 (HBM-weighted) — net-of-
 # dispatch interleaved A/B on v5e, chain-length differencing to cancel the
-# attach tunnel's ~90ms fixed round trip. Bands below ~256 rows lose ~10%
-# to per-band grid overhead; 512KB keeps the band >= 64 rows through the
-# width cap below.
+# attach tunnel's ~90ms fixed round trip. The 1MB band target halves the
+# 16-ghost-row over-fetch fraction vs 512KB (another +12% at 16384^2, +14%
+# at 65536^2) and still compiles + matches the oracle at the width cap
+# below (band floors at 64 rows there).
 TEMPORAL_GENS = 8
-_BANDT_BYTES = 512 << 10
+_BANDT_BYTES = 1 << 20
 
 
 def _bandt_kernel(
@@ -323,8 +324,12 @@ def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
 
 # Width cap for the temporal kernel: its live set spans (band+16)-row planes,
 # so at very wide rows even the minimum band exceeds scoped VMEM (e.g. 32768
-# words: 24 rows x 128KB x ~12 live planes = 36MB). 4096 words (width 2^17)
-# keeps the worst case ~9MB; wider falls back to the single-gen kernel.
+# words: 24 rows x 128KB x ~12 live planes = 36MB). At 4096 words (width
+# 2^17) the 1MB band target floors at 64 rows: 80-row planes x 16KB x ~12
+# live = ~15MB — verified to compile and match the oracle on v5e, but with
+# only ~1MB scoped-VMEM headroom; raising _MAX_WORDS_T or adding a live
+# plane needs a matching _BANDT_BYTES cut. Wider falls back to the
+# single-gen kernel.
 _MAX_WORDS_T = 4 << 10
 
 
