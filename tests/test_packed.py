@@ -262,3 +262,30 @@ def test_distributed_temporal_flags_ignore_ghosts():
         np.asarray(sp.decode(new_ext[T : T + 16, 1 : 3])), g
     )
     assert all(int(a) == 1 for a in alive) and all(int(s) == 1 for s in similar)
+
+
+def test_mesh_engine_runs_deep_halo_temporal_pass(monkeypatch):
+    """A mesh run's hot loop is the deep-halo temporal pass, not the
+    per-generation fallback: gen_limit >= TEMPORAL_GENS makes the blocked
+    loop take at least one fused multi-generation step per block."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    calls = []
+    real = sp._distributed_step_multi
+
+    def spy(words, topology):
+        calls.append(tuple(words.shape))
+        return real(words, topology)
+
+    monkeypatch.setattr(sp, "_distributed_step_multi", spy)
+    engine.make_runner.cache_clear()
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(31)
+    g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+    lim = 2 * sp.TEMPORAL_GENS + 1
+    got = engine.simulate(g, GameConfig(gen_limit=lim), mesh=mesh, kernel="packed")
+    expect = oracle.run(g, GameConfig(gen_limit=lim))
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+    assert calls and calls[0] == (32, 2)  # 32-row, 2-word local shard
+    engine.make_runner.cache_clear()
