@@ -232,10 +232,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--size",
         type=int,
-        default=16384,
-        help="grid side length (default: the BASELINE config-4 grid — large "
-        "enough to amortize the ~80ms fixed per-call dispatch, measured "
-        "faster per cell than 8192 or 32768 on one v5e)",
+        default=32768,
+        help="grid side length (default: the largest grid whose uint8 lane "
+        "fits HBM beside the word buffers — at the T=8 kernel's rate the "
+        "~90ms fixed per-call tunnel dispatch still eats ~15%% of a run, "
+        "so bigger beats 16384 or 8192 per cell; 65536 needs "
+        "--packed-state, which --config 5 implies)",
     )
     parser.add_argument("--gen-limit", type=int, default=1000)
     parser.add_argument(
@@ -276,6 +278,14 @@ def main(argv: list[str] | None = None) -> int:
         help="kernel-only table: every single-chip evolve path at --size "
         "(Pallas band kernels vs jnp fallbacks vs lax)",
     )
+    parser.add_argument(
+        "--packed-state",
+        action="store_true",
+        help="carry bitpacked uint32 word state end-to-end (the engine form "
+        "behind the CLI's --packed-io): the uint8 grid never exists, so "
+        "grids whose byte form exceeds HBM (65536^2) still bench; implied "
+        "by --config 5; excludes --verify",
+    )
     args = parser.parse_args(argv)
     _honor_platform_env()
 
@@ -290,6 +300,9 @@ def main(argv: list[str] | None = None) -> int:
             5: (65536, "4x4", 10000),
         }[args.config]
         args.size, args.mesh, args.gen_limit = preset
+        if args.config == 5:
+            # 65536^2 as bytes is 4.3GB — past HBM next to the word buffers.
+            args.packed_state = True
         import jax
 
         n = len(jax.devices())
@@ -303,12 +316,13 @@ def main(argv: list[str] | None = None) -> int:
                 )
                 args.mesh = None
 
+    if (args.compare or args.packed_state) and args.size % 32 != 0:
+        # After --config unpacking so presets are covered too.
+        print(f"word-state lanes (--compare/--packed-state) need --size "
+              f"divisible by 32 (the packed word width), got {args.size}",
+              file=sys.stderr)
+        return 1
     if args.compare:
-        # After --config unpacking so presets apply to the table too.
-        if args.size % 32 != 0:
-            print(f"--compare needs --size divisible by 32 (the packed word "
-                  f"width), got {args.size}", file=sys.stderr)
-            return 1
         return _bench_compare(args)
 
     if args.halo:
@@ -327,23 +341,55 @@ def main(argv: list[str] | None = None) -> int:
         mesh = make_mesh(r, c)
         n_chips = r * c
 
-    kernel = resolve_kernel_name(args.kernel, args.size, mesh)
+    if args.packed_state and (args.verify or args.config == 1):
+        print("--packed-state has no oracle lane; drop --verify "
+              "(--config 1 implies the oracle check)", file=sys.stderr)
+        return 1
+    if args.packed_state and args.kernel not in (None, "packed"):
+        # Word state admits only the packed kernel; mirror the CLI's loud
+        # --packed-io + --kernel rejection rather than silently ignoring.
+        print(f"--packed-state runs the packed kernel; drop --kernel "
+              f"{args.kernel}", file=sys.stderr)
+        return 1
+
+    kernel = (
+        "packed" if args.packed_state
+        else resolve_kernel_name(args.kernel, args.size, mesh)
+    )
     platform = jax.devices()[0].platform
     print(
         f"bench: {args.size}x{args.size}, gen_limit={args.gen_limit}, "
-        f"kernel={kernel}, platform={platform}, chips={n_chips}",
+        f"kernel={'packed-state' if args.packed_state else kernel}, "
+        f"platform={platform}, chips={n_chips}",
         file=sys.stderr,
     )
 
     rng = np.random.default_rng(42)
-    grid = rng.integers(0, 2, size=(args.size, args.size), dtype=np.uint8)
     # Random soup never stabilizes within 1000 generations, so the full
     # GEN_LIMIT runs with the similarity machinery still on the critical path
     # (the honest workload: src/game.c:6-9 constants, all checks enabled).
     config = GameConfig(gen_limit=args.gen_limit)
 
-    device_grid = engine.put_grid(grid, mesh)
-    runner = engine.make_runner(grid.shape, config, mesh, kernel)
+    if args.packed_state:
+        # Uniform random words == uniform random cells; 32x less host memory
+        # and transfer than the byte grid (512MB vs 4.3GB at 65536^2).
+        words = rng.integers(
+            0, np.iinfo(np.uint32).max, size=(args.size, args.size // 32),
+            dtype=np.uint32, endpoint=True,
+        )
+        import jax.numpy as jnp
+
+        from gol_tpu.parallel.mesh import grid_sharding
+
+        device_grid = (
+            jax.device_put(words, grid_sharding(mesh))
+            if mesh is not None else jnp.asarray(words)
+        )
+        runner = engine.make_packed_runner((args.size, args.size), config, mesh)
+    else:
+        grid = rng.integers(0, 2, size=(args.size, args.size), dtype=np.uint8)
+        device_grid = engine.put_grid(grid, mesh)
+        runner = engine.make_runner(grid.shape, config, mesh, kernel)
     compiled = runner.lower(device_grid).compile()
 
     best_s = float("inf")
@@ -384,6 +430,11 @@ def main(argv: list[str] | None = None) -> int:
                 "value": value,
                 "unit": "cells/s/chip",
                 "vs_baseline": value / TARGET_CELL_UPDATES_PER_SEC_PER_CHIP,
+                # workload pin: round-over-round values are only comparable
+                # at the same grid (the default moved 8192 -> 16384 -> 32768
+                # across rounds as the kernels outgrew dispatch overhead)
+                "grid": f"{args.size}x{args.size}",
+                "chips": n_chips,
             }
         )
     )
