@@ -1,6 +1,7 @@
 """VT100 renderer parity + bootstrap no-op + halo bench smoke."""
 
 import io
+import json
 import os
 import subprocess
 import sys
@@ -38,7 +39,8 @@ def test_bootstrap_noop_without_optin(monkeypatch):
     assert not bootstrap.is_multihost()
 
 
-def test_bench_halo_smoke():
+def _run_bench(*flags: str) -> dict:
+    """Run bench.py on an 8-virtual-CPU host and parse its JSON line."""
     env = {
         **os.environ,
         "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
@@ -46,16 +48,33 @@ def test_bench_halo_smoke():
     }
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     r = subprocess.run(
-        [sys.executable, os.path.join(repo, "bench.py"), "--halo", "--size", "64",
-         "--mesh", "2x4", "--repeats", "1"],
+        [sys.executable, os.path.join(repo, "bench.py"), *flags],
         capture_output=True,
         text=True,
         env=env,
         cwd=repo,
     )
     assert r.returncode == 0, r.stderr
-    import json
+    return json.loads(r.stdout.strip().splitlines()[-1])
 
-    line = json.loads(r.stdout.strip().splitlines()[-1])
+
+def test_bench_halo_smoke():
+    line = _run_bench("--halo", "--size", "64", "--mesh", "2x4", "--repeats", "1")
     assert line["metric"] == "halo_exchange_p50_latency"
+    assert line["value"] > 0
+
+
+def test_bench_packed_state_smoke():
+    """The packed-state lane (bench.py --packed-state, implied by --config 5)
+    runs the word-state engine end-to-end — here on an 8-virtual-CPU 2x4
+    mesh, with a generation count past TEMPORAL_GENS so the deep-halo fused
+    pass (not just the single-generation tail) is the path exercised."""
+    from gol_tpu.ops import stencil_packed as sp
+
+    line = _run_bench(
+        "--packed-state", "--size", "128", "--mesh", "2x4",
+        "--gen-limit", str(sp.TEMPORAL_GENS + 2), "--repeats", "1",
+    )
+    assert line["metric"] == "cell_updates_per_sec_per_chip"
+    assert line["grid"] == "128x128" and line["chips"] == 8
     assert line["value"] > 0
