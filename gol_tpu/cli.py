@@ -69,6 +69,34 @@ def _parse_mesh_arg(spec: str | None, distributed: bool):
     return make_mesh(devices=jax.devices())
 
 
+def _warn_if_huge_byte_lane(width: int, height: int, mesh=None) -> bool:
+    """Steer 2GB+-per-device byte-lane runs toward --packed-io before XLA OOMs.
+
+    The byte lane carries two uint8 buffers through the loop; at 2GB+ of
+    cells per device that flirts with (65536^2 single-chip: exceeds) a 16GB
+    chip's HBM, and the XLA OOM it dies with names no remedy. The packed
+    lane is 32x smaller — say so up front, but only where --packed-io would
+    actually accept the shape (width divisible by 32 x mesh cols,
+    io/packed_io.py). Returns whether the warning fired."""
+    devices = cols = 1
+    if mesh is not None:
+        devices = mesh.devices.size
+        from gol_tpu.parallel.mesh import COL_AXIS
+
+        cols = mesh.shape[COL_AXIS]
+    per_device = width * height // devices
+    if per_device < (2 << 30) or width % (32 * cols) != 0:
+        return False
+    print(
+        f"warning: {width}x{height} as bytes is "
+        f"{per_device / (1 << 30):.1f} GB per buffer per device; "
+        "if this runs out of device memory, use --packed-io "
+        "(bit-packed state, 32x smaller)",
+        file=sys.stderr,
+    )
+    return True
+
+
 def _read_phase(variant: Variant, path: str, width: int, height: int, mesh):
     if variant.io == "serial":
         return engine.put_grid(text_grid.read_grid(path, width, height), mesh)
@@ -145,6 +173,8 @@ def _run(args) -> int:
                 f"{args.kernel!r} contradicts it"
             )
         return _run_packed_io(args, variant, config, width, height, output_path, mesh)
+
+    _warn_if_huge_byte_lane(width, height, mesh)
 
     t0 = time.perf_counter()
     device_grid = _read_phase(variant, args.input_file, width, height, mesh)

@@ -180,3 +180,24 @@ class TestGenerate:
         g = text_grid.read_grid(str(src), 16, 16)
         want = oracle.run(g, GameConfig(gen_limit=10))
         assert dst.read_bytes() == text_grid.encode(want.grid)
+
+
+def test_huge_byte_lane_warning(capsys):
+    from gol_tpu.cli import _warn_if_huge_byte_lane
+    from gol_tpu.parallel.mesh import make_mesh
+
+    assert _warn_if_huge_byte_lane(65536, 65536)
+    err = capsys.readouterr().err
+    assert "--packed-io" in err and "4.0 GB" in err
+    # Below the per-device threshold, or widths --packed-io would reject
+    # (no packed lane to offer): silent.
+    assert not _warn_if_huge_byte_lane(16384, 16384)
+    assert not _warn_if_huge_byte_lane(65537, 65536)
+    # Sharded over 8 devices the same grid is 512MB/buffer/device — no
+    # warning; and the width gate scales to 32 x mesh cols.
+    mesh = make_mesh(2, 4)
+    assert not _warn_if_huge_byte_lane(65536, 65536, mesh)
+    assert not _warn_if_huge_byte_lane(65536, 262144, make_mesh(1, 3))
+    assert capsys.readouterr().err == ""
+    assert _warn_if_huge_byte_lane(65536, 262144, mesh)
+    assert "2.0 GB" in capsys.readouterr().err
