@@ -77,11 +77,11 @@ def _bench_halo(args) -> int:
     grid = rng.integers(0, 2, size=(args.size, args.size), dtype=np.uint8)
     device_grid = jax.device_put(grid, grid_sharding(mesh))
 
-    def body(x):
-        ext = halo.exchange(x, topo)
+    def consume_edges(ext):
         # Consume ONLY the exchanged boundary (plus a psum of four scalars):
-        # a full-grid reduction would dwarf the two ppermute phases being
-        # measured.
+        # a full-grid reduction would dwarf the ppermute phases being
+        # measured. Shared by the byte and deep-packed measurements so both
+        # consume identical work and stay comparable.
         edge = (
             jnp.sum(ext[0].astype(jnp.int32))
             + jnp.sum(ext[-1].astype(jnp.int32))
@@ -89,6 +89,9 @@ def _bench_halo(args) -> int:
             + jnp.sum(ext[:, -1].astype(jnp.int32))
         )
         return jax.lax.psum(edge, topo.axes)
+
+    def body(x):
+        return consume_edges(halo.exchange(x, topo))
 
     @jax.jit
     def exchange_once(g):
@@ -99,14 +102,51 @@ def _bench_halo(args) -> int:
             out_specs=jax.sharding.PartitionSpec(),
         )(g)
 
-    exchange_once(device_grid).block_until_ready()
-    samples = []
-    for _ in range(max(args.repeats * 10, 30)):
-        t0 = time.perf_counter()
-        int(exchange_once(device_grid))
-        samples.append((time.perf_counter() - t0) * 1e6)
-    p50 = statistics.median(samples)
-    print(f"halo p50 over {len(samples)} runs on {mesh.shape}", file=sys.stderr)
+    def timed_p50(fn, arg):
+        fn(arg).block_until_ready()
+        samples = []
+        for _ in range(max(args.repeats * 10, 30)):
+            t0 = time.perf_counter()
+            int(fn(arg))
+            samples.append((time.perf_counter() - t0) * 1e6)
+        return statistics.median(samples), len(samples)
+
+    p50, n = timed_p50(exchange_once, device_grid)
+
+    # The flagship's actual halo: the deep (TEMPORAL_GENS-row) packed-word
+    # exchange, one per TEMPORAL_GENS generations. Word state is 32x smaller,
+    # the ghost zone TEMPORAL_GENS x taller; per-generation cost is p50/T.
+    from gol_tpu.ops import packed_math, stencil_packed as sp
+
+    local_h = args.size // topo.shape[0]
+    local_w = args.size // topo.shape[1]
+    deep_p50 = None
+    # Same eligibility the engine uses to route shards onto the deep-halo
+    # temporal pass — measuring it for shapes the flagship would route to
+    # the per-generation path would be a number for a path never taken.
+    if sp.supports_multi(local_h, local_w, topo):
+        spec = jax.sharding.PartitionSpec(*MESH_TOPOLOGY_AXES)
+        words = jax.jit(
+            jax.shard_map(packed_math.encode, mesh=mesh,
+                          in_specs=spec, out_specs=spec)
+        )(device_grid)
+
+        def deep_body(w):
+            return consume_edges(sp.exchange_packed_deep(w, topo))
+
+        @jax.jit
+        def deep_once(w):
+            return jax.shard_map(deep_body, mesh=mesh,
+                                 in_specs=spec,
+                                 out_specs=jax.sharding.PartitionSpec())(w)
+
+        deep_p50, _ = timed_p50(deep_once, words)
+        deep_msg = (f"; deep packed exchange {deep_p50:.1f} us per "
+                    f"{sp.TEMPORAL_GENS} generations")
+    else:
+        deep_msg = " (shard shape not deep-halo eligible; byte exchange only)"
+
+    print(f"halo p50 over {n} runs on {mesh.shape}{deep_msg}", file=sys.stderr)
     print(
         json.dumps(
             {
@@ -116,6 +156,8 @@ def _bench_halo(args) -> int:
                 # No published halo baseline exists (BASELINE.md): null, not a
                 # fake ratio.
                 "vs_baseline": None,
+                "deep_packed_exchange_p50_us": deep_p50,
+                "deep_exchange_feeds_generations": sp.TEMPORAL_GENS,
             }
         )
     )
