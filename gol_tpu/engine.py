@@ -89,6 +89,77 @@ def _similarity_vote(fire, cur, new, similar_local, topology: Topology):
 _TERMINATION_BLOCK = 16
 
 
+def _block_generations(start, t, config, topology, kernel):
+    """Run ``t`` generations from ``start``, voting flags once for the block.
+
+    The shared machinery of both conventions' blocked loops: temporally-
+    blocked fused_multi passes (T generations per kernel call; the runner
+    factory strips fused_multi when the shape/topology can't) with a
+    single-generation tail for the ``t % T`` remainder — flags land at
+    vector slots T*j..T*j+T-1 / t-rem..t-1, so the callers' scalar replays
+    are oblivious to the grouping. Returns ``(cur, a_all, s_all)``: the
+    block-end state and the K-slot voted flag vectors (one vector vote per
+    block instead of one scalar vote per generation; on a single device the
+    collectives pass the int32 vectors through — normalize to bool so loop
+    carries keep one dtype). ``s_all`` is None when the similarity check is
+    disabled (the vote is dropped entirely).
+    """
+    zeros = jnp.zeros((_TERMINATION_BLOCK,), jnp.int32)
+
+    def single_gen(slot_base):
+        # One generation, flags recorded at slot_base + i.
+        def sub(i, carry):
+            cur, a_vec, s_vec = carry
+            new, alive_local, similar_local = _generation(cur, kernel, topology)
+            a_vec = a_vec.at[slot_base + i].set(alive_local.astype(jnp.int32))
+            if config.check_similarity:
+                s_vec = s_vec.at[slot_base + i].set(similar_local.astype(jnp.int32))
+            return new, a_vec, s_vec
+
+        return sub
+
+    if kernel.fused_multi is not None:
+        T = kernel.multi_gens
+
+        def sub_multi(j, carry):
+            cur, a_vec, s_vec = carry
+            new, a_flags, s_flags = kernel.fused_multi(cur, topology)
+            a_vec = jax.lax.dynamic_update_slice(a_vec, a_flags, (T * j,))
+            if config.check_similarity:
+                s_vec = jax.lax.dynamic_update_slice(s_vec, s_flags, (T * j,))
+            return new, a_vec, s_vec
+
+        cur, a_vec, s_vec = jax.lax.fori_loop(
+            0, t // T, sub_multi, (start, zeros, zeros)
+        )
+        cur, a_vec, s_vec = jax.lax.fori_loop(
+            0, t % T, single_gen(t - (t % T)), (cur, a_vec, s_vec)
+        )
+    else:
+        cur, a_vec, s_vec = jax.lax.fori_loop(
+            0, t, single_gen(0), (start, zeros, zeros)
+        )
+    a_all = collectives.any_flag(a_vec, topology).astype(jnp.bool_)
+    s_all = (
+        collectives.all_agree(s_vec, topology).astype(jnp.bool_)
+        if config.check_similarity
+        else None
+    )
+    return cur, a_all, s_all
+
+
+def _replay_similarity(counter, freq, s_all, i, check: bool):
+    """One replayed generation's similarity outcome: ``(similar_i, counter')``.
+
+    The counter fires every ``freq``-th generation and resets on fire —
+    shared by both conventions' scalar replays (their surrounding exit
+    semantics differ; this firing rule does not)."""
+    if not check:
+        return jnp.asarray(False), counter
+    fire = (counter + 1) == freq
+    return fire & s_all[i], jnp.where(fire, 0, counter + 1)
+
+
 def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound):
     """Blocked C-convention loop: K generations per flag sync, bit-exact.
 
@@ -113,64 +184,14 @@ def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound):
     def body(state):
         cur, gen, counter, alive, similar = state
         t = jnp.minimum(jnp.int32(K), bound - gen + 1)
-        zeros = jnp.zeros((K,), jnp.int32)
-
-        def single_gen(slot_base):
-            # One generation, flags recorded at slot_base + i.
-            def sub(i, carry):
-                cur, a_vec, s_vec = carry
-                new, alive_local, similar_local = _generation(cur, kernel, topology)
-                a_vec = a_vec.at[slot_base + i].set(alive_local.astype(jnp.int32))
-                if config.check_similarity:
-                    s_vec = s_vec.at[slot_base + i].set(similar_local.astype(jnp.int32))
-                return new, a_vec, s_vec
-
-            return sub
-
-        if kernel.fused_multi is not None:
-            # Temporally-blocked passes (T generations per kernel call; the
-            # runner factory strips fused_multi when the shape/topology
-            # can't), then a single-generation tail for the t % T remainder.
-            # Flags land at vector slots T*j..T*j+T-1 / t-rem..t-1, so the
-            # scalar replay below is oblivious to the grouping.
-            T = kernel.multi_gens
-
-            def sub_multi(j, carry):
-                cur, a_vec, s_vec = carry
-                new, a_flags, s_flags = kernel.fused_multi(cur, topology)
-                a_vec = jax.lax.dynamic_update_slice(a_vec, a_flags, (T * j,))
-                if config.check_similarity:
-                    s_vec = jax.lax.dynamic_update_slice(s_vec, s_flags, (T * j,))
-                return new, a_vec, s_vec
-
-            cur, a_vec, s_vec = jax.lax.fori_loop(
-                0, t // T, sub_multi, (cur, zeros, zeros)
-            )
-            cur, a_vec, s_vec = jax.lax.fori_loop(
-                0, t % T, single_gen(t - (t % T)), (cur, a_vec, s_vec)
-            )
-        else:
-            cur, a_vec, s_vec = jax.lax.fori_loop(
-                0, t, single_gen(0), (cur, zeros, zeros)
-            )
-        # One vector vote per block instead of one scalar vote per generation.
-        # (On a single device the collectives pass the int32 vectors through;
-        # normalize to bool so the while carry keeps one dtype.) The
-        # similarity vote is dropped entirely when the check is disabled.
-        a_all = collectives.any_flag(a_vec, topology).astype(jnp.bool_)
-        if config.check_similarity:
-            s_all = collectives.all_agree(s_vec, topology).astype(jnp.bool_)
+        cur, a_all, s_all = _block_generations(cur, t, config, topology, kernel)
 
         def replay(i, c):
             gen, counter, alive, similar, stopped = c
             ran = jnp.logical_not(stopped) & (i < t)
-            if config.check_similarity:
-                fire = (counter + 1) == freq
-                sim_i = fire & s_all[i]
-                counter_n = jnp.where(fire, 0, counter + 1)
-            else:
-                sim_i = jnp.asarray(False)
-                counter_n = counter
+            sim_i, counter_n = _replay_similarity(
+                counter, freq, s_all, i, config.check_similarity
+            )
             alive_n = a_all[i]
             gen_n = jnp.where(sim_i, gen, gen + 1)
             gen = jnp.where(ran, gen_n, gen)
@@ -246,6 +267,69 @@ def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel, re
     return final, gen, counter, stopped
 
 
+def _simulate_cuda_block(grid, config, topology, kernel, gen0, counter0, bound):
+    """Blocked CUDA-convention loop: K generations per flag sync, bit-exact.
+
+    The CUDA loop's break-before-swap (src/game_cuda.cu:250,266) keeps the
+    *pre-step* state on exit, which a fused multi-generation pass has
+    overwritten — but the two exits differ in kind. A similarity exit means
+    ``state_i == state_{i+1}``: a still life, so every overrun generation is
+    identical and the block-end state IS the exit state. Only the empty exit
+    keeps a non-fixed-point state (the last non-empty generation), so that
+    rare case — at most once per run — replays ``i`` single generations from
+    the saved block-start state. Counts replay exactly like the C block.
+    """
+    K = _TERMINATION_BLOCK
+    freq = jnp.int32(config.similarity_frequency)
+
+    def cond(state):
+        _, gen, _, stop = state
+        return jnp.logical_not(stop) & (gen < bound)
+
+    def body(state):
+        start, gen, counter, _ = state
+        t = jnp.minimum(jnp.int32(K), bound - gen)
+        cur, a_all, s_all = _block_generations(start, t, config, topology, kernel)
+
+        # Scalar replay: flag entry i is (alive, similar) of the *new* grid
+        # of CUDA iteration i — exactly what its per-generation checks read
+        # (src/game_cuda.cu:238-268). On the stop iteration gen does not
+        # advance (break precedes gen++ via the swap skip).
+        def replay(i, c):
+            gen, counter, stopped, exit_i, exit_empty = c
+            ran = jnp.logical_not(stopped) & (i < t)
+            sim_i, counter_n = _replay_similarity(
+                counter, freq, s_all, i, config.check_similarity
+            )
+            empty_i = jnp.logical_not(a_all[i])
+            stop_i = sim_i | empty_i
+            gen = jnp.where(ran & jnp.logical_not(stop_i), gen + 1, gen)
+            counter = jnp.where(ran, counter_n, counter)
+            newly = ran & stop_i
+            exit_i = jnp.where(newly, i, exit_i)
+            exit_empty = jnp.where(newly, empty_i & jnp.logical_not(sim_i), exit_empty)
+            stopped = stopped | newly
+            return gen, counter, stopped, exit_i, exit_empty
+
+        gen, counter, stopped, exit_i, exit_empty = jax.lax.fori_loop(
+            0, K, replay,
+            (gen, counter, jnp.asarray(False), jnp.int32(0), jnp.asarray(False)),
+        )
+        # Empty exit at iteration i keeps state_i (the last non-empty
+        # generation): replay i plain generations from the block start.
+        cur = jax.lax.cond(
+            stopped & exit_empty,
+            lambda: jax.lax.fori_loop(
+                0, exit_i, lambda j, g: _generation(g, kernel, topology)[0], start
+            ),
+            lambda: cur,
+        )
+        return (cur, gen, counter, stopped)
+
+    state0 = (grid, jnp.int32(gen0), jnp.int32(counter0), jnp.asarray(False))
+    return jax.lax.while_loop(cond, body, state0)
+
+
 def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel, resume=None):
     """CUDA-variant loop (src/game_cuda.cu:222-276).
 
@@ -254,11 +338,20 @@ def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel,
     empty exit keeps the last non-empty generation; reported count is the raw
     counter. Checks scan the interior only — deliberately not the binary's
     stale-halo padded scan; see gol_tpu.oracle._run_cuda.
+
+    Fused kernels take the blocked loop (``_simulate_cuda_block``), K
+    generations per flag sync, bit-exact with this per-generation form.
     """
     limit = jnp.int32(config.gen_limit)
     freq = jnp.int32(config.similarity_frequency)
     gen0, counter0, seg_end = resume if resume is not None else (0, 0, limit)
     bound = jnp.minimum(limit, jnp.int32(seg_end))
+
+    if kernel.fused is not None:
+        final, gen, counter, stop = _simulate_cuda_block(
+            grid, config, topology, kernel, gen0, counter0, bound
+        )
+        return final, gen, counter, stop | (gen >= limit)
 
     def cond(state):
         _, gen, _, stop = state
@@ -328,14 +421,13 @@ def _build_runner(
     report = _REPORT[config.convention]
     encode = None if packed_state else kernel_obj.encode
     decode = None if packed_state else kernel_obj.decode
-    if kernel_obj.fused_multi is not None and (
-        config.convention != Convention.C
-        or not kernel_obj.supports_multi(local_h, local_w, topology)
+    if kernel_obj.fused_multi is not None and not kernel_obj.supports_multi(
+        local_h, local_w, topology
     ):
-        # The temporally-blocked pass only serves the blocked C-convention
-        # loop (CUDA's break-before-swap keeps pre-step state, which a fused
-        # multi-pass would have overwritten) and only where the kernel
-        # supports it.
+        # The temporally-blocked pass only where the kernel supports it.
+        # Both conventions consume it: the C block replays exits from flag
+        # vectors (fixed points), the CUDA block additionally recovers the
+        # pre-step state on empty exits (_simulate_cuda_block).
         kernel_obj = dataclasses.replace(kernel_obj, fused_multi=None)
 
     if segmented:

@@ -189,3 +189,51 @@ class TestBlockTermination:
         want = oracle.run(g, cfg)
         assert got.generations == want.generations == exit_gen, (seed, density)
         assert np.array_equal(got.grid, want.grid), (seed, density)
+
+
+class TestCudaBlockTermination:
+    """Pins the blocked CUDA-convention loop (engine._simulate_cuda_block):
+    both exit kinds at varied offsets within the 16-generation vote block,
+    including the empty-exit recovery replay (break-before-swap keeps the
+    last non-empty generation, src/game_cuda.cu:259-268)."""
+
+    @pytest.mark.parametrize("gen_limit", [1, 15, 16, 17, 31, 33, 48])
+    def test_bound_straddles_blocks(self, gen_limit):
+        g = text_grid.generate(64, 64, seed=5)  # soup: no early exit
+        cfg = GameConfig(gen_limit=gen_limit, convention=Convention.CUDA)
+        got = engine.simulate(g, cfg, kernel="packed")
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations == gen_limit
+        assert np.array_equal(got.grid, want.grid)
+
+    # Seeds chosen by oracle search: empty exits at in-block iterations
+    # 0,1,3,5,7,9,12,13 (each replays that many recovery generations; seed
+    # 166 exits mid-run so the replay starts from a non-initial block) plus
+    # similarity exits at several offsets.
+    @pytest.mark.parametrize(
+        "seed,density,exit_gen",
+        [
+            (2, 0.04, 0), (0, 0.04, 1), (101, 0.04, 3), (40, 0.04, 5),
+            (189, 0.04, 7), (142, 0.08, 9), (16, 0.06, 12), (210, 0.06, 13),
+            (166, 0.06, 72),  # empty exits
+            (91, 0.04, 17), (177, 0.08, 131), (27, 0.04, 5), (200, 0.18, 176),
+        ],
+    )
+    def test_early_exits_at_varied_block_offsets(self, seed, density, exit_gen):
+        g = text_grid.generate(32, 32, seed=seed, density=density)
+        cfg = GameConfig(gen_limit=200, convention=Convention.CUDA)
+        got = engine.simulate(g, cfg, kernel="packed")
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations == exit_gen, (seed, density)
+        assert np.array_equal(got.grid, want.grid), (seed, density)
+
+    def test_empty_exit_recovery_on_mesh(self):
+        # The recovery replay runs per-shard under shard_map: the cond
+        # predicate is psum-uniform, so every shard takes the same branch.
+        g = text_grid.generate(64, 32, seed=72, density=0.03)  # dies at gen 4
+        cfg = GameConfig(gen_limit=200, convention=Convention.CUDA)
+        got = engine.simulate(g, cfg, mesh=make_mesh(2, 2), kernel="packed")
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations == 4
+        assert got.grid.any()  # last non-empty generation, not the empty one
+        assert np.array_equal(got.grid, want.grid)

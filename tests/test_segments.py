@@ -58,14 +58,33 @@ def test_segmented_early_exits_cross_boundaries(segment, convention):
     assert gens == expect.generations
 
 
-def test_segmented_packed_kernel():
+@pytest.mark.parametrize("convention", [Convention.C, Convention.CUDA])
+def test_segmented_packed_kernel(convention):
+    """The blocked loops under resume scalars (nonzero gen0/counter0): the
+    fused packed kernel takes _simulate_c_block / _simulate_cuda_block, so
+    segment boundaries land mid-vote-block in both conventions."""
     rng = np.random.default_rng(17)
     g = rng.integers(0, 2, size=(32, 128), dtype=np.uint8)
-    config = GameConfig(gen_limit=30)
+    config = GameConfig(gen_limit=30, convention=convention)
     expect = oracle.run(g, config)
     gens, final, _ = _segmented_final(g, config, 7, kernel="packed")
     np.testing.assert_array_equal(final, expect.grid)
     assert gens == expect.generations
+
+
+@pytest.mark.parametrize("segment", [1, 3, 5, 100])
+def test_segmented_cuda_empty_exit_recovery(segment):
+    """A mid-run CUDA empty exit (break-before-swap keeps the last non-empty
+    generation) through the blocked loop's recovery replay, with the exit
+    landing inside different resumed segments."""
+    g = text_grid.generate(32, 32, seed=166, density=0.06)  # dies at gen 72
+    config = GameConfig(gen_limit=200, convention=Convention.CUDA)
+    expect = oracle.run(g, config)
+    assert expect.grid.any()  # sanity: the kept state is the non-empty one
+    gens, final, stopped = _segmented_final(g, config, segment, kernel="packed")
+    np.testing.assert_array_equal(final, expect.grid)
+    assert gens == expect.generations == 72
+    assert stopped
 
 
 def test_cli_snapshots(tmp_path, monkeypatch):
