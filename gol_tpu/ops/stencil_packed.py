@@ -202,12 +202,14 @@ def _step(words: jnp.ndarray, interpret: bool = False):
 # kernel the T=4 pass measured parity-to-1.3x and T=8 adds another ~2% at
 # 16384^2 (compute-bound) and ~11% at 65536^2 (HBM-weighted) — net-of-
 # dispatch interleaved A/B on v5e, chain-length differencing to cancel the
-# attach tunnel's ~90ms fixed round trip. The 1MB band target halves the
-# 16-ghost-row over-fetch fraction vs 512KB (another +12% at 16384^2, +14%
-# at 65536^2) and still compiles + matches the oracle at the width cap
-# below (band floors at 64 rows there).
+# attach tunnel's ~90ms fixed round trip. Each doubling of the band target
+# shrinks the 16-ghost-row over-fetch fraction: 512KB -> 1MB gained
+# +12%/+14% (16384^2/65536^2), 1MB -> 2MB another +6%/+8% (2.99/2.63
+# Tcells/s); 4MB fails to compile at 65536^2 (512-row bands), so 2MB is
+# the ceiling. At the width cap below the 2MB target means 128-row bands —
+# verified to compile and match the oracle at (1024, 2^17).
 TEMPORAL_GENS = 8
-_BANDT_BYTES = 1 << 20
+_BANDT_BYTES = 2 << 20
 
 
 def _bandt_kernel(
@@ -322,13 +324,14 @@ def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
     return new, alive[0], similar[0]
 
 
-# Width cap for the temporal kernel: its live set spans (band+16)-row planes,
-# so at very wide rows even the minimum band exceeds scoped VMEM (e.g. 32768
-# words: 24 rows x 128KB x ~12 live planes = 36MB). At 4096 words (width
-# 2^17) the 1MB band target floors at 64 rows: 80-row planes x 16KB x ~12
-# live = ~15MB — verified to compile and match the oracle on v5e, but with
-# only ~1MB scoped-VMEM headroom; raising _MAX_WORDS_T or adding a live
-# plane needs a matching _BANDT_BYTES cut. Wider falls back to the
+# Width cap for the temporal kernel: its live set spans (band+16)-row
+# planes, so at very wide rows even the minimum band exceeds scoped VMEM
+# (32768 words: 24-row blocks x 128KB rows failed to compile when probed).
+# At the 4096-word cap (width 2^17) the 2MB target's 128-row bands compile
+# and match the oracle on v5e — the naive all-planes-live estimate says
+# ~27MB, so Mosaic's liveness is evidently tighter; treat compile-at-cap as
+# the empirical gate and re-probe (1024, 2^17) when raising _MAX_WORDS_T,
+# _BANDT_BYTES, or the network's live set. Wider falls back to the
 # single-gen kernel.
 _MAX_WORDS_T = 4 << 10
 
