@@ -40,16 +40,18 @@ from gol_tpu.parallel.mesh import ROW_AXIS, Topology
 
 _BITS = packed_math.BITS
 _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
-# Word-count cap: ~10 live uint32 temporaries mean even the minimum 8-row band
-# costs ~320*nwords bytes of VMEM. Empirical limit on v5e: 32768 words
-# (width 2^20) compiles and matches the oracle, 65536 VMEM-OOMs at compile.
+# Word-count cap: the kernel's live temporaries scale with nwords, so very
+# wide rows exhaust scoped VMEM regardless of band height. Empirical limit
+# on v5e: 32768 words (width 2^20) compiles and matches the oracle with the
+# width-aware 1MB band target (_pick_band), 65536 VMEM-OOMs at compile.
 _MAX_WORDS = 32 << 10
-# Target VMEM bytes for one band of packed words; the ~10 live temporaries of
-# the adder network and the double-buffered in/out blocks sit beside it.
-# Scoped VMEM is 16MB on v5e and total usage scales at ~8x the band: 1MB
-# measures fastest (1.49e12 cells/s marginal at 16384^2, +11% over 256KB);
-# 2MB OOMs the scoped allocator.
-_BAND_BYTES = 1 << 20
+# Target VMEM bytes for one band of packed words; the adder network's live
+# temporaries and the double-buffered in/out blocks sit beside it. Measured
+# at 16384^2 on v5e (interleaved A/B, net of dispatch): 1MB beat 256KB by
+# +11%, and 2MB beats 1MB by another ~7% (2.73 Tcells/s marginal) — the
+# "2MB OOMs" note from the pre-row-sum-sharing network no longer holds
+# after its live set shrank.
+_BAND_BYTES = 2 << 20
 
 # Re-exported for the kernel registry: the engine packs/unpacks at the loop
 # boundary through these.
@@ -72,8 +74,14 @@ def supports(height: int, width: int, topology) -> bool:
     return height % _SUBLANES == 0 and height >= _SUBLANES
 
 
-def _pick_band(height: int, words: int, target_bytes: int = _BAND_BYTES) -> int:
+def _pick_band(height: int, words: int, target_bytes: int | None = None) -> int:
     row_bytes = max(words * 4, 1)
+    if target_bytes is None:
+        # Width-aware default: the kernel's live set scales with the band, so
+        # 64KB+ rows (16K+ words) keep the 1MB target whose band sizes were
+        # compile-validated up to the _MAX_WORDS cap; 2MB 16-row bands at
+        # 32768 words fail to compile.
+        target_bytes = _BAND_BYTES if row_bytes < (64 << 10) else (1 << 20)
     target = max(_SUBLANES, min(height, target_bytes // row_bytes))
     for band in range(target, _SUBLANES - 1, -1):
         if height % band == 0 and band % _SUBLANES == 0:
