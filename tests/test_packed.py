@@ -289,3 +289,19 @@ def test_mesh_engine_runs_deep_halo_temporal_pass(monkeypatch):
     assert got.generations == expect.generations
     assert calls and calls[0] == (32, 2)  # 32-row, 2-word local shard
     engine.make_runner.cache_clear()
+
+
+def test_pick_band_width_aware_target():
+    """Wide rows (64KB+, i.e. 16K+ words) keep the compile-validated 1MB
+    band target; narrower rows get the full 2MB target (bands clamp to
+    height and 8-row alignment either way)."""
+    # 512 words = 2KB rows: 2MB target -> 1024-row bands.
+    assert sp._pick_band(16384, 512) == 1024
+    # 16384 words = 64KB rows: clamped to 1MB -> 16-row bands.
+    assert sp._pick_band(64, 16384) == 16
+    # 32768 words = 128KB rows: 1MB -> the minimum 8-row bands.
+    assert sp._pick_band(64, 32768) == 8
+    # Short grids clamp to height.
+    assert sp._pick_band(8, 512) == 8
+    # Explicit targets bypass the width-aware default (the temporal kernel).
+    assert sp._pick_band(64, 32768, 4 << 20) == 32
