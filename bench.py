@@ -221,6 +221,15 @@ def _bench_compare(args) -> int:
                 "words",
                 sp.TEMPORAL_GENS,
             )
+            # What a pod shard actually runs: deep-halo assembly (local
+            # wrap standing in for ppermute'd neighbors) + the temporal
+            # pass on the ghost-extended block — the honest per-chip proxy
+            # for flagship mesh throughput.
+            paths["packed-dist-temporal"] = (
+                lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0],
+                "words",
+                sp.TEMPORAL_GENS,
+            )
 
     device_grid = jnp.asarray(grid)
     device_words = jax.jit(sp.encode)(device_grid)

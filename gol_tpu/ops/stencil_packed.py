@@ -75,7 +75,10 @@ def supports(height: int, width: int, topology) -> bool:
 
 
 def _pick_band(height: int, words: int, target_bytes: int | None = None) -> int:
-    row_bytes = max(words * 4, 1)
+    # VMEM rows are padded to full 128-lane tiles: a 3-word strip still
+    # occupies 512 bytes per row on chip, so narrow arrays must budget by
+    # the padded width or a whole-height band blows scoped VMEM.
+    row_bytes = max(words, 128) * 4
     if target_bytes is None:
         # Width-aware default: the kernel's live set scales with the band, so
         # 64KB+ rows (16K+ words) keep the 1MB target whose band sizes were
@@ -221,8 +224,7 @@ _BANDT_BYTES = 2 << 20
 
 
 def _bandt_kernel(
-    main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref,
-    *, band: int, interior=None,
+    *refs, band: int, interior=None, ghosts: bool = False,
 ):
     """TEMPORAL_GENS generations per VMEM pass (temporal blocking).
 
@@ -235,26 +237,73 @@ def _bandt_kernel(
     (mid-pass exits are fixed points — see engine._simulate_c_block).
 
     ``interior`` = (row_lo, row_hi, col_lo, col_hi), absolute over the whole
-    array: when the array is a ghost-extended shard block (the distributed
-    temporal pass), the flags must see only the shard's own cells — ghost
-    rows/columns hold neighbor data and frontier garbage.
+    array: when the array holds ghost rows/columns (the distributed temporal
+    pass), the flags must see only the shard's own cells.
+
+    ``ghosts`` adds three banded (·, 128) operands carrying the ppermute'd
+    E/W ghost word columns (west in lane 0, east in lane 1). Each
+    generation patches the two edge words' cross-seam neighbor words from
+    those lanes and evolves both ghost columns in ONE extra adder-network
+    pass over the combined plane — their outer-side inputs are garbage,
+    which advances one bit per generation from the far edge of the 32-bit
+    word, so the carry bits stay exact for TEMPORAL_GENS <= 8. This keeps
+    the main block at its natural lane width: concatenating ghost columns
+    instead costs an extra 128-lane tile per band wherever nwords is a
+    tile multiple (measured 35% at 16384^2).
     """
+    if ghosts:
+        (main_ref, top_ref, bot_ref, g_ref, gt_ref, gb_ref,
+         out_ref, alive_ref, similar_ref) = refs
+    else:
+        main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref = refs
     i = pl.program_id(0)
     x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
     nwords = x.shape[1]
     rows = x.shape[0]  # band + 16
+    if ghosts:
+        # One (rows, 128) plane carries BOTH ghost columns: west in lane 0,
+        # east in lane 1 — they evolve in a single adder-network pass.
+        G = jnp.concatenate([gt_ref[:], g_ref[:], gb_ref[:]], axis=0)
+        glanes = jax.lax.broadcasted_iota(jnp.int32, G.shape, 1)
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (rows, nwords), 1)
 
-    def evolve_full(x):
+    def vcombine(m0, m1, s0, s1, mid):
+        return packed_math.combine(
+            pltpu.roll(s0, 1, 0), pltpu.roll(s1, 1, 0),
+            pltpu.roll(s0, rows - 1, 0), pltpu.roll(s1, rows - 1, 0),
+            m0, m1, mid,
+        )
+
+    def evolve_full(x, G):
         # Torus column wrap via lane rolls; row wrap via sublane rolls whose
         # wrapped-in rows are garbage only at the extended block's two ends.
         left = pltpu.roll(x, 1 % nwords, 1)
         right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+        if ghosts:
+            # Cross-seam neighbor words for the two edge lanes.
+            gw = G[:, 0:1]
+            ge = G[:, 1:2]
+            left = jnp.where(lanes == 0, jnp.broadcast_to(gw, (rows, nwords)), left)
+            right = jnp.where(
+                lanes == nwords - 1, jnp.broadcast_to(ge, (rows, nwords)), right
+            )
         m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
-        return packed_math.combine(
-            pltpu.roll(s0, 1, 0), pltpu.roll(s1, 1, 0),
-            pltpu.roll(s0, rows - 1, 0), pltpu.roll(s1, rows - 1, 0),
-            m0, m1, x,
-        )
+        new_x = vcombine(m0, m1, s0, s1, x)
+        if not ghosts:
+            return new_x, G
+        # Evolve the ghost plane from current-generation values: the west
+        # ghost's east neighbor is shard word 0, the east ghost's west
+        # neighbor is shard word nwords-1; their outer-side inputs are
+        # garbage (zeros) that never crosses the 32-bit word within 8
+        # generations.
+        x0 = x[:, 0:1]
+        xl = x[:, nwords - 1 : nwords]
+        zero = jnp.zeros_like(G)
+        g_left = jnp.where(glanes == 1, jnp.broadcast_to(xl, G.shape), zero)
+        g_right = jnp.where(glanes == 0, jnp.broadcast_to(x0, G.shape), zero)
+        m0g, m1g, s0g, s1g = packed_math.row_sums(G, g_left, g_right)
+        new_G = vcombine(m0g, m1g, s0g, s1g, G)
+        return new_x, new_G
 
     prev = main_ref[:]
     mask = None
@@ -264,8 +313,9 @@ def _bandt_kernel(
         c = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
         mask = (r >= row_lo) & (r < row_hi) & (c >= col_lo) & (c < col_hi)
     flags = []
+    G_c = G if ghosts else None
     for _ in range(TEMPORAL_GENS):
-        x = evolve_full(x)
+        x, G_c = evolve_full(x, G_c)
         g = x[8 : band + 8]
         live = g != 0
         diff = (g ^ prev) != 0
@@ -291,29 +341,39 @@ def _bandt_kernel(
             similar_ref[0, t] = similar_ref[0, t] & similar
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "interior"))
-def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
-    height, nwords = words.shape
-    band = _pick_band(height, nwords, _BANDT_BYTES)
+def _banded_specs(band: int, nwords: int, nb: int):
+    """The (main, top-wrap, bot-wrap) BlockSpec triple every temporal
+    operand uses: a band-aligned block plus the 8-row neighbor blocks
+    wrapped modulo the whole array."""
     bb = band // _SUBLANES
+    return [
+        pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec(
+            (_SUBLANES, nwords),
+            lambda i: ((i * bb - 1) % nb, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (_SUBLANES, nwords),
+            lambda i: ((i * bb + bb) % nb, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+
+
+def _temporal_call(operands, *, band, height, nwords, interior, ghosts, interpret):
+    """Shared pallas_call scaffolding of the two temporal entry points."""
     nb = height // _SUBLANES
     T = TEMPORAL_GENS
+    in_specs = _banded_specs(band, nwords, nb)
+    if ghosts:
+        in_specs += _banded_specs(band, 128, nb)
     new, alive, similar = pl.pallas_call(
-        functools.partial(_bandt_kernel, band=band, interior=interior),
+        functools.partial(
+            _bandt_kernel, band=band, interior=interior, ghosts=ghosts
+        ),
         grid=(height // band,),
-        in_specs=[
-            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (_SUBLANES, nwords),
-                lambda i: ((i * bb - 1) % nb, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (_SUBLANES, nwords),
-                lambda i: ((i * bb + bb) % nb, 0),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
@@ -328,8 +388,42 @@ def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(words, words, words)
+    )(*operands)
     return new, alive[0], similar[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "interior"))
+def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
+    height, nwords = words.shape
+    band = _pick_band(height, nwords, _BANDT_BYTES)
+    return _temporal_call(
+        (words, words, words),
+        band=band, height=height, nwords=nwords,
+        interior=interior, ghosts=False, interpret=interpret,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _step_tg(xr: jnp.ndarray, gwest: jnp.ndarray, geast: jnp.ndarray,
+             interpret: bool = False):
+    """Temporal pass over a row-extended shard block with E/W ghost operands.
+
+    ``xr`` is (h + 2T, nwords) — the shard plus TEMPORAL_GENS ghost rows per
+    side; ``gwest``/``geast`` are its (h + 2T,) ghost word columns. Returns
+    the same-shape evolved block plus flag vectors masked to the shard
+    interior (rows [T, T+h), all words — the in-kernel carry patching keeps
+    every shard word exact, unlike the concatenated ghost-column form).
+    """
+    height, nwords = xr.shape
+    T = TEMPORAL_GENS
+    h = height - 2 * T
+    band = _pick_band(height, nwords, _BANDT_BYTES)
+    G = jnp.pad(jnp.stack([gwest, geast], axis=1), ((0, 0), (0, 126)))
+    return _temporal_call(
+        (xr, xr, xr, G, G, G),
+        band=band, height=height, nwords=nwords,
+        interior=(T, T + h, 0, nwords), ghosts=True, interpret=interpret,
+    )
 
 
 # Width cap for the temporal kernel: its live set spans (band+16)-row
@@ -357,7 +451,7 @@ def supports_multi(height: int, width: int, topology) -> bool:
     return height % _SUBLANES == 0 and height >= 2 * TEMPORAL_GENS
 
 
-def exchange_packed_deep(words: jnp.ndarray, topology: Topology) -> jnp.ndarray:
+def exchange_packed_deep_parts(words: jnp.ndarray, topology: Topology):
     """Deep two-phase halo feeding TEMPORAL_GENS generations at once.
 
     The wide-ghost-zone trade on the reference's per-generation 16-request
@@ -369,13 +463,21 @@ def exchange_packed_deep(words: jnp.ndarray, topology: Topology) -> jnp.ndarray:
     cross-seam context because the invalid frontier advances one bit per
     generation from its far edge (32 >> TEMPORAL_GENS).
 
-    Returns the (h + 2*TEMPORAL_GENS, nwords + 2) extended block.
+    Returns ``(xr, gwest, geast)``: the (h + 2T, nwords) row-extended block
+    and the two (h + 2T,) ghost word columns.
     """
     rows, _cols = topology.shape
     row_axis = ROW_AXIS if topology.distributed else None
     top, bot = halo.ghost_slices(words, 0, row_axis, rows, depth=TEMPORAL_GENS)
     xr = jnp.concatenate([top, words, bot], axis=0)
     gwest, geast = halo.exchange_columns(xr[:, 0], xr[:, -1], topology)
+    return xr, gwest, geast
+
+
+def exchange_packed_deep(words: jnp.ndarray, topology: Topology) -> jnp.ndarray:
+    """``exchange_packed_deep_parts`` assembled into one
+    (h + 2*TEMPORAL_GENS, nwords + 2) extended block."""
+    xr, gwest, geast = exchange_packed_deep_parts(words, topology)
     return jnp.concatenate([gwest[:, None], xr, geast[:, None]], axis=1)
 
 
@@ -399,18 +501,21 @@ def _jnp_multi(state, prev0, interior):
 
 def _distributed_step_multi(words: jnp.ndarray, topology: Topology):
     """Shard-local temporal pass: deep halo, then TEMPORAL_GENS generations
-    on the ghost-extended block with flags masked to the shard interior."""
+    with flags masked to the shard interior — the ghost word columns ride
+    as kernel operands (lane-0 planes patched into the edge words' carries
+    each generation) so the main block keeps its natural lane width."""
     T = TEMPORAL_GENS
     h, nwords = words.shape
-    xe = exchange_packed_deep(words, topology)
     if jax.default_backend() != "tpu":
         # Identical math at jnp level: torus rolls over the extended block
         # wrap garbage only into the invalid frontier (never the interior).
+        xe = exchange_packed_deep(words, topology)
         return _jnp_multi(
             xe, words, (slice(T, T + h), slice(1, nwords + 1))
         )
-    new_ext, a_vec, s_vec = _step_t(xe, interior=(T, T + h, 1, nwords + 1))
-    return new_ext[T : T + h, 1 : nwords + 1], a_vec, s_vec
+    xr, gwest, geast = exchange_packed_deep_parts(words, topology)
+    new_ext, a_vec, s_vec = _step_tg(xr, gwest, geast)
+    return new_ext[T : T + h], a_vec, s_vec
 
 
 def packed_step_multi(cur: jnp.ndarray, topology: Topology):
