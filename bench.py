@@ -283,12 +283,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--size",
         type=int,
-        default=32768,
-        help="grid side length (default: the largest grid whose uint8 lane "
-        "fits HBM beside the word buffers — at the T=8 kernel's rate the "
-        "~90ms fixed per-call tunnel dispatch still eats ~15%% of a run, "
-        "so bigger beats 16384 or 8192 per cell; 65536 needs "
-        "--packed-state, which --config 5 implies)",
+        default=None,
+        help="grid side length (default: 65536 on the packed-state lane — "
+        "the BASELINE.md north-star grid, and the best amortization of the "
+        "~90ms fixed per-call tunnel dispatch, measured +22%% over the byte "
+        "lane's 32768 HBM ceiling; --compare/--halo/--verify and explicit "
+        "--kernel default to 16384 on the byte lane instead)",
     )
     parser.add_argument("--gen-limit", type=int, default=1000)
     parser.add_argument(
@@ -366,6 +366,18 @@ def main(argv: list[str] | None = None) -> int:
                     file=sys.stderr,
                 )
                 args.mesh = None
+
+    if args.size is None:
+        # Default workload (no --size, no --config): the north-star 65536^2
+        # grid on the packed-state lane (the only lane where it fits HBM —
+        # the uint8 form is 4.3GB). Lanes that need the byte grid (kernel
+        # table, halo latency, oracle verification, explicit non-packed
+        # kernels) default to 16384.
+        if args.compare or args.halo or args.verify or args.kernel not in (None, "packed"):
+            args.size = 16384
+        else:
+            args.size = 65536
+            args.packed_state = True
 
     if (args.compare or args.packed_state) and args.size % 32 != 0:
         # After --config unpacking so presets are covered too.
