@@ -278,7 +278,57 @@ def _bench_compare(args) -> int:
     return 0
 
 
-def main(argv: list[str] | None = None) -> int:
+def resolve_workload(args, n_devices: int | None = None) -> None:
+    """Resolve --config presets and the default workload, in that order.
+
+    Mutates ``args`` in place. Order matters (pinned by tests): presets fully
+    determine size/mesh/gen-limit/lane, so the default-size rules only apply
+    when neither --size nor --config was given. ``n_devices`` is injectable
+    for tests; by default it is read from jax lazily and only when a preset
+    names a mesh.
+    """
+    if args.config:
+        # (size, mesh, gen_limit); mesh None = single device. Configs needing
+        # more devices than available fall back to fewer mesh cells loudly.
+        preset = {
+            1: (512, None, 1000),
+            2: (4096, None, 1000),
+            3: (8192, "2x2", 1000),
+            4: (16384, None, 1000),
+            5: (65536, "4x4", 10000),
+        }[args.config]
+        args.size, args.mesh, args.gen_limit = preset
+        if args.config == 5:
+            # 65536^2 as bytes is 4.3GB — past HBM next to the word buffers.
+            args.packed_state = True
+        if args.mesh:
+            if n_devices is None:
+                import jax
+
+                n_devices = len(jax.devices())
+            r, c = (int(x) for x in args.mesh.split("x"))
+            if r * c > n_devices:
+                print(
+                    f"config {args.config} wants a {args.mesh} mesh but only "
+                    f"{n_devices} device(s) are attached; running single-device",
+                    file=sys.stderr,
+                )
+                args.mesh = None
+
+    if args.size is None:
+        # Default workload (no --size, no --config): the north-star 65536^2
+        # grid on the packed-state lane (the only lane where it fits HBM —
+        # the uint8 form is 4.3GB). Lanes that need the byte grid (kernel
+        # table, halo latency, oracle verification, explicit non-packed
+        # kernels) default to 16384.
+        if args.compare or args.halo or args.verify or args.kernel is not None:
+            args.size = 16384
+        else:
+            args.size = 65536
+            args.packed_state = True
+
+
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--size",
@@ -337,47 +387,13 @@ def main(argv: list[str] | None = None) -> int:
         "grids whose byte form exceeds HBM (65536^2) still bench; implied "
         "by --config 5; excludes --verify",
     )
-    args = parser.parse_args(argv)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
     _honor_platform_env()
-
-    if args.config:
-        # (size, mesh, gen_limit); mesh None = single device. Configs needing
-        # more devices than available fall back to fewer mesh cells loudly.
-        preset = {
-            1: (512, None, 1000),
-            2: (4096, None, 1000),
-            3: (8192, "2x2", 1000),
-            4: (16384, None, 1000),
-            5: (65536, "4x4", 10000),
-        }[args.config]
-        args.size, args.mesh, args.gen_limit = preset
-        if args.config == 5:
-            # 65536^2 as bytes is 4.3GB — past HBM next to the word buffers.
-            args.packed_state = True
-        import jax
-
-        n = len(jax.devices())
-        if args.mesh:
-            r, c = (int(x) for x in args.mesh.split("x"))
-            if r * c > n:
-                print(
-                    f"config {args.config} wants a {args.mesh} mesh but only "
-                    f"{n} device(s) are attached; running single-device",
-                    file=sys.stderr,
-                )
-                args.mesh = None
-
-    if args.size is None:
-        # Default workload (no --size, no --config): the north-star 65536^2
-        # grid on the packed-state lane (the only lane where it fits HBM —
-        # the uint8 form is 4.3GB). Lanes that need the byte grid (kernel
-        # table, halo latency, oracle verification, explicit non-packed
-        # kernels) default to 16384.
-        if args.compare or args.halo or args.verify or args.kernel not in (None, "packed"):
-            args.size = 16384
-        else:
-            args.size = 65536
-            args.packed_state = True
+    resolve_workload(args)
 
     if (args.compare or args.packed_state) and args.size % 32 != 0:
         # After --config unpacking so presets are covered too.

@@ -78,3 +78,37 @@ def test_bench_packed_state_smoke():
     assert line["metric"] == "cell_updates_per_sec_per_chip"
     assert line["grid"] == "128x128" and line["chips"] == 8
     assert line["value"] > 0
+
+
+def test_bench_workload_resolution():
+    """resolve_workload's preset-then-default ordering: presets must fully
+    pin their lane (the oracle config stays on the byte lane; config 5
+    implies packed state), and the default workload only applies when
+    neither --size nor --config was given."""
+    import bench  # repo root is on sys.path via conftest
+
+    def resolve(*argv, n_devices=1):
+        args = bench.build_parser().parse_args(list(argv))
+        bench.resolve_workload(args, n_devices=n_devices)
+        return args
+
+    a = resolve()
+    assert (a.size, a.packed_state) == (65536, True)
+    a = resolve("--config", "1")
+    assert (a.size, a.packed_state, a.mesh) == (512, False, None)
+    a = resolve("--config", "3", n_devices=1)
+    assert (a.size, a.packed_state, a.mesh) == (8192, False, None)
+    a = resolve("--config", "3", n_devices=4)
+    assert (a.size, a.mesh) == (8192, "2x2")
+    a = resolve("--config", "5", n_devices=16)
+    assert (a.size, a.packed_state, a.mesh, a.gen_limit) == (
+        65536, True, "4x4", 10000,
+    )
+    for flags in (
+        ["--compare"], ["--halo"], ["--verify"],
+        ["--kernel", "lax"], ["--kernel", "packed"],
+    ):
+        a = resolve(*flags)
+        assert (a.size, a.packed_state) == (16384, False), flags
+    a = resolve("--size", "4096")
+    assert (a.size, a.packed_state) == (4096, False)
