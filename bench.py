@@ -318,9 +318,10 @@ def resolve_workload(args, n_devices: int | None = None) -> None:
     if args.size is None:
         # Default workload (no --size, no --config): the north-star 65536^2
         # grid on the packed-state lane (the only lane where it fits HBM —
-        # the uint8 form is 4.3GB). Lanes that need the byte grid (kernel
-        # table, halo latency, oracle verification, explicit non-packed
-        # kernels) default to 16384.
+        # the uint8 form is 4.3GB). Byte-grid modes (kernel table, halo
+        # latency, oracle verification) and ANY explicit --kernel — packed
+        # included, so kernels are compared on the same byte-lane workload —
+        # default to 16384.
         if args.compare or args.halo or args.verify or args.kernel is not None:
             args.size = 16384
         else:

@@ -497,10 +497,10 @@ def make_runner(
     topology/bootstrap step the reference does with MPI_Init + MPI_Cart_create
     (src/game_mpi_collective.c:116-133) happens here, at trace time.
 
-    The lru_cache key includes the Mesh, which is safe by value: jax interns
-    Mesh instances (equal device grid + axis names => the same object), so
-    two separately-constructed equal meshes hit the same cache entry —
-    pinned by tests/test_engine.py::test_runner_cache_equal_meshes.
+    The lru_cache key includes the Mesh, which is safe by value: Mesh defines
+    __eq__/__hash__ over the device grid + axis names, so two
+    separately-constructed equal meshes hit the same cache entry — pinned by
+    tests/test_engine.py::test_runner_cache_equal_meshes.
     """
     return _build_runner(shape, config, mesh, kernel,
                          segmented=False, packed_state=False)

@@ -21,6 +21,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("GOL_TPU_HW"):
+    jax.config.update("jax_platforms", "cpu")
+# else: hardware lane — leave the attached backend alone so
+# tests/test_tpu_hw.py runs on the real chip:
+#   GOL_TPU_HW=1 python -m pytest tests/test_tpu_hw.py -q
+# (run only that module; the CPU-mesh suites would needlessly recompile
+# everything for the TPU).
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

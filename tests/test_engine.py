@@ -240,16 +240,16 @@ class TestCudaBlockTermination:
 
 
 def test_runner_cache_equal_meshes():
-    # jax interns Mesh instances (equal device grid + axis names => same
-    # object), so make_runner's lru_cache is keyed by value, not identity —
-    # a long-lived server constructing its mesh per request compiles once.
+    # Mesh defines __eq__/__hash__ over the device grid + axis names, so
+    # make_runner's lru_cache is keyed by value, not identity — a long-lived
+    # server constructing its mesh per request compiles once.
     import jax
     from jax.sharding import Mesh
 
     devs = np.array(jax.devices()[:4]).reshape(2, 2)
     m1 = Mesh(devs, ("row", "col"))
     m2 = Mesh(devs.copy(), ("row", "col"))
-    assert m1 is m2
+    assert m1 == m2 and hash(m1) == hash(m2)
     r1 = engine.make_runner((64, 64), GameConfig(), m1, "lax")
     r2 = engine.make_runner((64, 64), GameConfig(), m2, "lax")
     assert r1 is r2
