@@ -223,110 +223,56 @@ TEMPORAL_GENS = 8
 _BANDT_BYTES = 2 << 20
 
 
-def _bandt_kernel(
-    *refs, band: int, interior=None, ghosts: bool = False,
-):
-    """TEMPORAL_GENS generations per VMEM pass (temporal blocking).
+def _vroll_combine(s0, s1, m0, m1, x):
+    """Vertical combine over a whole extended block: re-rank the triple-sum
+    planes by ±1 sublane torus rolls (the roll-seam rows are the callers'
+    garbage frontier) and finish B3/S23."""
+    rows = x.shape[0]
+    return packed_math.combine(
+        pltpu.roll(s0, 1, 0), pltpu.roll(s1, 1, 0),
+        pltpu.roll(s0, rows - 1, 0), pltpu.roll(s1, rows - 1, 0),
+        m0, m1, x,
+    )
 
-    Each generation is computed over the full (band+16)-row extended block
-    with rolled row shifts; the rows adjacent to the roll seam are garbage,
-    but garbage spreads one row per generation and the interior starts 8
-    rows in, so the interior (an aligned [8, band+8) slice) stays exact for
-    up to 8 fused generations. Per-generation flags accumulate in SMEM so
-    the engine's blocked termination replay stays per-generation exact
-    (mid-pass exits are fixed points — see engine._simulate_c_block).
 
-    ``interior`` = (row_lo, row_hi, col_lo, col_hi), absolute over the whole
-    array: when the array holds ghost rows/columns (the distributed temporal
-    pass), the flags must see only the shard's own cells.
+def _evolve_with_ghost_plane(x, G, lanes, glanes):
+    """One generation of an extended block plus its (·, 128) ghost plane.
 
-    ``ghosts`` adds three banded (·, 128) operands carrying the ppermute'd
-    E/W ghost word columns (west in lane 0, east in lane 1). Each
-    generation patches the two edge words' cross-seam neighbor words from
-    those lanes and evolves both ghost columns in ONE extra adder-network
-    pass over the combined plane — their outer-side inputs are garbage,
-    which advances one bit per generation from the far edge of the 32-bit
-    word, so the carry bits stay exact for TEMPORAL_GENS <= 8. This keeps
-    the main block at its natural lane width: concatenating ghost columns
-    instead costs an extra 128-lane tile per band wherever nwords is a
-    tile multiple (measured 35% at 16384^2).
+    ``G`` carries BOTH ghost word columns (west in lane 0, east in lane 1),
+    row-aligned with ``x``. Each generation patches the two edge words'
+    cross-seam neighbor words from those lanes and evolves both ghost
+    columns in ONE extra adder-network pass over the combined plane — their
+    outer-side inputs are garbage, which advances one bit per generation
+    from the far edge of the 32-bit word, so the carry bits stay exact for
+    TEMPORAL_GENS <= 8. This keeps the main block at its natural lane
+    width: concatenating ghost columns instead costs an extra 128-lane tile
+    per band wherever nwords is a tile multiple (measured 35% at 16384^2).
     """
-    if ghosts:
-        (main_ref, top_ref, bot_ref, g_ref, gt_ref, gb_ref,
-         out_ref, alive_ref, similar_ref) = refs
-    else:
-        main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref = refs
-    i = pl.program_id(0)
-    x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
-    nwords = x.shape[1]
-    rows = x.shape[0]  # band + 16
-    if ghosts:
-        # One (rows, 128) plane carries BOTH ghost columns: west in lane 0,
-        # east in lane 1 — they evolve in a single adder-network pass.
-        G = jnp.concatenate([gt_ref[:], g_ref[:], gb_ref[:]], axis=0)
-        glanes = jax.lax.broadcasted_iota(jnp.int32, G.shape, 1)
-        lanes = jax.lax.broadcasted_iota(jnp.int32, (rows, nwords), 1)
+    rows, nwords = x.shape
+    left = pltpu.roll(x, 1 % nwords, 1)
+    right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+    gw = G[:, 0:1]
+    ge = G[:, 1:2]
+    left = jnp.where(lanes == 0, jnp.broadcast_to(gw, (rows, nwords)), left)
+    right = jnp.where(lanes == nwords - 1, jnp.broadcast_to(ge, (rows, nwords)), right)
+    m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
+    new_x = _vroll_combine(s0, s1, m0, m1, x)
+    # Evolve the ghost plane from current-generation values: the west
+    # ghost's east neighbor is shard word 0, the east ghost's west neighbor
+    # is shard word nwords-1; their outer-side inputs are garbage (zeros)
+    # that never crosses the 32-bit word within 8 generations.
+    x0 = x[:, 0:1]
+    xl = x[:, nwords - 1 : nwords]
+    zero = jnp.zeros_like(G)
+    g_left = jnp.where(glanes == 1, jnp.broadcast_to(xl, G.shape), zero)
+    g_right = jnp.where(glanes == 0, jnp.broadcast_to(x0, G.shape), zero)
+    m0g, m1g, s0g, s1g = packed_math.row_sums(G, g_left, g_right)
+    return new_x, _vroll_combine(s0g, s1g, m0g, m1g, G)
 
-    def vcombine(m0, m1, s0, s1, mid):
-        return packed_math.combine(
-            pltpu.roll(s0, 1, 0), pltpu.roll(s1, 1, 0),
-            pltpu.roll(s0, rows - 1, 0), pltpu.roll(s1, rows - 1, 0),
-            m0, m1, mid,
-        )
 
-    def evolve_full(x, G):
-        # Torus column wrap via lane rolls; row wrap via sublane rolls whose
-        # wrapped-in rows are garbage only at the extended block's two ends.
-        left = pltpu.roll(x, 1 % nwords, 1)
-        right = pltpu.roll(x, (nwords - 1) % nwords, 1)
-        if ghosts:
-            # Cross-seam neighbor words for the two edge lanes.
-            gw = G[:, 0:1]
-            ge = G[:, 1:2]
-            left = jnp.where(lanes == 0, jnp.broadcast_to(gw, (rows, nwords)), left)
-            right = jnp.where(
-                lanes == nwords - 1, jnp.broadcast_to(ge, (rows, nwords)), right
-            )
-        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
-        new_x = vcombine(m0, m1, s0, s1, x)
-        if not ghosts:
-            return new_x, G
-        # Evolve the ghost plane from current-generation values: the west
-        # ghost's east neighbor is shard word 0, the east ghost's west
-        # neighbor is shard word nwords-1; their outer-side inputs are
-        # garbage (zeros) that never crosses the 32-bit word within 8
-        # generations.
-        x0 = x[:, 0:1]
-        xl = x[:, nwords - 1 : nwords]
-        zero = jnp.zeros_like(G)
-        g_left = jnp.where(glanes == 1, jnp.broadcast_to(xl, G.shape), zero)
-        g_right = jnp.where(glanes == 0, jnp.broadcast_to(x0, G.shape), zero)
-        m0g, m1g, s0g, s1g = packed_math.row_sums(G, g_left, g_right)
-        new_G = vcombine(m0g, m1g, s0g, s1g, G)
-        return new_x, new_G
-
-    prev = main_ref[:]
-    mask = None
-    if interior is not None:
-        row_lo, row_hi, col_lo, col_hi = interior
-        r = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 0) + i * band
-        c = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
-        mask = (r >= row_lo) & (r < row_hi) & (c >= col_lo) & (c < col_hi)
-    flags = []
-    G_c = G if ghosts else None
-    for _ in range(TEMPORAL_GENS):
-        x, G_c = evolve_full(x, G_c)
-        g = x[8 : band + 8]
-        live = g != 0
-        diff = (g ^ prev) != 0
-        if mask is not None:
-            live = mask & live
-            diff = mask & diff
-        alive = jnp.max(jnp.where(live, 1, 0))
-        similar = 1 - jnp.max(jnp.where(diff, 1, 0))
-        flags.append((alive, similar))
-        prev = g
-    out_ref[:] = prev
+def _record_flags(i, flags, alive_ref, similar_ref):
+    """Accumulate per-generation (alive, similar) pairs into the SMEM flag
+    vectors across the sequential band grid."""
 
     @pl.when(i == 0)
     def _init():
@@ -339,6 +285,102 @@ def _bandt_kernel(
         for t, (alive, similar) in enumerate(flags):
             alive_ref[0, t] = alive_ref[0, t] | alive
             similar_ref[0, t] = similar_ref[0, t] & similar
+
+
+def _bandt_kernel(
+    main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref,
+    *, band: int, interior=None,
+):
+    """TEMPORAL_GENS generations per VMEM pass (temporal blocking), torus form.
+
+    Each generation is computed over the full (band+16)-row extended block
+    with rolled row shifts; the rows adjacent to the roll seam are garbage,
+    but garbage spreads one row per generation and the interior starts 8
+    rows in, so the interior (an aligned [8, band+8) slice) stays exact for
+    up to 8 fused generations. Per-generation flags accumulate in SMEM so
+    the engine's blocked termination replay stays per-generation exact
+    (mid-pass exits are fixed points — see engine._simulate_c_block).
+
+    ``interior`` = (row_lo, row_hi, col_lo, col_hi), absolute over the whole
+    array: when the array holds ghost rows/columns the flags must see only
+    those cells (the assembled-extended-block form; the production mesh path
+    is ``_bandtg_kernel``, whose operands carry ghosts separately).
+    """
+    i = pl.program_id(0)
+    x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
+    nwords = x.shape[1]
+
+    def evolve_full(x):
+        # Torus column wrap via lane rolls; row wrap via sublane rolls whose
+        # wrapped-in rows are garbage only at the extended block's two ends.
+        left = pltpu.roll(x, 1 % nwords, 1)
+        right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
+        return _vroll_combine(s0, s1, m0, m1, x)
+
+    prev = main_ref[:]
+    mask = None
+    if interior is not None:
+        row_lo, row_hi, col_lo, col_hi = interior
+        r = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 0) + i * band
+        c = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
+        mask = (r >= row_lo) & (r < row_hi) & (c >= col_lo) & (c < col_hi)
+    flags = []
+    for _ in range(TEMPORAL_GENS):
+        x = evolve_full(x)
+        g = x[8 : band + 8]
+        live = g != 0
+        diff = (g ^ prev) != 0
+        if mask is not None:
+            live = mask & live
+            diff = mask & diff
+        alive = jnp.max(jnp.where(live, 1, 0))
+        similar = 1 - jnp.max(jnp.where(diff, 1, 0))
+        flags.append((alive, similar))
+        prev = g
+    out_ref[:] = prev
+    _record_flags(i, flags, alive_ref, similar_ref)
+
+
+def _bandtg_kernel(
+    main_ref, topn_ref, botn_ref, gtop_ref, gbot_ref,
+    ga_ref, gb_ref, gc_ref,
+    out_ref, alive_ref, similar_ref,
+    *, band: int, nbands: int,
+):
+    """TEMPORAL_GENS generations per pass for one mesh shard, banded operands.
+
+    Same temporal-blocking shape as ``_bandt_kernel``, but the (band+16)-row
+    extended block is assembled in VMEM from banded operands: the shard band,
+    its 8-row neighbor blocks (replaced by the ppermute'd TEMPORAL_GENS-row
+    ghost blocks at the shard's first/last band), and the row-aligned
+    (·, 128) ghost-column plane. No (h + 2T, nwords) extended array ever
+    exists in HBM and the output is the shard rows directly — the
+    materialized-extended-array form this replaces spent ~2.4 ms/pass on
+    pure concat/slice HBM traffic at 32768², vs 3.4 ms for the whole kernel.
+    Flags need no interior mask: the main band block holds exactly the
+    shard's own rows.
+    """
+    i = pl.program_id(0)
+    top_ctx = jnp.where(i == 0, gtop_ref[:], topn_ref[:])
+    bot_ctx = jnp.where(i == nbands - 1, gbot_ref[:], botn_ref[:])
+    x = jnp.concatenate([top_ctx, main_ref[:], bot_ctx], axis=0)
+    G = jnp.concatenate([ga_ref[:], gb_ref[:], gc_ref[:]], axis=0)
+    rows, nwords = x.shape  # (band + 16, nwords)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (rows, nwords), 1)
+    glanes = jax.lax.broadcasted_iota(jnp.int32, G.shape, 1)
+
+    prev = main_ref[:]
+    flags = []
+    for _ in range(TEMPORAL_GENS):
+        x, G = _evolve_with_ghost_plane(x, G, lanes, glanes)
+        g = x[8 : band + 8]
+        alive = jnp.max(jnp.where(g != 0, 1, 0))
+        similar = 1 - jnp.max(jnp.where((g ^ prev) != 0, 1, 0))
+        flags.append((alive, similar))
+        prev = g
+    out_ref[:] = prev
+    _record_flags(i, flags, alive_ref, similar_ref)
 
 
 def _banded_specs(band: int, nwords: int, nb: int):
@@ -361,19 +403,16 @@ def _banded_specs(band: int, nwords: int, nb: int):
     ]
 
 
-def _temporal_call(operands, *, band, height, nwords, interior, ghosts, interpret):
-    """Shared pallas_call scaffolding of the two temporal entry points."""
+@functools.partial(jax.jit, static_argnames=("interpret", "interior"))
+def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
+    height, nwords = words.shape
+    band = _pick_band(height, nwords, _BANDT_BYTES)
     nb = height // _SUBLANES
     T = TEMPORAL_GENS
-    in_specs = _banded_specs(band, nwords, nb)
-    if ghosts:
-        in_specs += _banded_specs(band, 128, nb)
     new, alive, similar = pl.pallas_call(
-        functools.partial(
-            _bandt_kernel, band=band, interior=interior, ghosts=ghosts
-        ),
+        functools.partial(_bandt_kernel, band=band, interior=interior),
         grid=(height // band,),
-        in_specs=in_specs,
+        in_specs=_banded_specs(band, nwords, nb),
         out_specs=(
             pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
@@ -388,42 +427,68 @@ def _temporal_call(operands, *, band, height, nwords, interior, ghosts, interpre
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(*operands)
+    )(words, words, words)
     return new, alive[0], similar[0]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "interior"))
-def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
-    height, nwords = words.shape
-    band = _pick_band(height, nwords, _BANDT_BYTES)
-    return _temporal_call(
-        (words, words, words),
-        band=band, height=height, nwords=nwords,
-        interior=interior, ghosts=False, interpret=interpret,
-    )
-
-
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _step_tg(xr: jnp.ndarray, gwest: jnp.ndarray, geast: jnp.ndarray,
-             interpret: bool = False):
-    """Temporal pass over a row-extended shard block with E/W ghost operands.
+def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
+              G_ext: jnp.ndarray, interpret: bool = False):
+    """Temporal pass for one (h, nwords) shard from banded ghost operands.
 
-    ``xr`` is (h + 2T, nwords) — the shard plus TEMPORAL_GENS ghost rows per
-    side; ``gwest``/``geast`` are its (h + 2T,) ghost word columns. Returns
-    the same-shape evolved block plus flag vectors masked to the shard
-    interior (rows [T, T+h), all words — the in-kernel carry patching keeps
-    every shard word exact, unlike the concatenated ghost-column form).
+    ``gtop``/``gbot`` are the ppermute'd TEMPORAL_GENS-row ghost word blocks
+    (neighbor's far rows); ``G_ext`` is the (h + 2T, 128) ghost-column plane
+    covering extended rows -T..h+T-1 (west column in lane 0, east in lane
+    1). Returns ``(new_words, alive_vec, similar_vec)`` — shard-shaped
+    output, flags over exactly the shard's cells.
+
+    Row alignment leans on T == 8 == the sublane granule: band i's extended
+    block covers shard rows [i*band - 8, i*band + band + 8), which in
+    ``G_ext``'s indexing (row j = shard row j - 8) is rows
+    [i*band, i*band + band + 16) — one (band, 128) banded block plus two
+    8-row blocks at block offsets (i+1)*band/8 and (i+1)*band/8 + 1, all
+    exactly expressible as BlockSpecs with no overlap tricks.
     """
-    height, nwords = xr.shape
+    h, nwords = words.shape
+    band = _pick_band(h, nwords, _BANDT_BYTES)
+    bb = band // _SUBLANES
+    nb = h // _SUBLANES
     T = TEMPORAL_GENS
-    h = height - 2 * T
-    band = _pick_band(height, nwords, _BANDT_BYTES)
-    G = jnp.pad(jnp.stack([gwest, geast], axis=1), ((0, 0), (0, 126)))
-    return _temporal_call(
-        (xr, xr, xr, G, G, G),
-        band=band, height=height, nwords=nwords,
-        interior=(T, T + h, 0, nwords), ghosts=True, interpret=interpret,
-    )
+    new, alive, similar = pl.pallas_call(
+        functools.partial(_bandtg_kernel, band=band, nbands=h // band),
+        grid=(h // band,),
+        in_specs=[
+            *_banded_specs(band, nwords, nb),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (_SUBLANES, 128),
+                lambda i: (i * bb + bb, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_SUBLANES, 128),
+                lambda i: (i * bb + bb + 1, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, nwords), jnp.uint32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(words, words, words, gtop, gbot, G_ext, G_ext, G_ext)
+    return new, alive[0], similar[0]
 
 
 # Width cap for the temporal kernel: its live set spans (band+16)-row
@@ -464,14 +529,14 @@ def exchange_packed_deep_parts(words: jnp.ndarray, topology: Topology):
     generation from its far edge (32 >> TEMPORAL_GENS).
 
     Returns ``(xr, gwest, geast)``: the (h + 2T, nwords) row-extended block
-    and the two (h + 2T,) ghost word columns.
+    and the two (h + 2T,) ghost word columns. A thin assembled view over
+    ``deep_ghost_operands`` (the banded-operand form the TPU kernel consumes
+    directly), kept for the off-TPU jnp branch and halo benchmarking — one
+    exchange protocol, two presentations.
     """
-    rows, _cols = topology.shape
-    row_axis = ROW_AXIS if topology.distributed else None
-    top, bot = halo.ghost_slices(words, 0, row_axis, rows, depth=TEMPORAL_GENS)
-    xr = jnp.concatenate([top, words, bot], axis=0)
-    gwest, geast = halo.exchange_columns(xr[:, 0], xr[:, -1], topology)
-    return xr, gwest, geast
+    gtop, gbot, G_ext = deep_ghost_operands(words, topology)
+    xr = jnp.concatenate([gtop, words, gbot], axis=0)
+    return xr, G_ext[:, 0], G_ext[:, 1]
 
 
 def exchange_packed_deep(words: jnp.ndarray, topology: Topology) -> jnp.ndarray:
@@ -500,22 +565,48 @@ def _jnp_multi(state, prev0, interior):
 
 
 def _distributed_step_multi(words: jnp.ndarray, topology: Topology):
-    """Shard-local temporal pass: deep halo, then TEMPORAL_GENS generations
-    with flags masked to the shard interior — the ghost word columns ride
-    as kernel operands (lane-0 planes patched into the edge words' carries
-    each generation) so the main block keeps its natural lane width."""
+    """Shard-local temporal pass: deep halo, then TEMPORAL_GENS generations.
+
+    The ghost word rows and columns ride as banded kernel operands
+    (``_bandtg_kernel``) — nothing larger than the (h+2T, 128) ghost-column
+    plane is ever materialized around the shard array."""
     T = TEMPORAL_GENS
     h, nwords = words.shape
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu" and not _FORCE_KERNEL_OFF_TPU:
         # Identical math at jnp level: torus rolls over the extended block
         # wrap garbage only into the invalid frontier (never the interior).
         xe = exchange_packed_deep(words, topology)
         return _jnp_multi(
             xe, words, (slice(T, T + h), slice(1, nwords + 1))
         )
-    xr, gwest, geast = exchange_packed_deep_parts(words, topology)
-    new_ext, a_vec, s_vec = _step_tg(xr, gwest, geast)
-    return new_ext[T : T + h], a_vec, s_vec
+    gtop, gbot, G_ext = deep_ghost_operands(words, topology)
+    return _step_tgb(words, gtop, gbot, G_ext,
+                     interpret=jax.default_backend() != "tpu")
+
+
+# Test hook: route off-TPU mesh shards through the banded Pallas kernel in
+# interpret mode instead of the (equivalent, much faster) jnp network, so the
+# real ppermute'd-operands -> kernel composition runs under a CPU mesh in CI.
+_FORCE_KERNEL_OFF_TPU = False
+
+
+def deep_ghost_operands(words: jnp.ndarray, topology: Topology):
+    """The deep-halo exchange in banded-operand form: ``(gtop, gbot, G_ext)``.
+
+    ``gtop``/``gbot`` are the ppermute'd TEMPORAL_GENS-row ghost word blocks;
+    ``G_ext`` is the (h + 2T, 128) ghost-column plane (west in lane 0, east
+    in lane 1) over the extended row range — the ghost rows' edge words ride
+    the column exchange so corner context arrives too (the two-phase trick,
+    src/game_cuda.cu:64-74). Same wire traffic as ``exchange_packed_deep``;
+    nothing shard-sized is ever concatenated.
+    """
+    rows, _cols = topology.shape
+    row_axis = ROW_AXIS if topology.distributed else None
+    gtop, gbot = halo.ghost_slices(words, 0, row_axis, rows, depth=TEMPORAL_GENS)
+    west, east = halo.boundary_columns(words, gtop, gbot)
+    gwest, geast = halo.exchange_columns(west, east, topology)
+    G_ext = jnp.pad(jnp.stack([gwest, geast], axis=1), ((0, 0), (0, 126)))
+    return gtop, gbot, G_ext
 
 
 def packed_step_multi(cur: jnp.ndarray, topology: Topology):

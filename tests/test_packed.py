@@ -309,21 +309,20 @@ def test_pick_band_width_aware_target():
 
 @pytest.mark.parametrize("shape", [(16, 64), (16, 128 * 32), (32, 96)])
 def test_ghost_operand_temporal_kernel_interpret(shape):
-    """The ghost-operand temporal form (_step_tg): E/W ghost columns ride as
-    lane-0 kernel operands, the edge words' carries are patched per
-    generation, and the ghosts evolve in-kernel. State and per-generation
-    flags must match the oracle exactly (local torus wrap = 1x1
-    topology)."""
+    """The banded ghost-operand temporal form (_step_tgb): ghost row blocks
+    and the E/W ghost-column plane ride as kernel operands, the edge words'
+    carries are patched per generation, and the ghosts evolve in-kernel.
+    State and per-generation flags must match the oracle exactly (local
+    torus wrap = 1x1 topology)."""
     h, w = shape
-    nwords = w // 32
     rng = np.random.default_rng(29)
     g = rng.integers(0, 2, size=shape, dtype=np.uint8)
     T = sp.TEMPORAL_GENS
-    xr, gwest, geast = sp.exchange_packed_deep_parts(
-        sp.encode(jnp.asarray(g)), SINGLE_DEVICE
-    )
-    new_ext, alive, similar = sp._step_tg(xr, gwest, geast, interpret=True)
-    got = np.asarray(sp.decode(new_ext[T : T + h]))
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
+    assert gtop.shape == (T, w // 32) and G_ext.shape == (h + 2 * T, 128)
+    new, alive, similar = sp._step_tgb(words, gtop, gbot, G_ext, interpret=True)
+    got = np.asarray(sp.decode(new))
     states = [g]
     for _ in range(T):
         states.append(oracle.evolve(states[-1]))
@@ -340,39 +339,59 @@ def test_ghost_operand_temporal_edge_word_activity():
     g = np.zeros((h, nwords * 32), np.uint8)
     g[7:10, 1] = 1    # blinker in word 0, feeding across the wrap seam
     g[3:5, nwords * 32 - 2 : nwords * 32] = 1  # block in the east word
-    xr, gwest, geast = sp.exchange_packed_deep_parts(
-        sp.encode(jnp.asarray(g)), SINGLE_DEVICE
-    )
-    new_ext, alive, similar = sp._step_tg(xr, gwest, geast, interpret=True)
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tgb(words, gtop, gbot, G_ext, interpret=True)
     expect = g
     for _ in range(sp.TEMPORAL_GENS):
         expect = oracle.evolve(expect)
-    T = sp.TEMPORAL_GENS
-    np.testing.assert_array_equal(np.asarray(sp.decode(new_ext[T : T + h])), expect)
+    np.testing.assert_array_equal(np.asarray(sp.decode(new)), expect)
     assert all(int(a) == 1 for a in alive)
 
 
 def test_ghost_operand_temporal_multi_band(monkeypatch):
-    """Multiple bands per pass: the ghost plane's wrap BlockSpecs and the
+    """Multiple bands per pass: the first/last band's ghost-block selection,
+    interior bands' neighbor blocks, the ghost plane's banded specs, and the
     i>0 SMEM flag accumulation must agree with the single-band result (the
     default 2MB target would put these shapes in one band, so the target is
     shrunk to force banding; the unjitted entry re-reads the constant)."""
-    h, w = 48, 64  # height 64 extended; 8KB target -> 16-row bands -> grid (4,)
+    h, w = 48, 64  # 8KB target -> 16-row bands -> grid (3,)
     rng = np.random.default_rng(41)
     g = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
     T = sp.TEMPORAL_GENS
-    xr, gwest, geast = sp.exchange_packed_deep_parts(
-        sp.encode(jnp.asarray(g)), SINGLE_DEVICE
-    )
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
     monkeypatch.setattr(sp, "_BANDT_BYTES", 8 << 10)
-    assert sp._pick_band(h + 2 * T, w // 32, sp._BANDT_BYTES) == 16
-    new_ext, alive, similar = sp._step_tg.__wrapped__(
-        xr, gwest, geast, interpret=True
+    assert sp._pick_band(h, w // 32, sp._BANDT_BYTES) == 16
+    new, alive, similar = sp._step_tgb.__wrapped__(
+        words, gtop, gbot, G_ext, interpret=True
     )
-    got = np.asarray(sp.decode(new_ext[T : T + h]))
+    got = np.asarray(sp.decode(new))
     states = [g]
     for _ in range(T):
         states.append(oracle.evolve(states[-1]))
     np.testing.assert_array_equal(got, states[-1])
     for t in range(T):
         assert int(alive[t]) == int(states[t + 1].any()), t
+
+
+def test_banded_kernel_under_real_mesh(monkeypatch):
+    """The banded ghost-operand kernel composed with REAL shard_map
+    ppermutes: _FORCE_KERNEL_OFF_TPU routes the CPU-mesh temporal pass
+    through _step_tgb in interpret mode, so the exchanged gtop/gbot/G_ext
+    operands (not the jnp-network equivalent) produce the mesh result."""
+    from gol_tpu import engine
+    from gol_tpu.config import GameConfig
+    from gol_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setattr(sp, "_FORCE_KERNEL_OFF_TPU", True)
+    engine.make_runner.cache_clear()
+    rng = np.random.default_rng(53)
+    g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+    lim = 2 * sp.TEMPORAL_GENS + 3
+    cfg = GameConfig(gen_limit=lim)
+    got = engine.simulate(g, cfg, mesh=make_mesh(2, 4), kernel="packed")
+    expect = oracle.run(g, cfg)
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+    engine.make_runner.cache_clear()

@@ -60,3 +60,71 @@ def test_packed_torus_matches_oracle(h, words, density, seed):
         packed_math.evolve_torus_words(packed_math.encode(jnp.asarray(grid)))
     )
     np.testing.assert_array_equal(np.asarray(got), oracle.evolve(grid))
+
+
+@given(
+    freq=st.integers(1, 5),
+    check=st.booleans(),
+    convention=st.sampled_from([Convention.C, Convention.CUDA]),
+    grid=grids,
+)
+@settings(max_examples=40, deadline=None)
+def test_similarity_frequency_matches_oracle(freq, check, convention, grid):
+    # The blocked loops replay similarity counters from per-generation flag
+    # vectors; the firing phase must survive any frequency, toggled checks,
+    # and both exit conventions.
+    config = GameConfig(
+        gen_limit=14,
+        similarity_frequency=freq,
+        check_similarity=check,
+        convention=convention,
+    )
+    expect = oracle.run(grid, config)
+    got = engine.simulate(grid, config, kernel="lax")
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
+@given(
+    h=st.integers(1, 6),
+    words=st.integers(1, 3),
+    density=st.floats(0.0, 1.0),
+    seed=st.integers(0, 2**31),
+    convention=st.sampled_from([Convention.C, Convention.CUDA]),
+)
+@settings(max_examples=30, deadline=None)
+def test_packed_engine_matches_oracle(h, words, density, seed, convention):
+    # The packed kernel's engine path (fused flags + temporal blocking where
+    # eligible) across heights 8..48 and word counts, both conventions.
+    grid = (
+        np.random.default_rng(seed).random((h * 8, words * 32)) < density
+    ).astype(np.uint8)
+    config = GameConfig(gen_limit=20, convention=convention)
+    expect = oracle.run(grid, config)
+    got = engine.simulate(grid, config, kernel="packed")
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
+@given(
+    mesh_shape=st.sampled_from([(1, 2), (2, 1), (2, 2), (2, 4), (4, 2)]),
+    hk=st.integers(1, 3),
+    wk=st.integers(1, 2),
+    density=st.floats(0.0, 1.0),
+    seed=st.integers(0, 2**31),
+    kernel=st.sampled_from(["lax", "auto"]),
+)
+@settings(max_examples=25, deadline=None)
+def test_mesh_engine_matches_oracle(mesh_shape, hk, wk, density, seed, kernel):
+    # Random grids over random mesh shapes: halo exchange + psum votes on
+    # every axis split, auto kernel routing per local shard shape.
+    from gol_tpu.parallel import make_mesh
+
+    r, c = mesh_shape
+    h, w = r * hk * 8, c * wk * 32
+    grid = (np.random.default_rng(seed).random((h, w)) < density).astype(np.uint8)
+    config = GameConfig(gen_limit=12)
+    expect = oracle.run(grid, config)
+    got = engine.simulate(grid, config, mesh=make_mesh(r, c), kernel=kernel)
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
