@@ -1,0 +1,81 @@
+"""Extended randomized differential soak: engine vs oracle on CPU meshes.
+
+Open-ended fuzz over mesh shapes (1x1..8x1) x kernels (lax/auto/packed/pallas)
+x conventions x similarity frequencies x densities x generation limits, every
+case byte-compared against the NumPy oracle:
+
+    python tools/soak_cpu.py [seconds=1800]
+
+(The 8-virtual-device XLA flag is set automatically when absent.) Prints the
+per-kernel case counts at the end so coverage of each path is visible —
+pallas cases need 128-lane local shards, so their draws use wider grids.
+Round-2 record: 853 cases in 30 minutes, all oracle-identical, plus a
+follow-up run covering the pallas draws (counts in the commit message). The
+pytest suite pins fixed cases; this explores the space around them.
+"""
+import collections
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.parallel.mesh import make_mesh
+
+DEADLINE = time.time() + (float(sys.argv[1]) if len(sys.argv) > 1 else 1800)
+seed0 = int(time.time())
+print(f"soak seed: {seed0}", flush=True)
+rng = np.random.default_rng(seed0)
+meshes = [None, (1, 2), (2, 1), (2, 2), (2, 4), (4, 2), (1, 8), (8, 1)]
+kernels = ["lax", "auto", "packed", "pallas"]
+counts = collections.Counter()
+while time.time() < DEADLINE:
+    ms = meshes[rng.integers(len(meshes))]
+    r, c = ms if ms else (1, 1)
+    kernel = kernels[rng.integers(len(kernels))]
+    hk = int(rng.integers(1, 4))
+    # The byte pallas kernel needs 128-lane local shards; give its draws
+    # (and some others) wide-enough grids instead of silently skipping.
+    wk = 4 if kernel == "pallas" or rng.random() < 0.25 else int(rng.integers(1, 3))
+    h, w = r * hk * 8, c * wk * 32
+    conv = Convention.CUDA if rng.random() < 0.5 else Convention.C
+    freq = int(rng.integers(1, 5))
+    check = bool(rng.random() < 0.9)
+    lim = int(rng.integers(1, 40))
+    density = float(rng.random())
+    seed = int(rng.integers(2**31))
+    g = (np.random.default_rng(seed).random((h, w)) < density).astype(np.uint8)
+    cfg = GameConfig(gen_limit=lim, similarity_frequency=freq,
+                     check_similarity=check, convention=conv)
+    case = dict(mesh=ms, shape=(h, w), kernel=kernel, conv=conv, freq=freq,
+                check=check, lim=lim, density=round(density, 3), seed=seed)
+    try:
+        got = engine.simulate(g, cfg, mesh=make_mesh(r, c) if ms else None, kernel=kernel)
+    except ValueError as e:
+        # unsupported kernel/shape combos are loud errors by design
+        if "does not support" in str(e) or "requires" in str(e):
+            counts[f"{kernel}-unsupported"] += 1
+            continue
+        print("UNEXPECTED ERROR", case, e)
+        sys.exit(1)
+    want = oracle.run(g, cfg)
+    if got.generations != want.generations or not np.array_equal(got.grid, want.grid):
+        print("MISMATCH", case)
+        sys.exit(1)
+    counts[kernel] += 1
+    total = sum(v for k, v in counts.items() if not k.endswith("-unsupported"))
+    if total % 50 == 0:
+        print(f"{total} cases OK {dict(counts)}", flush=True)
+total = sum(v for k, v in counts.items() if not k.endswith("-unsupported"))
+print(f"SOAK PASS: {total} randomized cases, all oracle-identical; {dict(counts)}")
