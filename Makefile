@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench clean $(VARIANTS)
+.PHONY: all test bench soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -20,6 +20,13 @@ test:
 
 bench:
 	python3 bench.py
+
+# Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
+soak:
+	python3 tools/soak_cpu.py $(or $(SECONDS_CPU),600)
+
+soak-tpu:
+	python3 tools/soak_tpu.py $(or $(SECONDS_TPU),600)
 
 # The reference's `clean` removes *.out, which also deletes the output DATA
 # files since they share the suffix (reference Makefile:31) — reproduced
