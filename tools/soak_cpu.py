@@ -74,8 +74,25 @@ while time.time() < DEADLINE:
         print("MISMATCH", case)
         sys.exit(1)
     counts[kernel] += 1
-    total = sum(v for k, v in counts.items() if not k.endswith("-unsupported"))
+    if rng.random() < 0.25:
+        # Segmented replay: random segment lengths must reproduce the whole
+        # run bit-exactly (the snapshot/resume property, with the similarity
+        # phase carried across arbitrary segment boundaries).
+        segment = int(rng.integers(1, lim + 2))
+        seg_gens, seg_grid = 0, None
+        for seg_gens, seg_grid, _stopped in engine.simulate_segments(
+            g, cfg, make_mesh(r, c) if ms else None, kernel, segment
+        ):
+            pass
+        seg_np = np.asarray(jax.device_get(seg_grid), dtype=np.uint8)
+        if seg_gens != want.generations or not np.array_equal(seg_np, want.grid):
+            print("SEGMENT MISMATCH", {**case, "segment": segment})
+            sys.exit(1)
+        counts["segmented"] += 1
+    total = sum(v for k, v in counts.items()
+                if not k.endswith("-unsupported") and k != "segmented")
     if total % 50 == 0:
         print(f"{total} cases OK {dict(counts)}", flush=True)
-total = sum(v for k, v in counts.items() if not k.endswith("-unsupported"))
+total = sum(v for k, v in counts.items()
+            if not k.endswith("-unsupported") and k != "segmented")
 print(f"SOAK PASS: {total} randomized cases, all oracle-identical; {dict(counts)}")
