@@ -9,8 +9,8 @@ case byte-compared against the NumPy oracle:
 (The 8-virtual-device XLA flag is set automatically when absent.) Prints the
 per-kernel case counts at the end so coverage of each path is visible —
 pallas cases need 128-lane local shards, so their draws use wider grids.
-Round-2 record: 853 cases in 30 minutes, all oracle-identical, plus a
-follow-up run covering the pallas draws (counts in the commit message). The
+Round-2 record: 2082 cases across four runs (e.g. 916 in 30 minutes at
+{auto 231, lax 223, pallas 229, packed 233}), all oracle-identical. The
 pytest suite pins fixed cases; this explores the space around them.
 """
 import collections
