@@ -176,3 +176,57 @@ def test_cli_packed_io_snapshots(tmp_path, monkeypatch):
     expect10 = oracle.run(g, GameConfig(gen_limit=10))
     got10 = text_grid.read_grid("collective_output.out", 64, 64)
     np.testing.assert_array_equal(got10, expect10.grid)
+
+
+@pytest.mark.parametrize("convention", [Convention.C, Convention.CUDA])
+@pytest.mark.parametrize(
+    "mesh_a,mesh_b", [((2, 2), (2, 4)), ((2, 4), None), (None, (4, 2))]
+)
+def test_resume_across_topologies(convention, mesh_a, mesh_b):
+    """Elastic reconfiguration: a mid-run segment state moves between meshes
+    (or to/from a single device) and the continued run stays bit-exact with
+    one uninterrupted loop — generation counter AND similarity phase carry.
+    The reference cannot do this at all: its only resume path is the final
+    output file, with the similarity phase lost (src/game.c:25-40,154-165).
+    """
+    import jax
+
+    from gol_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(77)
+    g = rng.integers(0, 2, size=(32, 64), dtype=np.uint8)
+    config = GameConfig(gen_limit=40, convention=convention)
+    expect = oracle.run(g, config)
+
+    def runner_for(mesh_shape):
+        mesh = make_mesh(*mesh_shape) if mesh_shape else None
+        return engine.make_segment_runner((32, 64), config, mesh, "lax"), mesh
+
+    # Phase 1: 13 generations (an awkward offset for the freq-3 counter) on A.
+    import jax.numpy as jnp
+
+    run_a, mesh_a_obj = runner_for(mesh_a)
+    gen0 = engine._GEN_START[config.convention]
+    seg_end = gen0 + 13 - (1 if config.convention == Convention.C else 0)
+    state_a = engine.put_grid(g, mesh_a_obj)
+    state, gen, counter, stopped = run_a(
+        state_a, jnp.int32(gen0), jnp.int32(0), jnp.int32(seg_end)
+    )
+    assert not bool(stopped)
+    # The "checkpoint": host bytes + the two loop scalars (a real checkpoint
+    # serializes all three; device arrays committed to mesh A must not leak
+    # their sharding into mesh B's compiled call).
+    host_state = np.asarray(jax.device_get(state), dtype=np.uint8)
+    gen_ck, counter_ck = int(gen), int(counter)
+
+    # Phase 2: rehydrate on B and run to completion.
+    run_b, mesh_b_obj = runner_for(mesh_b)
+    state_b = engine.put_grid(host_state, mesh_b_obj)
+    state, gen, counter, stopped = run_b(
+        state_b, jnp.int32(gen_ck), jnp.int32(counter_ck),
+        jnp.int32(config.gen_limit),
+    )
+    assert bool(stopped)
+    final = np.asarray(jax.device_get(state), dtype=np.uint8)
+    np.testing.assert_array_equal(final, expect.grid)
+    assert engine._REPORT[config.convention](int(gen)) == expect.generations
