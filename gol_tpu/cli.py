@@ -140,6 +140,16 @@ def _run(args) -> int:
     )
     output_path = args.output or f"./{variant.output_file}"
 
+    if args.resume_gen < 0:
+        raise ValueError(f"--resume-gen must be >= 0, got {args.resume_gen}")
+    if args.resume_gen > config.gen_limit:
+        # A typo'd resume count would otherwise produce a no-op run with a
+        # plausible-looking report above the limit.
+        raise ValueError(
+            f"--resume-gen {args.resume_gen} exceeds --gen-limit "
+            f"{config.gen_limit}; nothing to resume"
+        )
+
     if args.host:
         # lax is what the host oracle effectively is, so it stays accepted;
         # forcing an accelerator kernel alongside --host is a contradiction.
@@ -148,6 +158,9 @@ def _run(args) -> int:
                 "--mesh/--kernel/--packed-io do not apply with --host "
                 "(oracle runs on the host CPU)"
             )
+        if args.resume_gen:
+            raise ValueError("--resume-gen is not supported with --host "
+                             "(the oracle has no segmented loop)")
         return _run_host(args, variant, config, width, height, output_path)
 
     if variant.distributed:
@@ -184,6 +197,9 @@ def _run(args) -> int:
 
     if args.snapshot_every:
         run_fn = _prepare_segmented(args, variant, config, mesh, device_grid, height, width)
+    elif args.resume_gen:
+        run_fn = _prepare_resumed(args, config, mesh, device_grid, height, width,
+                                  packed=False, kernel=args.kernel)
     else:
         runner = engine.make_runner((height, width), config, mesh, args.kernel)
         compiled = runner.lower(device_grid).compile()
@@ -243,6 +259,9 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
 
     if args.snapshot_every:
         run_fn = _prepare_packed_segmented(args, config, mesh, words, height, width)
+    elif args.resume_gen:
+        run_fn = _prepare_resumed(args, config, mesh, words, height, width,
+                                  packed=True)
     else:
         runner = engine.make_packed_runner((height, width), config, mesh)
         compiled = runner.lower(words).compile()
@@ -280,10 +299,41 @@ def _prepare_packed_segmented(args, config, mesh, words, height, width):
         runner,
         words,
         lambda: engine.simulate_packed_segments(
-            words, (height, width), config, mesh, args.snapshot_every
+            words, (height, width), config, mesh, args.snapshot_every,
+            completed=args.resume_gen,
         ),
         lambda path, state: packed_io.write_packed(path, state, width),
     )
+
+
+def _prepare_resumed(args, config, mesh, state, height, width, *, packed, kernel="auto"):
+    """Continue a run from a snapshot without writing further snapshots.
+
+    The input file is the state after ``--resume-gen`` generations of a run
+    that had not early-exited; the similarity phase is realigned from that
+    count alone (engine.resume_scalars — no sidecar metadata exists or is
+    needed), so exits and the reported total match the uninterrupted run.
+    """
+    import jax.numpy as jnp
+
+    runner = (
+        engine.make_packed_segment_runner((height, width), config, mesh)
+        if packed
+        else engine.make_segment_runner((height, width), config, mesh, kernel)
+    )
+    gen0, counter0 = engine.resume_scalars(config, args.resume_gen)
+    _, g, _, _ = runner(state, jnp.int32(gen0), jnp.int32(counter0), jnp.int32(0))
+    int(g)  # zero-step call: compile + program upload (the --warmup treatment)
+
+    report = engine._REPORT[config.convention]
+
+    def run_fn():
+        final, gen, _counter, _stopped = runner(
+            state, jnp.int32(gen0), jnp.int32(counter0), jnp.int32(config.gen_limit)
+        )
+        return final, report(int(gen))
+
+    return run_fn
 
 
 def _profile_trace(profile_dir: str | None):
@@ -339,7 +389,8 @@ def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
         runner,
         device_grid,
         lambda: engine.simulate_segments(
-            device_grid, config, mesh, args.kernel, args.snapshot_every
+            device_grid, config, mesh, args.kernel, args.snapshot_every,
+            completed=args.resume_gen,
         ),
         lambda path, state: _write_phase(variant, path, state),
     )
@@ -448,6 +499,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--snapshot-dir", default=None, help="snapshot directory (default ./snapshots)"
+    )
+    run.add_argument(
+        "--resume-gen",
+        type=int,
+        default=0,
+        metavar="N",
+        help="treat the input file as the state after N generations (a "
+        "gen_NNNNNN.out snapshot) and continue to --gen-limit with the "
+        "similarity phase realigned — exits and the reported total match "
+        "the uninterrupted run exactly; composes with --snapshot-every",
     )
     run.add_argument(
         "--warmup",

@@ -560,13 +560,28 @@ def make_packed_segment_runner(
                          segmented=True, packed_state=True)
 
 
-def _iter_segments(runner, state, config: GameConfig, segment: int):
+def resume_scalars(config: GameConfig, completed: int) -> tuple[int, int]:
+    """Loop scalars ``(gen0, counter0)`` for resuming after ``completed``
+    generations of a run that had not early-exited.
+
+    Both conventions increment the similarity counter once per executed
+    generation and reset it on every ``similarity_frequency``-th, so mid-run
+    (non-exited) state needs no sidecar metadata: ``counter = completed mod
+    frequency`` — a snapshot file plus its generation count is a complete
+    checkpoint. (Early-exited runs are finished; there is nothing to resume.)
+    """
+    if completed < 0:
+        raise ValueError(f"completed generations must be >= 0, got {completed}")
+    counter = completed % config.similarity_frequency if config.check_similarity else 0
+    return _GEN_START[config.convention] + completed, counter
+
+
+def _iter_segments(runner, state, config: GameConfig, segment: int, completed: int = 0):
     """Drive a segment runner to completion, yielding after every segment."""
     if segment <= 0:
         raise ValueError(f"segment must be positive, got {segment}")
     report = _REPORT[config.convention]
-    gen = _GEN_START[config.convention]
-    counter = 0
+    gen, counter = resume_scalars(config, completed)
     while True:
         seg_end = gen + segment - (1 if config.convention == Convention.C else 0)
         state, gen_a, counter_a, stopped_a = runner(
@@ -584,6 +599,7 @@ def simulate_segments(
     mesh: Mesh | None = None,
     kernel: str = "auto",
     segment: int = 100,
+    completed: int = 0,
 ):
     """Generator of ``(generations_so_far, device_grid, stopped)`` per segment.
 
@@ -592,11 +608,17 @@ def simulate_segments(
     generations so callers can snapshot, log, or abort. The similarity
     counter is carried across segments, so exits fire on exactly the same
     generations as the unsegmented loop.
+
+    ``completed`` resumes: the grid is taken to be the state after that many
+    generations of a longer run (a snapshot), and the loop continues to
+    ``config.gen_limit`` with the similarity phase realigned
+    (``resume_scalars``) — yielded counts and exits match the uninterrupted
+    run exactly.
     """
     shape = tuple(np.shape(grid))
     runner = make_segment_runner(shape, config, mesh, kernel)
     device_grid = grid if isinstance(grid, jax.Array) else put_grid(grid, mesh)
-    yield from _iter_segments(runner, device_grid, config, segment)
+    yield from _iter_segments(runner, device_grid, config, segment, completed)
 
 
 def simulate_packed_segments(
@@ -605,6 +627,7 @@ def simulate_packed_segments(
     config: GameConfig = DEFAULT_CONFIG,
     mesh: Mesh | None = None,
     segment: int = 100,
+    completed: int = 0,
 ):
     """Packed-state counterpart of ``simulate_segments``.
 
@@ -614,7 +637,7 @@ def simulate_packed_segments(
     grid never exists.
     """
     runner = make_packed_segment_runner(shape, config, mesh)
-    yield from _iter_segments(runner, words, config, segment)
+    yield from _iter_segments(runner, words, config, segment, completed)
 
 
 def put_grid(grid, mesh: Mesh | None = None) -> jax.Array:

@@ -230,3 +230,78 @@ def test_resume_across_topologies(convention, mesh_a, mesh_b):
     final = np.asarray(jax.device_get(state), dtype=np.uint8)
     np.testing.assert_array_equal(final, expect.grid)
     assert engine._REPORT[config.convention](int(gen)) == expect.generations
+
+
+@pytest.mark.parametrize("convention", [Convention.C, Convention.CUDA])
+@pytest.mark.parametrize("freq,split", [(3, 13), (3, 12), (1, 7), (4, 10)])
+def test_resume_scalars_realign_similarity_phase(convention, freq, split):
+    """engine.resume_scalars: a snapshot after N generations plus N alone
+    reconstructs the loop scalars — the continued run is bit-exact with the
+    uninterrupted one, early exits included, at every counter phase."""
+    rng = np.random.default_rng(91)
+    g = rng.integers(0, 2, size=(24, 32), dtype=np.uint8)
+    config = GameConfig(gen_limit=40, similarity_frequency=freq,
+                        convention=convention)
+    expect = oracle.run(g, config)
+    assert expect.generations > split  # split lands mid-run, not post-exit
+
+    # The snapshot: the state after `split` generations (no early exit yet).
+    first = GameConfig(gen_limit=split, similarity_frequency=freq,
+                       convention=convention)
+    snap = engine.simulate(g, first, kernel="lax").grid
+
+    last = None
+    for last in engine.simulate_segments(
+        snap, config, None, "lax", segment=5, completed=split
+    ):
+        pass
+    gens, final, stopped = last
+    np.testing.assert_array_equal(
+        np.asarray(final, dtype=np.uint8), expect.grid
+    )
+    assert gens == expect.generations and stopped
+
+
+def test_cli_resume_gen_matches_uninterrupted(tmp_path, monkeypatch):
+    """CLI crash-recovery flow: snapshot at gen 6, resume with --resume-gen 6,
+    final output bytes and printed Generations match the uninterrupted run."""
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.default_rng(19)
+    g = rng.integers(0, 2, size=(32, 32), dtype=np.uint8)
+    text_grid.write_grid("input.txt", g)
+
+    def run(*argv):
+        r = cli.main(["run", "32", "32", *argv])
+        assert r == 0
+
+    run("input.txt", "--variant", "game", "--gen-limit", "20",
+        "--output", "whole.out")
+    run("input.txt", "--variant", "game", "--gen-limit", "20",
+        "--snapshot-every", "6", "--snapshot-dir", "snaps",
+        "--output", "ignored.out")
+    # "Crash" after the first snapshot: resume from gen_000006.out.
+    run("snaps/gen_000006.out", "--variant", "game", "--gen-limit", "20",
+        "--resume-gen", "6", "--output", "resumed.out")
+    whole = open("whole.out", "rb").read()
+    resumed = open("resumed.out", "rb").read()
+    assert whole == resumed
+    # And composing --resume-gen with further snapshots keeps absolute names.
+    run("snaps/gen_000006.out", "--variant", "game", "--gen-limit", "20",
+        "--resume-gen", "6", "--snapshot-every", "7",
+        "--snapshot-dir", "snaps2", "--output", "resumed2.out")
+    assert open("resumed2.out", "rb").read() == whole
+    names = sorted(os.listdir("snaps2"))
+    assert names and names[0] == "gen_000013.out"
+
+
+def test_cli_resume_gen_validation(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    text_grid.write_grid("in.txt", np.ones((8, 8), np.uint8))
+    rc = cli.main(["run", "8", "8", "in.txt", "--gen-limit", "10",
+                   "--resume-gen", "25"])
+    assert rc == 1
+    assert "exceeds --gen-limit" in capsys.readouterr().err
+    rc = cli.main(["run", "8", "8", "in.txt", "--resume-gen", "-1"])
+    assert rc == 1
+    rc = cli.main(["run", "8", "8", "in.txt", "--host", "--resume-gen", "3"])
+    assert rc == 1
