@@ -89,10 +89,32 @@ while time.time() < DEADLINE:
             print("SEGMENT MISMATCH", {**case, "segment": segment})
             sys.exit(1)
         counts["segmented"] += 1
+    if rng.random() < 0.25 and want.generations > 1:
+        # Resume replay: snapshot after a random split, continue with the
+        # similarity phase realigned from the count alone (resume_scalars) —
+        # must match the uninterrupted run, early exits included.
+        split = int(rng.integers(1, want.generations))
+        first = GameConfig(gen_limit=split,
+                           similarity_frequency=freq,
+                           check_similarity=check, convention=conv)
+        snap = engine.simulate(g, first,
+                               mesh=make_mesh(r, c) if ms else None,
+                               kernel=kernel).grid
+        res_gens, res_grid = 0, None
+        for res_gens, res_grid, _stopped in engine.simulate_segments(
+            snap, cfg, make_mesh(r, c) if ms else None, kernel,
+            segment=int(rng.integers(1, lim + 2)), completed=split,
+        ):
+            pass
+        res_np = np.asarray(jax.device_get(res_grid), dtype=np.uint8)
+        if res_gens != want.generations or not np.array_equal(res_np, want.grid):
+            print("RESUME MISMATCH", {**case, "split": split})
+            sys.exit(1)
+        counts["resumed"] += 1
     total = sum(v for k, v in counts.items()
-                if not k.endswith("-unsupported") and k != "segmented")
+                if not k.endswith("-unsupported") and k not in ("segmented", "resumed"))
     if total % 50 == 0:
         print(f"{total} cases OK {dict(counts)}", flush=True)
 total = sum(v for k, v in counts.items()
-            if not k.endswith("-unsupported") and k != "segmented")
+            if not k.endswith("-unsupported") and k not in ("segmented", "resumed"))
 print(f"SOAK PASS: {total} randomized cases, all oracle-identical; {dict(counts)}")
