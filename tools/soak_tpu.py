@@ -7,8 +7,8 @@ temporal, byte band kernel) must match the jnp reference exactly:
     python tools/soak_tpu.py [seconds=900]
 
 The seed is taken from the clock and printed, so every run explores new
-shapes and any failure is replayable. Round-2 record: 106 shapes across
-two runs (compiles dominate the wall clock), all identical.
+shapes and any failure is replayable. Round-2 record: 213 shapes across
+three runs (compiles dominate the wall clock), all identical.
 """
 import os
 import sys
