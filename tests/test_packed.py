@@ -129,7 +129,9 @@ def test_dist_kernel_local_wrap_matches_oracle():
 
 
 def test_distributed_packed_runs_pallas_kernel(monkeypatch):
-    """The mesh path's hot loop is the Pallas band kernel, not the jnp net."""
+    """On TPU the mesh path's hot loop is the Pallas band kernel, not the
+    jnp net; off TPU only the _FORCE_KERNEL_OFF_TPU test hook takes that
+    route (interpret mode) — engaged here so CI pins the composition."""
     from gol_tpu.parallel.mesh import make_mesh
 
     calls = []
@@ -140,6 +142,7 @@ def test_distributed_packed_runs_pallas_kernel(monkeypatch):
         return real(*args, **kwargs)
 
     monkeypatch.setattr(sp, "_dist_step_pallas", spy)
+    monkeypatch.setattr(sp, "_FORCE_KERNEL_OFF_TPU", True)
     engine.make_runner.cache_clear()
     mesh = make_mesh(2, 4)
     rng = np.random.default_rng(3)
@@ -388,10 +391,22 @@ def test_banded_kernel_under_real_mesh(monkeypatch):
     engine.make_runner.cache_clear()
     rng = np.random.default_rng(53)
     g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
+    # 2T+3 generations: two fused temporal blocks plus a 3-generation tail
+    # through the single-generation dist kernel (also interpret mode here).
     lim = 2 * sp.TEMPORAL_GENS + 3
     cfg = GameConfig(gen_limit=lim)
     got = engine.simulate(g, cfg, mesh=make_mesh(2, 4), kernel="packed")
     expect = oracle.run(g, cfg)
     np.testing.assert_array_equal(got.grid, expect.grid)
     assert got.generations == expect.generations
+    # 8-row shards (16 rows over 2 mesh rows): supports_multi fails, so the
+    # engine strips fused_multi and EVERY generation runs the single-gen
+    # dist kernel — the ppermuted ghost-row/bit-column operands composed
+    # with the interpret-mode kernel under a real mesh.
+    g8 = rng.integers(0, 2, size=(16, 256), dtype=np.uint8)
+    cfg8 = GameConfig(gen_limit=6)
+    got8 = engine.simulate(g8, cfg8, mesh=make_mesh(2, 4), kernel="packed")
+    expect8 = oracle.run(g8, cfg8)
+    np.testing.assert_array_equal(got8.grid, expect8.grid)
+    assert got8.generations == expect8.generations
     engine.make_runner.cache_clear()

@@ -66,9 +66,15 @@ def test_supports_gating():
     assert not supports(30, 128, Topology(shape=(2, 2), axes=("row", "col")))
 
 
-def test_auto_resolution_on_cpu_prefers_lax():
-    # Tests run on CPU, where auto must not pick the (interpret-only) pallas.
-    assert resolve_kernel("auto", 4096, 4096, SINGLE_DEVICE).name == "lax"
+def test_auto_resolution_on_cpu():
+    # Tests run on CPU: auto must not pick the (interpret-only) byte pallas
+    # kernel, but packed still wins where it fits — its off-TPU hot paths are
+    # the jnp adder network, 18x the lax stencil on CPU at 4096².
+    assert resolve_kernel("auto", 4096, 4096, SINGLE_DEVICE).name == "packed"
+    # Shapes the packed kernel can't take (width not a multiple of 32, or
+    # lane-misaligned heights on one device) fall back to lax, never pallas.
+    assert resolve_kernel("auto", 4096, 4090, SINGLE_DEVICE).name == "lax"
+    assert resolve_kernel("auto", 30, 4096, SINGLE_DEVICE).name == "lax"
     assert get_kernel("pallas").name == "pallas"
 
 
