@@ -313,6 +313,12 @@ def _prepare_resumed(args, config, mesh, state, height, width, *, packed, kernel
     that had not early-exited; the similarity phase is realigned from that
     count alone (engine.resume_scalars — no sidecar metadata exists or is
     needed), so exits and the reported total match the uninterrupted run.
+
+    The zero-step warmup call below runs unconditionally (unlike the
+    unsegmented lane, where warmup is opt-in via --warmup) for the same
+    reason _snapshot_loop's does: compile + program upload happen outside
+    the timer, so resumed Execution time is comparable to the unsegmented
+    lane, which compiles before its timer too.
     """
     import jax.numpy as jnp
 
@@ -508,7 +514,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="treat the input file as the state after N generations (a "
         "gen_NNNNNN.out snapshot) and continue to --gen-limit with the "
         "similarity phase realigned — exits and the reported total match "
-        "the uninterrupted run exactly; composes with --snapshot-every",
+        "the uninterrupted run exactly; composes with --snapshot-every. "
+        "The snapshot must come from a run that had NOT early-exited: "
+        "resuming from the final output of an exited run (e.g. a still "
+        "life) replays it as mid-run state and reports a different count",
     )
     run.add_argument(
         "--warmup",
