@@ -67,16 +67,29 @@ def _similarity_vote(fire, cur, new, similar_local, topology: Topology):
 
     With a fused kernel the local flag already exists, so the vote is plain
     arithmetic — a lax.cond here measurably stalls the TPU pipeline (~80us per
-    generation at 4096^2). Without one, the full-grid compare is guarded by
-    lax.cond so it is only paid on firing generations.
+    generation at 4096^2). Without one, the O(grid) compare is guarded by
+    lax.cond so it is only paid on firing generations — but the *collective*
+    runs unconditionally on the masked flag: a psum under a data-dependent
+    lax.cond deadlocks any backend that cannot prove the predicate
+    SPMD-uniform (ours is — the counter is identical on every shard — but
+    XLA cannot know that). Off-generations vote False everywhere, so the
+    unconditional all_agree is correct and matches the reference's
+    unconditional every-3rd-gen similarity_all
+    (src/game_mpi_collective.c:353-361).
     """
     if similar_local is not None:
         return fire & collectives.all_agree(similar_local, topology)
-    return jax.lax.cond(
+    # The compare's output is device-varying under shard_map; the False arm
+    # must be cast to match (vma tracking rejects mixed-variance branches).
+    false_arm = jnp.asarray(False)
+    if topology.distributed:
+        false_arm = jax.lax.pcast(false_arm, topology.axes, to="varying")
+    sim_local = jax.lax.cond(
         fire,
-        lambda: collectives.all_agree(jnp.all(cur == new), topology),
-        lambda: jnp.asarray(False),
+        lambda: jnp.all(cur == new),
+        lambda: false_arm,
     )
+    return fire & collectives.all_agree(sim_local, topology)
 
 
 # Generations per outer while iteration in the C-convention block loop. The

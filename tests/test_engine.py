@@ -253,3 +253,32 @@ def test_runner_cache_equal_meshes():
     r1 = engine.make_runner((64, 64), GameConfig(), m1, "lax")
     r2 = engine.make_runner((64, 64), GameConfig(), m2, "lax")
     assert r1 is r2
+
+
+def test_no_collective_under_conditional():
+    # A psum under a data-dependent lax.cond deadlocks backends that cannot
+    # prove the predicate SPMD-uniform. The engine's similarity vote keeps the
+    # O(grid) compare behind the cond but runs the collective unconditionally
+    # on the masked flag (engine._similarity_vote) — matching the reference's
+    # unconditional every-3rd-gen similarity_all
+    # (src/game_mpi_collective.c:353-361). Pin it by walking the lowered
+    # StableHLO: no all_reduce may appear inside an if/case region.
+    mesh = make_mesh(2, 2)
+    runner = engine._build_runner(
+        (16, 16), GameConfig(gen_limit=10), mesh, "lax",
+        segmented=False, packed_state=False,
+    )
+    grid = engine.put_grid(np.zeros((16, 16), np.uint8), mesh)
+    txt = runner.lower(grid).as_text()
+    assert txt.count("all_reduce") > 0  # the votes are still collectives
+    region_stack, offenders = [], []
+    for line in txt.splitlines():
+        if "stablehlo.if" in line or "stablehlo.case" in line:
+            region_stack.append(line.count("{") - line.count("}"))
+        elif region_stack:
+            region_stack[-1] += line.count("{") - line.count("}")
+            if "all_reduce" in line:
+                offenders.append(line.strip())
+            if region_stack[-1] <= 0:
+                region_stack.pop()
+    assert offenders == []
