@@ -30,7 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.config import Convention, DEFAULT_CONFIG, GameConfig
-from gol_tpu.ops import Kernel, resolve_kernel
+from gol_tpu.ops import Kernel, fallback_chain, resolve_kernel
 from gol_tpu.parallel import collectives
 from gol_tpu.parallel.mesh import (
     Topology,
@@ -409,6 +409,102 @@ _GEN_START = {Convention.C: 1, Convention.CUDA: 0}
 _REPORT = {Convention.C: lambda gen: gen - 1, Convention.CUDA: lambda gen: gen}
 
 
+# Substrings that mark a kernel *compile* failure (Mosaic lowering/VMEM
+# exhaustion, XLA resource errors) as opposed to a user error like a
+# wrong-shaped operand — only the former may demote the kernel ladder.
+_COMPILE_FAILURE_MARKS = (
+    "mosaic",
+    "resource_exhausted",
+    "resource exhausted",
+    "vmem",
+    "ran out of memory",
+    "out of memory",
+    "scoped memory",
+)
+
+
+def _is_compile_failure(err: Exception) -> bool:
+    text = f"{type(err).__name__}: {err}".lower()
+    return any(mark in text for mark in _COMPILE_FAILURE_MARKS)
+
+
+class _KernelFallback:
+    """A runner that demotes down a kernel ladder if its first compile fails.
+
+    Pallas compiles lazily — at the first call, not at build time — and the
+    packed/pallas VMEM caps are v5e-empirical, so another TPU generation can
+    Mosaic-OOM a shape inside them. The reference never dies on a supported
+    shape (src/game.c:224-245 runs anything malloc can hold); this wrapper
+    matches that bar: on a first-call *compile* failure (``
+    _is_compile_failure`` — user errors like wrong-shaped operands still
+    raise) it warns on stderr and retries with the next kernel
+    (packed -> packed-jnp -> lax). Once any call has succeeded the ladder is
+    frozen — later failures are real errors and propagate (a mid-run
+    demotion would silently change the measured kernel).
+
+    Multi-process runs never demote: the decision is process-local, and two
+    processes settling on different kernels would run different collective
+    programs — a distributed deadlock, not a fallback.
+    """
+
+    def __init__(self, builders, names, context: str):
+        self._builders = list(builders)  # () -> jitted fn, lazy
+        self._names = list(names)
+        self._context = context
+        self._fns = [None] * len(self._builders)
+        self._idx = 0
+        self._settled = False
+
+    def _fn(self):
+        if self._fns[self._idx] is None:
+            self._fns[self._idx] = self._builders[self._idx]()
+        return self._fns[self._idx]
+
+    @property
+    def kernel_name(self) -> str:
+        """The currently-selected ladder entry (telemetry/tests)."""
+        return self._names[self._idx]
+
+    def __call__(self, *args):
+        import sys
+
+        while True:
+            try:
+                out = self._fn()(*args)
+            except Exception as err:
+                demotable = (
+                    not self._settled
+                    and self._idx + 1 < len(self._names)
+                    and _is_compile_failure(err)
+                )
+                if demotable and jax.process_count() > 1:
+                    sys.stderr.write(
+                        f"gol_tpu: kernel {self._names[self._idx]!r} failed "
+                        f"to compile for {self._context}, but this is a "
+                        f"{jax.process_count()}-process run — refusing the "
+                        "process-local demotion (peers may have compiled; "
+                        "mixed kernels deadlock at the next collective). "
+                        "Pick the fallback explicitly on every process.\n"
+                    )
+                    raise
+                if not demotable:
+                    raise
+                sys.stderr.write(
+                    f"gol_tpu: kernel {self._names[self._idx]!r} failed to "
+                    f"compile for {self._context}; falling back to "
+                    f"{self._names[self._idx + 1]!r} "
+                    f"({type(err).__name__}: {str(err)[:200]})\n"
+                )
+                self._idx += 1
+                continue
+            self._settled = True
+            return out
+
+    def __getattr__(self, name):
+        # .lower()/.trace() etc. delegate to the current jitted fn.
+        return getattr(self._fn(), name)
+
+
 def _build_runner(
     shape: tuple[int, int],
     config: GameConfig,
@@ -425,6 +521,11 @@ def _build_runner(
     array and never touch the uint8 grid; otherwise kernels with their own
     carried representation convert once at the loop boundary. ``segmented``
     runners take/return the resume scalars for snapshotting drivers.
+
+    The auto lane and the packed-state lane return a ``_KernelFallback``
+    ladder (compile failures demote instead of crashing); an explicitly
+    named unpacked kernel stays strict — the caller asked for that kernel
+    and a silent demotion would mislabel benchmark numbers.
     """
     topology = topology_for(mesh)
     local_h, local_w = validate_grid(shape[0], shape[1], topology)
@@ -443,58 +544,75 @@ def _build_runner(
         )
     simulate = _SIMULATORS[config.convention]
     report = _REPORT[config.convention]
-    encode = None if packed_state else kernel_obj.encode
-    decode = None if packed_state else kernel_obj.decode
-    if kernel_obj.fused_multi is not None and not kernel_obj.supports_multi(
-        local_h, local_w, topology
-    ):
-        # The temporally-blocked pass only where the kernel supports it.
-        # Both conventions consume it: the C block replays exits from flag
-        # vectors (fixed points), the CUDA block additionally recovers the
-        # pre-step state on empty exits (_simulate_cuda_block).
-        kernel_obj = dataclasses.replace(kernel_obj, fused_multi=None)
 
-    if segmented:
+    def jit_for(kobj: Kernel):
+        encode = None if packed_state else kobj.encode
+        decode = None if packed_state else kobj.decode
+        if kobj.fused_multi is not None and not kobj.supports_multi(
+            local_h, local_w, topology
+        ):
+            # The temporally-blocked pass only where the kernel supports it.
+            # Both conventions consume it: the C block replays exits from flag
+            # vectors (fixed points), the CUDA block additionally recovers the
+            # pre-step state on empty exits (_simulate_cuda_block).
+            kobj = dataclasses.replace(kobj, fused_multi=None)
 
-        def local_fn(g, gen0, counter0, seg_end):
-            if encode is not None:
-                g = encode(g)
-            final, gen, counter, stopped = simulate(
-                g, config, topology, kernel_obj, resume=(gen0, counter0, seg_end)
+        if segmented:
+
+            def local_fn(g, gen0, counter0, seg_end):
+                if encode is not None:
+                    g = encode(g)
+                final, gen, counter, stopped = simulate(
+                    g, config, topology, kobj, resume=(gen0, counter0, seg_end)
+                )
+                if decode is not None:
+                    final = decode(final)
+                return final, gen, counter, stopped
+
+            in_specs = (P(*topology.axes), P(), P(), P())
+            out_specs = (P(*topology.axes), P(), P(), P())
+        else:
+
+            def local_fn(g):
+                if encode is not None:
+                    g = encode(g)
+                final, gen, _, _ = simulate(g, config, topology, kobj)
+                if decode is not None:
+                    final = decode(final)
+                return final, report(gen)
+
+            in_specs = P(*topology.axes)
+            out_specs = (P(*topology.axes), P())
+
+        if topology.distributed:
+            fn = jax.shard_map(
+                local_fn,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                # vma tracking does not yet thread through pallas_call kernel
+                # constants, so the check is off for the Pallas-bearing kernels
+                # (the JAX-documented workaround) but kept for the lax path.
+                check_vma=kobj.name == "lax",
             )
-            if decode is not None:
-                final = decode(final)
-            return final, gen, counter, stopped
+        else:
+            fn = local_fn
+        return jax.jit(fn)
 
-        in_specs = (P(*topology.axes), P(), P(), P())
-        out_specs = (P(*topology.axes), P(), P(), P())
-    else:
-
-        def local_fn(g):
-            if encode is not None:
-                g = encode(g)
-            final, gen, _, _ = simulate(g, config, topology, kernel_obj)
-            if decode is not None:
-                final = decode(final)
-            return final, report(gen)
-
-        in_specs = P(*topology.axes)
-        out_specs = (P(*topology.axes), P())
-
-    if topology.distributed:
-        fn = jax.shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=out_specs,
-            # vma tracking does not yet thread through pallas_call kernel
-            # constants, so the check is off for the Pallas-bearing kernels
-            # (the JAX-documented workaround) but kept for the lax path.
-            check_vma=kernel_obj.name == "lax",
-        )
-    else:
-        fn = local_fn
-    return jax.jit(fn)
+    if kernel != "auto" and not packed_state:
+        return jit_for(kernel_obj)
+    chain = fallback_chain(kernel_obj, local_h, local_w, topology,
+                           packed_state=packed_state)
+    if len(chain) == 1:
+        return jit_for(chain[0])
+    return _KernelFallback(
+        [functools.partial(jit_for, k) for k in chain],
+        [k.name for k in chain],
+        context=(
+            f"a {local_h}x{local_w} shard on a "
+            f"{topology.shape[0]}x{topology.shape[1]} topology"
+        ),
+    )
 
 
 @functools.lru_cache(maxsize=64)

@@ -56,6 +56,8 @@ def lax_evolve(cur, topology: Topology):
 def _registry() -> dict[str, Kernel]:
     kernels = {"lax": Kernel(name="lax", step=lax_evolve)}
     try:
+        import functools
+
         from gol_tpu.ops import stencil_packed, stencil_pallas
 
         kernels["pallas"] = Kernel(
@@ -64,19 +66,34 @@ def _registry() -> dict[str, Kernel]:
             fused=stencil_pallas.pallas_step,
             supports=stencil_pallas.supports,
         )
-        kernels["packed"] = Kernel(
-            name="packed",
-            step=lambda cur, topo: stencil_packed.decode(
-                stencil_packed.packed_step(stencil_packed.encode(cur), topo)[0]
-            ),
-            fused=stencil_packed.packed_step,
-            supports=stencil_packed.supports,
-            encode=stencil_packed.encode,
-            decode=stencil_packed.decode,
-            fused_multi=stencil_packed.packed_step_multi,
-            multi_gens=stencil_packed.TEMPORAL_GENS,
-            supports_multi=stencil_packed.supports_multi,
-        )
+
+        def _packed(force_jnp: bool) -> Kernel:
+            fused = functools.partial(stencil_packed.packed_step,
+                                      force_jnp=force_jnp)
+            return Kernel(
+                name="packed-jnp" if force_jnp else "packed",
+                step=lambda cur, topo: stencil_packed.decode(
+                    fused(stencil_packed.encode(cur), topo)[0]
+                ),
+                fused=fused,
+                supports=stencil_packed.supports,
+                encode=stencil_packed.encode,
+                decode=stencil_packed.decode,
+                fused_multi=functools.partial(stencil_packed.packed_step_multi,
+                                              force_jnp=force_jnp),
+                multi_gens=stencil_packed.TEMPORAL_GENS,
+                supports_multi=stencil_packed.supports_multi,
+            )
+
+        kernels["packed"] = _packed(False)
+        # The Mosaic-compile-failure demotion target: identical word-state
+        # semantics through the jnp adder network, no Pallas anywhere. Not
+        # offered by `auto` directly — engine._KernelFallback engages it when
+        # the packed kernel's first compile fails (the VMEM caps are
+        # v5e-empirical; another TPU generation may refuse a shape inside
+        # them, and the reference never dies on a supported shape,
+        # src/game.c:224-245).
+        kernels["packed-jnp"] = _packed(True)
     except ImportError:  # pragma: no cover - pallas unavailable on some backends
         pass
     return kernels
@@ -111,3 +128,33 @@ def resolve_kernel(name: str, height: int, width: int, topology: Topology) -> Ke
         if kernel is not None and kernel.supports(height, width, topology):
             return kernel
     return kernels["lax"]
+
+
+def fallback_chain(kernel: Kernel, height: int, width: int, topology: Topology,
+                   *, packed_state: bool) -> list[Kernel]:
+    """The compile-failure demotion ladder behind ``kernel``, best first.
+
+    Pallas compiles lazily — at the engine runner's first call, not at
+    resolution time — and the packed/pallas VMEM caps are v5e-empirical
+    constants, so on another TPU generation a shape inside the caps can
+    Mosaic-OOM at compile. The engine wraps the runner's first call and
+    demotes down this ladder instead of crashing (the reference bar: no
+    supported shape ever aborts, src/game.c:224-245):
+
+      packed -> packed-jnp (-> lax)     pallas -> lax
+
+    ``packed_state`` runners carry uint32 word state, which only the packed
+    family speaks — their ladder stops at packed-jnp. Fallback entries that
+    do not support the shape are dropped (today none: packed-jnp shares
+    packed's `supports` and lax supports everything, but the filter keeps
+    the invariant checked rather than assumed).
+    """
+    kernels = _registry()
+    chain = [kernel]
+    if kernel.name == "packed":
+        chain.append(kernels["packed-jnp"])
+    if not packed_state and kernel.name != "lax":
+        chain.append(kernels["lax"])
+    return [chain[0]] + [
+        k for k in chain[1:] if k.supports(height, width, topology)
+    ]

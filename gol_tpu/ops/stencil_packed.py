@@ -564,7 +564,8 @@ def _jnp_multi(state, prev0, interior):
     )
 
 
-def _distributed_step_multi(words: jnp.ndarray, topology: Topology):
+def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
+                            force_jnp: bool = False):
     """Shard-local temporal pass: deep halo, then TEMPORAL_GENS generations.
 
     The ghost word rows and columns ride as banded kernel operands
@@ -572,7 +573,7 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology):
     plane is ever materialized around the shard array."""
     T = TEMPORAL_GENS
     h, nwords = words.shape
-    if jax.default_backend() != "tpu" and not _FORCE_KERNEL_OFF_TPU:
+    if force_jnp or (jax.default_backend() != "tpu" and not _FORCE_KERNEL_OFF_TPU):
         # Identical math at jnp level: torus rolls over the extended block
         # wrap garbage only into the invalid frontier (never the interior).
         xe = exchange_packed_deep(words, topology)
@@ -609,7 +610,8 @@ def deep_ghost_operands(words: jnp.ndarray, topology: Topology):
     return gtop, gbot, G_ext
 
 
-def packed_step_multi(cur: jnp.ndarray, topology: Topology):
+def packed_step_multi(cur: jnp.ndarray, topology: Topology, *,
+                      force_jnp: bool = False):
     """TEMPORAL_GENS fused generations:
     ``words -> (words_T, alive_vec, similar_vec)``.
 
@@ -618,13 +620,18 @@ def packed_step_multi(cur: jnp.ndarray, topology: Topology):
     compute is the jnp adder network (identical math); on TPU it is the
     temporally-blocked band kernel. Distributed shards run the deep-halo
     form (one exchange per TEMPORAL_GENS generations).
+
+    ``force_jnp`` routes every branch through the jnp adder network even on
+    TPU — the engine's demotion target when Mosaic refuses to compile a
+    shape the empirical VMEM caps admit (the reference bar: no supported
+    shape ever aborts, src/game.c:224-245).
     """
     height, nwords = cur.shape
     if not supports_multi(height, nwords * _BITS, topology):
         raise ValueError("packed_step_multi requires a supported shape/topology")
     if topology.distributed:
-        return _distributed_step_multi(cur, topology)
-    if jax.default_backend() != "tpu":
+        return _distributed_step_multi(cur, topology, force_jnp)
+    if force_jnp or jax.default_backend() != "tpu":
         return _jnp_multi(cur, cur, (slice(None), slice(None)))
     return _step_t(cur)
 
@@ -785,7 +792,8 @@ def _dist_step_pallas(words, gtop8, gbot8, gmid, gwrap, interpret=False):
     return new, alive[0, 0] > 0, similar[0, 0] > 0
 
 
-def _distributed_step(words: jnp.ndarray, topology: Topology):
+def _distributed_step(words: jnp.ndarray, topology: Topology,
+                      force_jnp: bool = False):
     """Shard-local packed step under shard_map.
 
     The halo is the two-phase ppermute exchange (word rows N/S, bit columns
@@ -797,7 +805,7 @@ def _distributed_step(words: jnp.ndarray, topology: Topology):
     h, nwords = words.shape
     top, bot, gwest, geast = exchange_packed(words, topology)
     on_tpu = jax.default_backend() == "tpu"
-    if h % _SUBLANES == 0 and (on_tpu or _FORCE_KERNEL_OFF_TPU):
+    if h % _SUBLANES == 0 and not force_jnp and (on_tpu or _FORCE_KERNEL_OFF_TPU):
         # Off TPU the compiled kernel would be the Mosaic interpreter per
         # generation; the jnp network below is the identical math at full
         # XLA:CPU speed (the _FORCE_KERNEL_OFF_TPU test hook still routes
@@ -812,12 +820,15 @@ def _distributed_step(words: jnp.ndarray, topology: Topology):
     return new, jnp.any(new != 0), jnp.all(new == words)
 
 
-def packed_step(cur: jnp.ndarray, topology: Topology):
+def packed_step(cur: jnp.ndarray, topology: Topology, *,
+                force_jnp: bool = False):
     """Fused generation step on packed state: ``words -> (words, alive, similar)``.
 
     Single device: the compiled Pallas band kernel. Distributed: the same
     band kernel fed ppermute'd ghost rows and bit-column carries (jnp adder
-    network only for odd shard heights).
+    network only for odd shard heights). ``force_jnp`` routes everything
+    through the jnp adder network even on TPU (the Mosaic-compile-failure
+    demotion target; see ``packed_step_multi``).
     """
     height, nwords = cur.shape
     if not supports(height, nwords * _BITS, topology):
@@ -828,8 +839,8 @@ def packed_step(cur: jnp.ndarray, topology: Topology):
             f"{topology.shape[1]} devices — use kernel='lax' (or 'auto')"
         )
     if topology.distributed:
-        return _distributed_step(cur, topology)
-    if jax.default_backend() != "tpu":
+        return _distributed_step(cur, topology, force_jnp)
+    if force_jnp or jax.default_backend() != "tpu":
         # Off-TPU the jnp adder network beats running Mosaic's interpreter;
         # the kernel body itself is covered by interpret-mode tests.
         new = packed_math.evolve_torus_words(cur)

@@ -255,6 +255,122 @@ def test_runner_cache_equal_meshes():
     assert r1 is r2
 
 
+class TestCompileFailureFallback:
+    """The auto/packed lanes must survive a kernel that fails to compile.
+
+    The packed VMEM caps are v5e-empirical; on another TPU generation a shape
+    inside the caps can Mosaic-OOM at the runner's first (lazy) compile. The
+    reference never dies on a supported shape (src/game.c:224-245), so the
+    engine demotes packed -> packed-jnp -> lax with a stderr warning instead
+    of crashing. Simulated here by making the packed step raise at trace time
+    — same surface as a Mosaic compile error (first runner call).
+    """
+
+    def _boom_packed(self, monkeypatch, jnp_ok: bool):
+        from gol_tpu.ops import stencil_packed
+
+        orig_step = stencil_packed.packed_step
+        orig_multi = stencil_packed.packed_step_multi
+
+        def step(cur, topo, *, force_jnp=False):
+            if not (jnp_ok and force_jnp):
+                raise RuntimeError("simulated Mosaic compile OOM")
+            return orig_step(cur, topo, force_jnp=True)
+
+        def multi(cur, topo, *, force_jnp=False):
+            if not (jnp_ok and force_jnp):
+                raise RuntimeError("simulated Mosaic compile OOM")
+            return orig_multi(cur, topo, force_jnp=True)
+
+        monkeypatch.setattr(stencil_packed, "packed_step", step)
+        monkeypatch.setattr(stencil_packed, "packed_step_multi", multi)
+
+    def test_auto_demotes_to_lax(self, monkeypatch, capsys):
+        # Both packed flavors fail -> the auto lane lands on lax and the run
+        # still matches the oracle; each demotion warns on stderr.
+        self._boom_packed(monkeypatch, jnp_ok=False)
+        runner = engine._build_runner(
+            (64, 64), GameConfig(gen_limit=20), None, "auto",
+            segmented=False, packed_state=False,
+        )
+        assert runner.kernel_name == "packed"
+        g = text_grid.generate(64, 64, seed=11)
+        final, gen = runner(engine.put_grid(g))
+        assert runner.kernel_name == "lax"
+        want = oracle.run(g, GameConfig(gen_limit=20))
+        assert int(gen) == want.generations
+        assert np.array_equal(np.asarray(final), want.grid)
+        err = capsys.readouterr().err
+        assert "falling back to 'packed-jnp'" in err
+        assert "falling back to 'lax'" in err
+
+    def test_packed_state_demotes_to_jnp_network(self, monkeypatch, capsys):
+        # The packed-state lane carries word state, so its ladder stops at
+        # the jnp adder network — identical math, no Pallas.
+        from gol_tpu.ops import packed_math
+
+        self._boom_packed(monkeypatch, jnp_ok=True)
+        runner = engine._build_runner(
+            (64, 64), GameConfig(gen_limit=20), None, "packed",
+            segmented=False, packed_state=True,
+        )
+        g = text_grid.generate(64, 64, seed=12)
+        final, gen = runner(packed_math.encode(g))
+        assert runner.kernel_name == "packed-jnp"
+        want = oracle.run(g, GameConfig(gen_limit=20))
+        assert int(gen) == want.generations
+        assert np.array_equal(packed_math.decode(np.asarray(final)), want.grid)
+        assert "falling back to 'packed-jnp'" in capsys.readouterr().err
+
+    def test_auto_demotes_on_mesh(self, monkeypatch):
+        # Distributed demotion: the ladder rebuilds the whole shard_map
+        # program per entry, and the lax landing stays oracle-exact.
+        self._boom_packed(monkeypatch, jnp_ok=False)
+        mesh = make_mesh(2, 2)
+        runner = engine._build_runner(
+            (64, 64), GameConfig(gen_limit=12), mesh, "auto",
+            segmented=False, packed_state=False,
+        )
+        g = text_grid.generate(64, 64, seed=13)
+        final, gen = runner(engine.put_grid(g, mesh))
+        assert runner.kernel_name == "lax"
+        want = oracle.run(g, GameConfig(gen_limit=12))
+        assert int(gen) == want.generations
+        assert np.array_equal(np.asarray(final), want.grid)
+
+    def test_non_compile_errors_do_not_demote(self, monkeypatch):
+        # Only compile-shaped failures (Mosaic/VMEM/OOM) may demote; a user
+        # error raised at trace time must propagate from the chosen kernel,
+        # not silently land on lax with the root cause buried in stderr.
+        from gol_tpu.ops import stencil_packed
+
+        def boom(cur, topo, *, force_jnp=False):
+            raise ValueError("width must be a multiple of 32 (user error)")
+
+        monkeypatch.setattr(stencil_packed, "packed_step", boom)
+        monkeypatch.setattr(stencil_packed, "packed_step_multi", boom)
+        runner = engine._build_runner(
+            (64, 64), GameConfig(gen_limit=5), None, "auto",
+            segmented=False, packed_state=False,
+        )
+        g = text_grid.generate(64, 64, seed=15)
+        with pytest.raises(ValueError, match="user error"):
+            runner(engine.put_grid(g))
+        assert runner.kernel_name == "packed"  # never demoted
+
+    def test_explicit_kernel_stays_strict(self, monkeypatch):
+        # An explicitly named unpacked kernel must NOT silently demote — that
+        # would mislabel benchmark numbers. The failure propagates.
+        self._boom_packed(monkeypatch, jnp_ok=False)
+        runner = engine._build_runner(
+            (64, 64), GameConfig(gen_limit=5), None, "packed",
+            segmented=False, packed_state=False,
+        )
+        g = text_grid.generate(64, 64, seed=14)
+        with pytest.raises(RuntimeError, match="simulated Mosaic"):
+            runner(engine.put_grid(g))
+
+
 def test_no_collective_under_conditional():
     # A psum under a data-dependent lax.cond deadlocks backends that cannot
     # prove the predicate SPMD-uniform. The engine's similarity vote keeps the
