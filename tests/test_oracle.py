@@ -227,3 +227,86 @@ class TestCudaConventionExternalGroundTruth:
         assert got.generations == c_gens
         text_grid.write_grid("engine.out", got.grid)
         assert c_bytes == open("engine.out", "rb").read()
+
+
+class TestMpiLoopExternalGroundTruth:
+    """The MPI variants' loop accounting pinned by execution, not reading.
+
+    mpicc is absent, so C2-C5 parity rested on reading the C; mpi_loop.c is
+    a serial reimplementation of the game_mpi_collective.c driver loop
+    (generation=1 init, empty_all at the top of every iteration, halo ->
+    evolve -> swap -> post-swap similarity breaking before generation++,
+    `generation - 1` reported — src/game_mpi_collective.c:220,331-370),
+    compiled and byte-compared here against `--variant collective`."""
+
+    @pytest.fixture(scope="class")
+    def c_binary(self, tmp_path_factory):
+        import os
+        import shutil
+        import subprocess
+
+        cc = next((c for c in ("cc", "gcc", "clang") if shutil.which(c)), None)
+        if cc is None:
+            pytest.skip("no C toolchain on PATH")
+        src = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".claude", "skills", "verify", "mpi_loop.c",
+        )
+        exe = str(tmp_path_factory.mktemp("cbin") / "mpi_loop")
+        subprocess.run([cc, "-std=c99", "-O2", "-o", exe, src], check=True)
+        return exe
+
+    @pytest.mark.parametrize(
+        "case", ["random", "still_life", "lone_cell", "all_dead"]
+    )
+    def test_matches_collective_variant(
+        self, c_binary, case, tmp_path, monkeypatch, capsys
+    ):
+        import os
+        import subprocess
+
+        from gol_tpu import cli
+        from gol_tpu.io import text_grid
+
+        monkeypatch.chdir(tmp_path)
+        if case == "random":
+            g = np.asarray(text_grid.generate(48, 48, seed=21))
+        else:
+            g = np.zeros((16, 16), np.uint8)
+            if case == "still_life":
+                g[4:6, 4:6] = 1
+            elif case == "lone_cell":
+                g[8, 8] = 1
+        text_grid.write_grid("in.txt", g)
+        h, w = g.shape
+        p = subprocess.run(
+            [c_binary, str(w), str(h), "in.txt", "60"],
+            capture_output=True, text=True, check=True,
+        )
+        c_gens = int(
+            [l for l in p.stdout.splitlines() if l.startswith("Generations")][0]
+            .split("\t")[1]
+        )
+        c_bytes = open("collective_output.out", "rb").read()
+        os.rename("collective_output.out", "c_ground_truth.out")
+
+        rc = cli.main(
+            [str(w), str(h), "in.txt", "--variant", "collective",
+             "--gen-limit", "60"]
+        )
+        assert rc in (0, None)
+        out = capsys.readouterr().out
+        our_gens = int(
+            [l for l in out.splitlines() if l.startswith("Generations")][0]
+            .split("\t")[1]
+        )
+        assert our_gens == c_gens
+        assert open("collective_output.out", "rb").read() == c_bytes
+
+        # The single C convention is exact: the MPI loop's accounting equals
+        # the serial oracle's (VERDICT r2 verified the C sources agree; this
+        # executes that claim).
+        expect = oracle.run(g, GameConfig(gen_limit=60))
+        assert expect.generations == c_gens
+        text_grid.write_grid("oracle.out", expect.grid)
+        assert open("oracle.out", "rb").read() == c_bytes
