@@ -323,7 +323,7 @@ def test_ghost_operand_temporal_kernel_interpret(shape):
     T = sp.TEMPORAL_GENS
     words = sp.encode(jnp.asarray(g))
     gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
-    assert gtop.shape == (T, w // 32) and G_ext.shape == (h + 2 * T, 128)
+    assert gtop.shape == (T, w // 32) and G_ext.shape == (h + 2 * T, 2)
     new, alive, similar = sp._step_tgb(words, gtop, gbot, G_ext, interpret=True)
     got = np.asarray(sp.decode(new))
     states = [g]
