@@ -30,3 +30,66 @@ if not os.environ.get("GOL_TPU_HW"):
 # everything for the TPU).
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# Hardware-lane evidence artifact: GOL_TPU_HW=1 runs record every hardware
+# test's outcome to benchmarks/tpu_hw_r<N>.json so the "verified on v5e"
+# claims in kernel comments are auditable files, not git-log prose.
+_HW_ARTIFACT_ROUND = 3
+_hw_results: list[dict] = []
+
+
+def pytest_runtest_logreport(report):
+    if not os.environ.get("GOL_TPU_HW"):
+        return
+    # Record calls AND setup/teardown errors — a fixture blow-up must show
+    # as an error in the artifact, not vanish into an all-green payload.
+    if report.when == "call":
+        outcome = report.outcome
+    elif report.failed:
+        outcome = "error"
+    else:
+        return
+    _hw_results.append(
+        {
+            "test": report.nodeid,
+            "outcome": outcome,
+            "duration_s": round(report.duration, 3),
+        }
+    )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not os.environ.get("GOL_TPU_HW") or not _hw_results:
+        return
+    import json
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "benchmarks", f"tpu_hw_r{_HW_ARTIFACT_ROUND:02d}.json")
+    # A partial run (pytest -k ...) must not clobber fuller evidence.
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+            if len(prior.get("tests", [])) > len(_hw_results):
+                path = path.replace(".json", "-partial.json")
+        except (OSError, ValueError):
+            pass
+    payload = {
+        "lane": "GOL_TPU_HW=1 pytest tests/test_tpu_hw.py",
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "exitstatus": int(exitstatus),
+        "passed": sum(1 for r in _hw_results if r["outcome"] == "passed"),
+        "failed": sum(
+            1 for r in _hw_results if r["outcome"] in ("failed", "error")
+        ),
+        "tests": _hw_results,
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")

@@ -69,7 +69,9 @@ def test_temporal_kernel_matches_8_network_generations():
 
 def test_mesh_form_kernels_match_network():
     # SINGLE_DEVICE topology: the ghost-operand kernels with local wrap —
-    # the compiled code a pod shard runs, minus the ppermutes.
+    # the compiled code a pod shard runs, minus the ppermutes. The temporal
+    # form routes through the overlapped interior/frontier split (three
+    # frontier kernels + frame-masked interior + stitch) for nwords >= 2.
     words = _random_words(256, 48, seed=4)
     ref1 = packed_math.evolve_torus_words(words)
     new1 = sp._distributed_step(words, SINGLE_DEVICE)[0]
@@ -79,6 +81,18 @@ def test_mesh_form_kernels_match_network():
     for _ in range(sp.TEMPORAL_GENS):
         cur = packed_math.evolve_torus_words(cur)
     newt, a_vec, s_vec = sp._distributed_step_multi(words, SINGLE_DEVICE)
+    assert np.array_equal(np.asarray(newt), np.asarray(cur))
+    assert np.asarray(a_vec).tolist() == [1] * sp.TEMPORAL_GENS
+
+
+def test_mesh_temporal_single_word_branch():
+    # nwords == 1 has no column interior; the sequential banded form
+    # (_step_tgb on the whole shard) still serves it, compiled on hardware.
+    words = _random_words(64, 1, seed=8)
+    cur = words
+    for _ in range(sp.TEMPORAL_GENS):
+        cur = packed_math.evolve_torus_words(cur)
+    newt, a_vec, _ = sp._distributed_step_multi(words, SINGLE_DEVICE)
     assert np.array_equal(np.asarray(newt), np.asarray(cur))
     assert np.asarray(a_vec).tolist() == [1] * sp.TEMPORAL_GENS
 
