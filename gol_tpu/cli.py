@@ -150,6 +150,27 @@ def _run(args) -> int:
             f"{config.gen_limit}; nothing to resume"
         )
 
+    # The zarr guards depend only on argv, so they run before every lane
+    # (including --host, which would otherwise read_grid a .zarr directory).
+    if args.snapshot_format == "zarr":
+        if not args.packed_io:
+            raise ValueError(
+                "--snapshot-format zarr stores the bitpacked word state and "
+                "needs the packed lane; add --packed-io"
+            )
+        from gol_tpu.io import ts_store
+
+        if not ts_store.HAVE_TENSORSTORE:
+            raise ValueError(
+                "--snapshot-format zarr needs tensorstore, which is not "
+                "installed; use --snapshot-format text"
+            )
+    if args.input_file and args.input_file.endswith(".zarr") and not args.packed_io:
+        raise ValueError(
+            "a .zarr input (TensorStore snapshot) holds packed word state; "
+            "add --packed-io to resume from it"
+        )
+
     if args.host:
         # lax is what the host oracle effectively is, so it stays accepted;
         # forcing an accelerator kernel alongside --host is a contradiction.
@@ -202,7 +223,7 @@ def _run(args) -> int:
                                   packed=False, kernel=args.kernel)
     else:
         runner = engine.make_runner((height, width), config, mesh, args.kernel)
-        compiled = runner.lower(device_grid).compile()
+        compiled = engine.compile_runner(runner, device_grid)
         if args.warmup:
             # One discarded run: absorbs runtime/program-upload init that
             # would otherwise land in Execution time (remote-attached
@@ -252,7 +273,14 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
     from gol_tpu.io import packed_io
 
     t0 = time.perf_counter()
-    words = packed_io.read_packed(args.input_file, width, height, mesh)
+    if args.input_file.endswith(".zarr"):
+        # A TensorStore snapshot (gen_NNNNNN.zarr) resumes directly on the
+        # packed lane — the object-store counterpart of text resume.
+        from gol_tpu.io import ts_store
+
+        words = ts_store.read_words(args.input_file, width, height, mesh)
+    else:
+        words = packed_io.read_packed(args.input_file, width, height, mesh)
     read_ms = (time.perf_counter() - t0) * 1000
     if variant.io_timings:
         print(f"Reading file:\t{read_ms:.2f} msecs")
@@ -264,7 +292,7 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
                                   packed=True)
     else:
         runner = engine.make_packed_runner((height, width), config, mesh)
-        compiled = runner.lower(words).compile()
+        compiled = engine.compile_runner(runner, words)
         if args.warmup:
             _, g0 = compiled(words)
             int(g0)
@@ -288,11 +316,21 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
 
 def _prepare_packed_segmented(args, config, mesh, words, height, width):
     """Snapshotting loop over word state: every snapshot is written through
-    the packed codec and is itself a valid (packed-readable) input file —
-    the reference's resume property at packed-lane scale."""
+    the packed codec (text format — itself a valid packed-readable input
+    file, the reference's resume property at packed-lane scale) or, with
+    --snapshot-format zarr, through the sharded TensorStore lane (pod
+    object stores with no shared POSIX mmap; io/ts_store.py)."""
     from gol_tpu.io import packed_io
 
     runner = engine.make_packed_segment_runner((height, width), config, mesh)
+    if args.snapshot_format == "zarr":
+        from gol_tpu.io import ts_store
+
+        write = lambda path, state: ts_store.write_words(path, state, width)
+        suffix = ".zarr"
+    else:
+        write = lambda path, state: packed_io.write_packed(path, state, width)
+        suffix = ".out"
     return _snapshot_loop(
         args,
         config,
@@ -302,7 +340,8 @@ def _prepare_packed_segmented(args, config, mesh, words, height, width):
             words, (height, width), config, mesh, args.snapshot_every,
             completed=args.resume_gen,
         ),
-        lambda path, state: packed_io.write_packed(path, state, width),
+        write,
+        suffix=suffix,
     )
 
 
@@ -354,7 +393,8 @@ def _profile_trace(profile_dir: str | None):
     return jax.profiler.trace(profile_dir)
 
 
-def _snapshot_loop(args, config, runner, state0, segments, write_snapshot):
+def _snapshot_loop(args, config, runner, state0, segments, write_snapshot,
+                   suffix=".out"):
     """Shared snapshotting driver: compile and init outside the timer.
 
     A zero-step segment call compiles the program and uploads it to the
@@ -380,7 +420,7 @@ def _snapshot_loop(args, config, runner, state0, segments, write_snapshot):
         final, generations = state0, 0
         for generations, final, _stopped in segments():
             write_snapshot(
-                os.path.join(outdir, f"gen_{generations:06d}.out"), final
+                os.path.join(outdir, f"gen_{generations:06d}{suffix}"), final
             )
         return final, generations
 
@@ -505,6 +545,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--snapshot-dir", default=None, help="snapshot directory (default ./snapshots)"
+    )
+    run.add_argument(
+        "--snapshot-format",
+        choices=("text", "zarr"),
+        default="text",
+        help="snapshot encoding: 'text' writes gen_NNNNNN.out files (valid "
+        "input files, the reference's output-is-input resume); 'zarr' "
+        "(packed lane only) writes sharded TensorStore stores — every host "
+        "writes only its own shards, no shared POSIX mmap needed (pod "
+        "object stores); resume by passing the gen_NNNNNN.zarr path as the "
+        "input file with --resume-gen N",
     )
     run.add_argument(
         "--resume-gen",

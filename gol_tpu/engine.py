@@ -465,12 +465,15 @@ class _KernelFallback:
         """The currently-selected ladder entry (telemetry/tests)."""
         return self._names[self._idx]
 
-    def __call__(self, *args):
+    def _attempt(self, thunk):
+        """Run ``thunk`` against the current ladder entry, demoting on
+        compile-shaped failures — the single copy of the ladder policy,
+        shared by ``__call__`` and ``compile_aot``."""
         import sys
 
         while True:
             try:
-                out = self._fn()(*args)
+                out = thunk()
             except Exception as err:
                 demotable = (
                     not self._settled
@@ -500,9 +503,28 @@ class _KernelFallback:
             self._settled = True
             return out
 
+    def __call__(self, *args):
+        return self._attempt(lambda: self._fn()(*args))
+
+    def compile_aot(self, *args):
+        """AOT-compile down the ladder: ``lower(*args).compile()`` with the
+        same demotion rules as ``__call__`` (the CLI compiles before its
+        timer, so compile failures must demote HERE, not at first call)."""
+        return self._attempt(lambda: self._fn().lower(*args).compile())
+
     def __getattr__(self, name):
         # .lower()/.trace() etc. delegate to the current jitted fn.
         return getattr(self._fn(), name)
+
+
+def compile_runner(runner, *args):
+    """AOT-compile any runner the factories produce, fallback-aware.
+
+    Plain jitted runners compile strictly; ladder runners demote on compile
+    failure exactly as their first call would."""
+    if isinstance(runner, _KernelFallback):
+        return runner.compile_aot(*args)
+    return runner.lower(*args).compile()
 
 
 def _build_runner(

@@ -1,0 +1,146 @@
+"""TensorStore-backed sharded state storage (BASELINE.md config 5's
+"sharded TensorStore I/O").
+
+The text-grid files keep the reference's byte contract on POSIX filesystems
+(io/sharded.py, io/packed_io.py — the MPI-IO analog,
+src/game_mpi_collective.c:174-196,425-443). This module is the lane those
+memmap windows cannot serve: pod object-store filesystems with no shared
+POSIX mmap. The bitpacked word state is stored as a zarr array whose chunk
+grid aligns with the mesh's shard blocks, so
+
+- every host writes ONLY its addressable shards (no gather, no cross-host
+  traffic — the collective-write discipline of MPI_File_write_all),
+- reads reassemble a sharded `jax.Array` via per-shard chunk reads,
+- the store works over any TensorStore kvstore (file://, gs://, s3://).
+
+Snapshots stored this way carry the same no-sidecar resume property as text
+snapshots: the array plus its generation count (in the store path, like
+gen_NNNNNN) is a complete checkpoint (engine.resume_scalars).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from gol_tpu.ops.packed_math import BITS
+from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
+
+try:  # tensorstore ships with orbax; gate so the POSIX lanes never need it
+    import tensorstore as ts
+
+    HAVE_TENSORSTORE = True
+except ImportError:  # pragma: no cover - present in this image
+    ts = None
+    HAVE_TENSORSTORE = False
+
+
+def _require():
+    if not HAVE_TENSORSTORE:
+        raise RuntimeError(
+            "tensorstore is not installed; the POSIX text/packed lanes "
+            "(io/sharded.py, io/packed_io.py) cover shared filesystems"
+        )
+
+
+def _spec(path: str, shape=None, chunks=None):
+    spec = {
+        "driver": "zarr",
+        "kvstore": path if "://" in path else {"driver": "file", "path": path},
+    }
+    if shape is not None:
+        spec["metadata"] = {
+            "shape": list(shape),
+            "chunks": list(chunks),
+            "dtype": "<u4",
+        }
+    return spec
+
+
+def _shard_chunks(shape, mesh: Mesh | None):
+    """Chunk grid aligned to the mesh decomposition: one chunk per shard
+    block (or row-block chunks on a single device so writes parallelize)."""
+    h, w = shape
+    if mesh is None:
+        rows = max(1, min(h, 4096))
+        return (rows, w)
+    mr = mesh.shape[ROW_AXIS]
+    mc = mesh.shape[COL_AXIS]
+    return (math.ceil(h / mr), math.ceil(w / mc))
+
+
+def write_words(path: str, words: jax.Array, width: int) -> None:
+    """Bitpacked device state -> sharded zarr store.
+
+    Each process writes only its addressable shards; chunk boundaries equal
+    shard boundaries, so no write crosses a chunk another host owns (the
+    multi-writer-safety MPI_File_write_all gets from its subarray views).
+    """
+    _require()
+    height, nwords = words.shape
+    if nwords * BITS != width:
+        raise ValueError(f"width {width} != {nwords} words x {BITS}")
+    mesh = getattr(words.sharding, "mesh", None)
+    chunks = _shard_chunks((height, nwords), mesh)
+    if jax.process_count() > 1:
+        # Multi-host: only the lead process creates (a concurrent
+        # delete_existing on every host would clobber peers' shards); a
+        # device barrier orders create before any peer's write.
+        from jax.experimental import multihost_utils
+
+        if jax.process_index() == 0:
+            ts.open(
+                _spec(path, (height, nwords), chunks),
+                create=True,
+                delete_existing=True,
+            ).result()
+        multihost_utils.sync_global_devices(f"gol_tpu.ts_store.create:{path}")
+        store = ts.open(_spec(path)).result()
+    else:
+        store = ts.open(
+            _spec(path, (height, nwords), chunks),
+            create=True,
+            delete_existing=True,
+        ).result()
+    futures = []
+    for shard in words.addressable_shards:
+        rows, wcols = shard.index[0], shard.index[1]
+        block = np.asarray(shard.data)
+        futures.append(store[rows, wcols].write(block))
+    for f in futures:
+        f.result()
+
+
+def read_words(
+    path: str, width: int, height: int, mesh: Mesh | None = None
+) -> jax.Array:
+    """Sharded zarr store -> bitpacked (height, width/32) device array."""
+    _require()
+    from gol_tpu.io.packed_io import words_sharding
+
+    nwords = width // BITS
+    if nwords * BITS != width:
+        raise ValueError(f"width {width} must be a multiple of {BITS}")
+    store = ts.open(_spec(path)).result()
+    if tuple(store.shape) != (height, nwords):
+        raise ValueError(
+            f"{path}: stored shape {tuple(store.shape)} != ({height}, {nwords})"
+        )
+    if mesh is None:
+        return jax.numpy.asarray(store.read().result())
+    sharding = words_sharding(mesh)
+    index_map = sharding.addressable_devices_indices_map((height, nwords))
+    unique = {
+        tuple((s.start, s.stop) for s in idx): idx for idx in index_map.values()
+    }
+    blocks = {
+        key: store[idx[0], idx[1]].read().result() for key, idx in unique.items()
+    }
+    return jax.make_array_from_callback(
+        (height, nwords),
+        sharding,
+        lambda idx: blocks[tuple((s.start, s.stop) for s in idx)],
+    )

@@ -338,6 +338,24 @@ class TestCompileFailureFallback:
         assert int(gen) == want.generations
         assert np.array_equal(np.asarray(final), want.grid)
 
+    def test_aot_compile_demotes(self, monkeypatch, capsys):
+        # The CLI compiles before its timer (engine.compile_runner); the
+        # ladder must demote at AOT-compile time too, not just at first call.
+        self._boom_packed(monkeypatch, jnp_ok=False)
+        runner = engine._build_runner(
+            (64, 64), GameConfig(gen_limit=20), None, "auto",
+            segmented=False, packed_state=False,
+        )
+        g = text_grid.generate(64, 64, seed=16)
+        compiled = engine.compile_runner(runner, engine.put_grid(g))
+        assert runner.kernel_name == "lax"
+        final, gen = compiled(engine.put_grid(g))
+        want = oracle.run(g, GameConfig(gen_limit=20))
+        assert int(gen) == want.generations
+        assert np.array_equal(np.asarray(final), want.grid)
+        err = capsys.readouterr().err
+        assert "falling back to 'lax'" in err
+
     def test_non_compile_errors_do_not_demote(self, monkeypatch):
         # Only compile-shaped failures (Mosaic/VMEM/OOM) may demote; a user
         # error raised at trace time must propagate from the chosen kernel,

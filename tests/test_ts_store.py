@@ -1,0 +1,93 @@
+"""TensorStore-backed sharded word storage (BASELINE.md config 5's
+"sharded TensorStore I/O") — round trips, mesh sharding, and the CLI
+zarr-snapshot/resume lane."""
+
+import numpy as np
+import pytest
+
+from gol_tpu import cli, oracle
+from gol_tpu.config import GameConfig
+from gol_tpu.io import text_grid, ts_store
+from gol_tpu.ops import packed_math
+from gol_tpu.parallel import make_mesh
+
+pytestmark = pytest.mark.skipif(
+    not ts_store.HAVE_TENSORSTORE, reason="tensorstore not installed"
+)
+
+
+def test_round_trip_single_device(tmp_path):
+    g = text_grid.generate(64, 32, seed=5)
+    words = packed_math.encode(g)
+    path = str(tmp_path / "state.zarr")
+    ts_store.write_words(path, words, 64)
+    back = ts_store.read_words(path, 64, 32)
+    assert np.array_equal(np.asarray(back), np.asarray(words))
+
+
+def test_round_trip_mesh_shard_aligned_chunks(tmp_path):
+    mesh = make_mesh(2, 2)
+    g = text_grid.generate(128, 32, seed=6)
+    import jax
+    from gol_tpu.io.packed_io import words_sharding
+
+    words = jax.device_put(np.asarray(packed_math.encode(g)), words_sharding(mesh))
+    path = str(tmp_path / "state.zarr")
+    ts_store.write_words(path, words, 128)
+    # Read back onto a DIFFERENT mesh factorization: the store is
+    # topology-independent (elastic reconfiguration for checkpoints).
+    back = ts_store.read_words(path, 128, 32, make_mesh(1, 4))
+    assert np.array_equal(np.asarray(back), np.asarray(packed_math.encode(g)))
+    back1 = ts_store.read_words(path, 128, 32)
+    assert np.array_equal(np.asarray(back1), np.asarray(packed_math.encode(g)))
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    g = text_grid.generate(32, 16, seed=7)
+    path = str(tmp_path / "state.zarr")
+    ts_store.write_words(path, packed_math.encode(g), 32)
+    with pytest.raises(ValueError, match="stored shape"):
+        ts_store.read_words(path, 64, 16)
+
+
+def test_cli_zarr_snapshots_resume_exactly(tmp_path, monkeypatch, capsys):
+    """--snapshot-format zarr mid-run state resumed via a .zarr input file
+    reproduces the uninterrupted run's count and output bytes."""
+    monkeypatch.chdir(tmp_path)
+    g = text_grid.generate(128, 128, seed=8)
+    text_grid.write_grid("in.txt", g)
+
+    rc = cli.main(["128", "128", "in.txt", "--variant", "tpu", "--packed-io",
+                   "--gen-limit", "40"])
+    assert rc in (0, None)
+    capsys.readouterr()
+    whole = open("tpu_output.out", "rb").read()
+
+    rc = cli.main(["128", "128", "in.txt", "--variant", "tpu", "--packed-io",
+                   "--gen-limit", "40", "--snapshot-every", "15",
+                   "--snapshot-format", "zarr", "--snapshot-dir", "snaps"])
+    assert rc in (0, None)
+    capsys.readouterr()
+    import os
+
+    assert os.path.isdir("snaps/gen_000015.zarr")
+
+    rc = cli.main(["128", "128", "snaps/gen_000015.zarr", "--variant", "tpu",
+                   "--packed-io", "--gen-limit", "40", "--resume-gen", "15"])
+    assert rc in (0, None)
+    out = capsys.readouterr().out
+    gens = int([l for l in out.splitlines() if l.startswith("Generations")][0]
+               .split("\t")[1])
+    want = oracle.run(g, GameConfig(gen_limit=40))
+    assert gens == want.generations
+    assert open("tpu_output.out", "rb").read() == whole
+
+
+def test_zarr_flags_rejected_off_packed_lane(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    g = text_grid.generate(32, 32, seed=9)
+    text_grid.write_grid("in.txt", g)
+    rc = cli.main(["32", "32", "in.txt", "--variant", "game",
+                   "--snapshot-every", "5", "--snapshot-format", "zarr"])
+    assert rc == 1
+    assert "--packed-io" in capsys.readouterr().err
