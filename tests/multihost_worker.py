@@ -83,6 +83,20 @@ def main() -> int:
     if pid == 0:
         with open(os.path.join(workdir, "gens_packedio.txt"), "w") as f:
             f.write(str(generations))
+
+    # The TensorStore lane's multi-writer discipline under real processes:
+    # lead-process create + device barrier, every process writing only its
+    # addressable shards into shard-aligned chunks, then a sharded read-back
+    # unpacked through the codec so the parent can byte-compare.
+    from gol_tpu.io import ts_store
+
+    if ts_store.HAVE_TENSORSTORE:
+        store_path = os.path.join(workdir, "out_words.zarr")
+        ts_store.write_words(store_path, final_words, width)
+        back = ts_store.read_words(store_path, width, height, mesh)
+        packed_io.write_packed(
+            os.path.join(workdir, "out_tsstore.txt"), back, width
+        )
     return 0
 
 

@@ -77,3 +77,12 @@ def test_process_cluster_matches_oracle(tmp_path, nprocs):
         gens = int((tmp_path / f"gens_{lane}.txt").read_text())
         np.testing.assert_array_equal(np.asarray(got), expect.grid)
         assert gens == expect.generations
+    import importlib.util
+
+    if importlib.util.find_spec("tensorstore") is not None:
+        # TensorStore round trip across the process cluster: every process
+        # wrote only its shard-aligned chunks, none clobbered a peer's. The
+        # parent decides the expectation — a worker-side regression that
+        # skips the lane must fail here, not pass silently.
+        got = text_grid.read_grid(str(tmp_path / "out_tsstore.txt"), 64, 64)
+        np.testing.assert_array_equal(np.asarray(got), expect.grid)
