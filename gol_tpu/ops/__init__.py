@@ -67,11 +67,10 @@ def _registry() -> dict[str, Kernel]:
             supports=stencil_pallas.supports,
         )
 
-        def _packed(force_jnp: bool) -> Kernel:
-            fused = functools.partial(stencil_packed.packed_step,
-                                      force_jnp=force_jnp)
+        def _packed(name: str, **routing) -> Kernel:
+            fused = functools.partial(stencil_packed.packed_step, **routing)
             return Kernel(
-                name="packed-jnp" if force_jnp else "packed",
+                name=name,
                 step=lambda cur, topo: stencil_packed.decode(
                     fused(stencil_packed.encode(cur), topo)[0]
                 ),
@@ -80,12 +79,12 @@ def _registry() -> dict[str, Kernel]:
                 encode=stencil_packed.encode,
                 decode=stencil_packed.decode,
                 fused_multi=functools.partial(stencil_packed.packed_step_multi,
-                                              force_jnp=force_jnp),
+                                              **routing),
                 multi_gens=stencil_packed.TEMPORAL_GENS,
                 supports_multi=stencil_packed.supports_multi,
             )
 
-        kernels["packed"] = _packed(False)
+        kernels["packed"] = _packed("packed")
         # The Mosaic-compile-failure demotion target: identical word-state
         # semantics through the jnp adder network, no Pallas anywhere. Not
         # offered by `auto` directly — engine._KernelFallback engages it when
@@ -93,7 +92,13 @@ def _registry() -> dict[str, Kernel]:
         # v5e-empirical; another TPU generation may refuse a shape inside
         # them, and the reference never dies on a supported shape,
         # src/game.c:224-245).
-        kernels["packed-jnp"] = _packed(True)
+        kernels["packed-jnp"] = _packed("packed-jnp", force_jnp=True)
+        # Test lane: the distributed Pallas kernel composition in interpret
+        # mode off TPU (CI/soak coverage of the real kernel wiring without a
+        # chip) — a first-class kernel name so runner caches key correctly,
+        # unlike the module-global _FORCE_KERNEL_OFF_TPU hook. Never chosen
+        # by `auto`.
+        kernels["packed-interp"] = _packed("packed-interp", force_interp=True)
     except ImportError:  # pragma: no cover - pallas unavailable on some backends
         pass
     return kernels

@@ -745,7 +745,8 @@ def _jnp_multi(state, prev0, interior):
 
 
 def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
-                            force_jnp: bool = False):
+                            force_jnp: bool = False,
+                            force_interp: bool = False):
     """Shard-local temporal pass: deep halo, then TEMPORAL_GENS generations.
 
     The ghost word rows and columns ride as banded kernel operands
@@ -753,7 +754,8 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
     plane is ever materialized around the shard array."""
     T = TEMPORAL_GENS
     h, nwords = words.shape
-    if force_jnp or (jax.default_backend() != "tpu" and not _FORCE_KERNEL_OFF_TPU):
+    if force_jnp or (jax.default_backend() != "tpu"
+                     and not (_FORCE_KERNEL_OFF_TPU or force_interp)):
         # Identical math at jnp level: torus rolls over the extended block
         # wrap garbage only into the invalid frontier (never the interior).
         xe = exchange_packed_deep(words, topology)
@@ -800,7 +802,7 @@ def deep_ghost_operands(words: jnp.ndarray, topology: Topology):
 
 
 def packed_step_multi(cur: jnp.ndarray, topology: Topology, *,
-                      force_jnp: bool = False):
+                      force_jnp: bool = False, force_interp: bool = False):
     """TEMPORAL_GENS fused generations:
     ``words -> (words_T, alive_vec, similar_vec)``.
 
@@ -813,13 +815,17 @@ def packed_step_multi(cur: jnp.ndarray, topology: Topology, *,
     ``force_jnp`` routes every branch through the jnp adder network even on
     TPU — the engine's demotion target when Mosaic refuses to compile a
     shape the empirical VMEM caps admit (the reference bar: no supported
-    shape ever aborts, src/game.c:224-245).
+    shape ever aborts, src/game.c:224-245). ``force_interp`` is the inverse
+    test knob: distributed shards take the Pallas kernel composition in
+    interpret mode even off TPU (the per-case form of the module-wide
+    ``_FORCE_KERNEL_OFF_TPU`` hook, usable as kernel='packed-interp' with
+    ordinary runner caching).
     """
     height, nwords = cur.shape
     if not supports_multi(height, nwords * _BITS, topology):
         raise ValueError("packed_step_multi requires a supported shape/topology")
     if topology.distributed:
-        return _distributed_step_multi(cur, topology, force_jnp)
+        return _distributed_step_multi(cur, topology, force_jnp, force_interp)
     if force_jnp or jax.default_backend() != "tpu":
         return _jnp_multi(cur, cur, (slice(None), slice(None)))
     return _step_t(cur)
@@ -982,7 +988,7 @@ def _dist_step_pallas(words, gtop8, gbot8, gmid, gwrap, interpret=False):
 
 
 def _distributed_step(words: jnp.ndarray, topology: Topology,
-                      force_jnp: bool = False):
+                      force_jnp: bool = False, force_interp: bool = False):
     """Shard-local packed step under shard_map.
 
     The halo is the two-phase ppermute exchange (word rows N/S, bit columns
@@ -994,7 +1000,9 @@ def _distributed_step(words: jnp.ndarray, topology: Topology,
     h, nwords = words.shape
     top, bot, gwest, geast = exchange_packed(words, topology)
     on_tpu = jax.default_backend() == "tpu"
-    if h % _SUBLANES == 0 and not force_jnp and (on_tpu or _FORCE_KERNEL_OFF_TPU):
+    if h % _SUBLANES == 0 and not force_jnp and (
+        on_tpu or _FORCE_KERNEL_OFF_TPU or force_interp
+    ):
         # Off TPU the compiled kernel would be the Mosaic interpreter per
         # generation; the jnp network below is the identical math at full
         # XLA:CPU speed (the _FORCE_KERNEL_OFF_TPU test hook still routes
@@ -1010,7 +1018,7 @@ def _distributed_step(words: jnp.ndarray, topology: Topology,
 
 
 def packed_step(cur: jnp.ndarray, topology: Topology, *,
-                force_jnp: bool = False):
+                force_jnp: bool = False, force_interp: bool = False):
     """Fused generation step on packed state: ``words -> (words, alive, similar)``.
 
     Single device: the compiled Pallas band kernel. Distributed: the same
@@ -1028,7 +1036,7 @@ def packed_step(cur: jnp.ndarray, topology: Topology, *,
             f"{topology.shape[1]} devices — use kernel='lax' (or 'auto')"
         )
     if topology.distributed:
-        return _distributed_step(cur, topology, force_jnp)
+        return _distributed_step(cur, topology, force_jnp, force_interp)
     if force_jnp or jax.default_backend() != "tpu":
         # Off-TPU the jnp adder network beats running Mosaic's interpreter;
         # the kernel body itself is covered by interpret-mode tests.

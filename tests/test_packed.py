@@ -276,9 +276,9 @@ def test_mesh_engine_runs_deep_halo_temporal_pass(monkeypatch):
     calls = []
     real = sp._distributed_step_multi
 
-    def spy(words, topology, force_jnp=False):
+    def spy(words, topology, force_jnp=False, force_interp=False):
         calls.append(tuple(words.shape))
-        return real(words, topology, force_jnp)
+        return real(words, topology, force_jnp, force_interp)
 
     monkeypatch.setattr(sp, "_distributed_step_multi", spy)
     engine.make_runner.cache_clear()

@@ -57,13 +57,15 @@ while time.time() < DEADLINE:
     density = float(rng.random())
     seed = int(rng.integers(2**31))
     # A slice of packed mesh draws routes through the interpret-mode Mosaic
-    # kernels (_FORCE_KERNEL_OFF_TPU) so the overlapped deep-halo temporal
-    # composition gets fuzzed, not just the jnp network. Interpret mode is
-    # slow, so those draws keep small shapes and short runs.
+    # kernels (kernel='packed-interp') so the overlapped deep-halo temporal
+    # composition gets fuzzed, not just the jnp network. A first-class
+    # kernel name, so runner caches key correctly with no global-flag
+    # toggling. Interpret mode is slow: small shapes, short runs.
     force_kernel = (
         kernel == "packed" and ms is not None and rng.random() < 0.08
     )
     if force_kernel:
+        kernel = "packed-interp"
         hk = min(hk, 2)
         h, w = r * hk * 8, c * wk * 32
         # Two temporal passes plus a single-generation tail.
@@ -74,21 +76,12 @@ while time.time() < DEADLINE:
     case = dict(mesh=ms, shape=(h, w), kernel=kernel, conv=conv, freq=freq,
                 check=check, lim=lim, density=round(density, 3), seed=seed,
                 force_kernel=force_kernel)
-    if _sp._FORCE_KERNEL_OFF_TPU != force_kernel:
-        # Cached runners captured the previous flag state; clear ALL runner
-        # caches on every transition so keys never alias across routings.
-        _sp._FORCE_KERNEL_OFF_TPU = force_kernel
-        engine.make_runner.cache_clear()
-        engine.make_segment_runner.cache_clear()
-        engine.make_packed_runner.cache_clear()
-        engine.make_packed_segment_runner.cache_clear()
     try:
         got = engine.simulate(g, cfg, mesh=make_mesh(r, c) if ms else None, kernel=kernel)
     except ValueError as e:
         # unsupported kernel/shape combos are loud errors by design
         if "does not support" in str(e) or "requires" in str(e):
-            label = "packed-interp" if force_kernel else kernel
-            counts[f"{label}-unsupported"] += 1
+            counts[f"{kernel}-unsupported"] += 1
             continue
         print("UNEXPECTED ERROR", case, e)
         sys.exit(1)
@@ -96,7 +89,7 @@ while time.time() < DEADLINE:
     if got.generations != want.generations or not np.array_equal(got.grid, want.grid):
         print("MISMATCH", case)
         sys.exit(1)
-    counts["packed-interp" if force_kernel else kernel] += 1
+    counts[kernel] += 1
     if rng.random() < 0.25:
         # Segmented replay: random segment lengths must reproduce the whole
         # run bit-exactly (the snapshot/resume property, with the similarity
