@@ -94,10 +94,9 @@ def _registry() -> dict[str, Kernel]:
         # src/game.c:224-245).
         kernels["packed-jnp"] = _packed("packed-jnp", force_jnp=True)
         # Test lane: the distributed Pallas kernel composition in interpret
-        # mode off TPU (CI/soak coverage of the real kernel wiring without a
-        # chip) — a first-class kernel name so runner caches key correctly,
-        # unlike the module-global _FORCE_KERNEL_OFF_TPU hook. Never chosen
-        # by `auto`.
+        # mode off TPU (CI/soak coverage of the real kernel wiring without
+        # a chip) — a first-class kernel name so runner caches key
+        # correctly per routing. Never chosen by `auto`.
         kernels["packed-interp"] = _packed("packed-interp", force_interp=True)
     except ImportError:  # pragma: no cover - pallas unavailable on some backends
         pass
@@ -120,7 +119,7 @@ def resolve_kernel(name: str, height: int, width: int, topology: Topology) -> Ke
     the shape on this backend. Off TPU the packed kernel still wins where it
     fits: every off-TPU path routes to the jnp adder network (32 cells/word
     — measured 18x the lax roll stencil on CPU at 4096²), never the Mosaic
-    interpreter (which only the _FORCE_KERNEL_OFF_TPU test hook engages).
+    interpreter (which only the kernel='packed-interp' test lane engages).
     The byte ``pallas`` kernel is TPU-only for auto: off TPU it would run
     wholly in interpret mode. ``lax`` remains the any-shape fallback.
     """

@@ -754,8 +754,7 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
     plane is ever materialized around the shard array."""
     T = TEMPORAL_GENS
     h, nwords = words.shape
-    if force_jnp or (jax.default_backend() != "tpu"
-                     and not (_FORCE_KERNEL_OFF_TPU or force_interp)):
+    if force_jnp or (jax.default_backend() != "tpu" and not force_interp):
         # Identical math at jnp level: torus rolls over the extended block
         # wrap garbage only into the invalid frontier (never the interior).
         xe = exchange_packed_deep(words, topology)
@@ -771,12 +770,6 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
     # sequential banded-operand form still handles them.
     gtop, gbot, G_ext = deep_ghost_operands(words, topology)
     return _step_tgb(words, gtop, gbot, G_ext, interpret=interpret)
-
-
-# Test hook: route off-TPU mesh shards through the banded Pallas kernel in
-# interpret mode instead of the (equivalent, much faster) jnp network, so the
-# real ppermute'd-operands -> kernel composition runs under a CPU mesh in CI.
-_FORCE_KERNEL_OFF_TPU = False
 
 
 def deep_ghost_operands(words: jnp.ndarray, topology: Topology):
@@ -817,9 +810,8 @@ def packed_step_multi(cur: jnp.ndarray, topology: Topology, *,
     shape the empirical VMEM caps admit (the reference bar: no supported
     shape ever aborts, src/game.c:224-245). ``force_interp`` is the inverse
     test knob: distributed shards take the Pallas kernel composition in
-    interpret mode even off TPU (the per-case form of the module-wide
-    ``_FORCE_KERNEL_OFF_TPU`` hook, usable as kernel='packed-interp' with
-    ordinary runner caching).
+    interpret mode even off TPU (exposed as kernel='packed-interp', a
+    first-class registry entry so runner caches key per routing).
     """
     height, nwords = cur.shape
     if not supports_multi(height, nwords * _BITS, topology):
@@ -1000,13 +992,11 @@ def _distributed_step(words: jnp.ndarray, topology: Topology,
     h, nwords = words.shape
     top, bot, gwest, geast = exchange_packed(words, topology)
     on_tpu = jax.default_backend() == "tpu"
-    if h % _SUBLANES == 0 and not force_jnp and (
-        on_tpu or _FORCE_KERNEL_OFF_TPU or force_interp
-    ):
+    if h % _SUBLANES == 0 and not force_jnp and (on_tpu or force_interp):
         # Off TPU the compiled kernel would be the Mosaic interpreter per
         # generation; the jnp network below is the identical math at full
-        # XLA:CPU speed (the _FORCE_KERNEL_OFF_TPU test hook still routes
-        # CI through the interpret-mode kernel composition).
+        # XLA:CPU speed (kernel='packed-interp' still routes CI through
+        # the interpret-mode kernel composition).
         gtop8, gbot8, gmid, gwrap = halo.assemble_band_ghosts(
             top, bot, gwest, geast, _pick_band(h, nwords)
         )

@@ -130,8 +130,8 @@ def test_dist_kernel_local_wrap_matches_oracle():
 
 def test_distributed_packed_runs_pallas_kernel(monkeypatch):
     """On TPU the mesh path's hot loop is the Pallas band kernel, not the
-    jnp net; off TPU only the _FORCE_KERNEL_OFF_TPU test hook takes that
-    route (interpret mode) — engaged here so CI pins the composition."""
+    jnp net; off TPU the kernel='packed-interp' lane takes that route
+    (interpret mode) — engaged here so CI pins the composition."""
     from gol_tpu.parallel.mesh import make_mesh
 
     calls = []
@@ -142,16 +142,14 @@ def test_distributed_packed_runs_pallas_kernel(monkeypatch):
         return real(*args, **kwargs)
 
     monkeypatch.setattr(sp, "_dist_step_pallas", spy)
-    monkeypatch.setattr(sp, "_FORCE_KERNEL_OFF_TPU", True)
-    engine.make_runner.cache_clear()
     mesh = make_mesh(2, 4)
     rng = np.random.default_rng(3)
     g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
-    got = engine.simulate(g, GameConfig(gen_limit=5), mesh=mesh, kernel="packed")
+    got = engine.simulate(g, GameConfig(gen_limit=5), mesh=mesh,
+                          kernel="packed-interp")
     expect = oracle.run(g, GameConfig(gen_limit=5))
     np.testing.assert_array_equal(got.grid, expect.grid)
     assert calls and calls[0] == (32, 2)  # 32-row, 2-word local shard
-    engine.make_runner.cache_clear()
 
 
 def test_distributed_packed_odd_height_falls_back_to_jnp():
@@ -378,24 +376,23 @@ def test_ghost_operand_temporal_multi_band(monkeypatch):
         assert int(alive[t]) == int(states[t + 1].any()), t
 
 
-def test_banded_kernel_under_real_mesh(monkeypatch):
-    """The banded ghost-operand kernel composed with REAL shard_map
-    ppermutes: _FORCE_KERNEL_OFF_TPU routes the CPU-mesh temporal pass
-    through _step_tgb in interpret mode, so the exchanged gtop/gbot/G_ext
-    operands (not the jnp-network equivalent) produce the mesh result."""
+def test_banded_kernel_under_real_mesh():
+    """The banded ghost-operand kernels composed with REAL shard_map
+    ppermutes: kernel='packed-interp' routes the CPU-mesh temporal pass
+    through the overlapped interior/frontier kernels in interpret mode, so
+    the exchanged gtop/gbot/G_ext operands (not the jnp-network equivalent)
+    produce the mesh result."""
     from gol_tpu import engine
     from gol_tpu.config import GameConfig
     from gol_tpu.parallel.mesh import make_mesh
 
-    monkeypatch.setattr(sp, "_FORCE_KERNEL_OFF_TPU", True)
-    engine.make_runner.cache_clear()
     rng = np.random.default_rng(53)
     g = rng.integers(0, 2, size=(64, 256), dtype=np.uint8)
     # 2T+3 generations: two fused temporal blocks plus a 3-generation tail
     # through the single-generation dist kernel (also interpret mode here).
     lim = 2 * sp.TEMPORAL_GENS + 3
     cfg = GameConfig(gen_limit=lim)
-    got = engine.simulate(g, cfg, mesh=make_mesh(2, 4), kernel="packed")
+    got = engine.simulate(g, cfg, mesh=make_mesh(2, 4), kernel="packed-interp")
     expect = oracle.run(g, cfg)
     np.testing.assert_array_equal(got.grid, expect.grid)
     assert got.generations == expect.generations
@@ -405,8 +402,8 @@ def test_banded_kernel_under_real_mesh(monkeypatch):
     # with the interpret-mode kernel under a real mesh.
     g8 = rng.integers(0, 2, size=(16, 256), dtype=np.uint8)
     cfg8 = GameConfig(gen_limit=6)
-    got8 = engine.simulate(g8, cfg8, mesh=make_mesh(2, 4), kernel="packed")
+    got8 = engine.simulate(g8, cfg8, mesh=make_mesh(2, 4),
+                           kernel="packed-interp")
     expect8 = oracle.run(g8, cfg8)
     np.testing.assert_array_equal(got8.grid, expect8.grid)
     assert got8.generations == expect8.generations
-    engine.make_runner.cache_clear()
