@@ -42,6 +42,9 @@ _WRITE_CHUNK_BYTES = 64 << 20
 # link never idles between chunks (the r2 config-5 write spent ~25s on a
 # serial 512MB D2H chain — VERDICT r2 weak #3).
 _D2H_PREFETCH_DEPTH = 4
+# Test hook: engage the pipelined chunked upload on the CPU backend too
+# (production gates it to accelerators, where there is a transfer to hide).
+_FORCE_READ_PIPELINE = False
 
 
 def _check_shape(width: int, mesh: Mesh | None) -> None:
@@ -64,10 +67,35 @@ def read_packed(path: str, width: int, height: int, mesh: Mesh | None = None) ->
     nwords = width // BITS
 
     if mesh is None:
+        chunk = max(1, _READ_CHUNK_BYTES // max(row_stride(width), 1))
+        starts = list(range(0, height, chunk))
+        total_bytes = height * nwords * 4
+        # Pipelined upload: ship each block to the device as soon as it is
+        # packed — device_put is async, so uploads overlap the remaining
+        # packing instead of waiting for one whole-array transfer at the
+        # end. The on-device concatenate costs one extra HBM pass and a 2x
+        # transient (parts + result), so the pipeline engages only where it
+        # hides a real transfer (an accelerator backend) and the transient
+        # comfortably fits (<=2GB packed); otherwise pack into one host
+        # buffer and transfer once.
+        pipelined = (
+            (jax.default_backend() != "cpu" or _FORCE_READ_PIPELINE)
+            and len(starts) > 1
+            and total_bytes <= (2 << 30)
+        )
+        if pipelined:
+
+            def pack_rows_out(r0: int) -> np.ndarray:
+                r1 = min(height, r0 + chunk)
+                return native.pack_text(mm[r0:r1], width)
+
+            with concurrent.futures.ThreadPoolExecutor() as pool:
+                futures = [pool.submit(pack_rows_out, r0) for r0 in starts]
+                parts = [jax.device_put(f.result()) for f in futures]
+            return jax.numpy.concatenate(parts, axis=0)
+
         # Pack row blocks across a thread pool (the codec releases the GIL).
         out = np.empty((height, nwords), dtype=np.uint32)
-        chunk = max(1, _READ_CHUNK_BYTES // max(row_stride(width), 1))
-        starts = range(0, height, chunk)
 
         def pack_rows(r0: int) -> None:
             r1 = min(height, r0 + chunk)

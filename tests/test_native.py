@@ -96,10 +96,14 @@ def test_packed_file_roundtrip_sharded(tmp_path):
     assert out.read_bytes() == path.read_bytes()
 
 
-def test_packed_file_roundtrip_chunked(tmp_path, monkeypatch):
-    """Force the streaming chunk paths (normally >64/128 MB) on a small grid."""
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_packed_file_roundtrip_chunked(tmp_path, monkeypatch, pipeline):
+    """Force the streaming chunk paths (normally >64/128 MB) on a small grid,
+    both upload strategies (single-transfer and the pipelined per-chunk
+    device_put + concatenate an accelerator backend would take)."""
     monkeypatch.setattr(packed_io, "_READ_CHUNK_BYTES", 5 * 129)  # ~5 rows/chunk
     monkeypatch.setattr(packed_io, "_WRITE_CHUNK_BYTES", 3 * 16)  # 3 rows/chunk
+    monkeypatch.setattr(packed_io, "_FORCE_READ_PIPELINE", pipeline)
     rng = np.random.default_rng(9)
     g = rng.integers(0, 2, size=(37, 128), dtype=np.uint8)
     path = tmp_path / "grid.txt"
