@@ -222,20 +222,12 @@ def _bench_compare(args) -> int:
                 sp.TEMPORAL_GENS,
             )
             # What a pod shard actually runs: deep-halo assembly (local
-            # wrap standing in for ppermute'd neighbors) + the temporal
-            # pass — since r3 the overlapped interior/frontier split — the
-            # honest per-chip proxy for flagship mesh throughput.
+            # wrap standing in for ppermute'd neighbors) + the sequential
+            # banded temporal pass — the honest per-chip proxy for flagship
+            # mesh throughput. (An overlapped interior/frontier split was
+            # measured here in r3 and retired: see _distributed_step_multi.)
             paths["packed-dist-temporal"] = (
                 lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0],
-                "words",
-                sp.TEMPORAL_GENS,
-            )
-            # The pre-r3 sequential form (every ghost operand on the
-            # critical path), kept measurable for the A/B delta.
-            paths["packed-dist-temporal-seq"] = (
-                lambda w: sp._step_tgb(
-                    w, *sp.deep_ghost_operands(w, SINGLE_DEVICE)
-                )[0],
                 "words",
                 sp.TEMPORAL_GENS,
             )

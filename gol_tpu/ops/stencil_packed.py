@@ -289,7 +289,7 @@ def _record_flags(i, flags, alive_ref, similar_ref):
 
 def _bandt_kernel(
     main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref,
-    *, band: int, interior=None, frame: bool = False,
+    *, band: int, interior=None,
 ):
     """TEMPORAL_GENS generations per VMEM pass (temporal blocking), torus form.
 
@@ -305,16 +305,6 @@ def _bandt_kernel(
     array: when the array holds ghost rows/columns the flags must see only
     those cells (the assembled-extended-block form; the production mesh path
     is ``_bandtg_kernel``, whose operands carry ghosts separately).
-
-    ``frame`` = mesh-interior mode (the overlapped deep-halo path): the
-    array is one whole shard evolved with NO cross-shard ghosts, so a
-    TEMPORAL_GENS-wide frame decays — T rows top/bottom (the local torus
-    wrap brings the shard's own far side, wrong across a mesh) and T *bits*
-    at the west (bit 0 of word 0) and east (bit 31 of the last word) seams,
-    advancing one bit per generation. Flags mask to the exact complement:
-    rows [T, H-T) with word 0's low T bits and the last word's high T bits
-    dropped. The frame cells in the output are garbage; the caller stitches
-    the frontier kernels' exact values over them.
     """
     i = pl.program_id(0)
     x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
@@ -336,15 +326,6 @@ def _bandt_kernel(
         c = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
         mask = (r >= row_lo) & (r < row_hi) & (c >= col_lo) & (c < col_hi)
         bitmask = jnp.where(mask, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
-    elif frame:
-        T = TEMPORAL_GENS
-        H = band * pl.num_programs(0)
-        r = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 0) + i * band
-        c = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
-        wm = jnp.full((band, nwords), 0xFFFFFFFF, jnp.uint32)
-        wm = jnp.where(c == 0, wm & jnp.uint32((0xFFFFFFFF << T) & 0xFFFFFFFF), wm)
-        wm = jnp.where(c == nwords - 1, wm & jnp.uint32(0xFFFFFFFF >> T), wm)
-        bitmask = jnp.where((r >= T) & (r < H - T), wm, jnp.uint32(0))
     flags = []
     for _ in range(TEMPORAL_GENS):
         x = evolve_full(x)
@@ -420,16 +401,14 @@ def _banded_specs(band: int, nwords: int, nb: int):
     ]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "interior", "frame"))
-def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None,
-            frame: bool = False):
+@functools.partial(jax.jit, static_argnames=("interpret", "interior"))
+def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
     height, nwords = words.shape
     band = _pick_band(height, nwords, _BANDT_BYTES)
     nb = height // _SUBLANES
     T = TEMPORAL_GENS
     new, alive, similar = pl.pallas_call(
-        functools.partial(_bandt_kernel, band=band, interior=interior,
-                          frame=frame),
+        functools.partial(_bandt_kernel, band=band, interior=interior),
         grid=(height // band,),
         in_specs=_banded_specs(band, nwords, nb),
         out_specs=(
@@ -508,167 +487,6 @@ def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
         interpret=interpret,
     )(words, words, words, gtop, gbot, G_ext, G_ext, G_ext)
     return new, alive[0], similar[0]
-
-
-def _colstrip_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref,
-                     similar_ref, *, band: int):
-    """TEMPORAL_GENS generations over the 6-lane edge-column plane.
-
-    The column frontier of the overlapped mesh step: lanes are
-    [ghost_west, c0, c1, c_{n-2}, c_{n-1}, ghost_east] word columns over the
-    T-row-extended range, and each lane's cross-word neighbors are wired
-    per-lane (c0's west is the ghost, its east is c1; mirrored for the east
-    edge; the outward-facing sides of the inner context lanes are garbage
-    that decays one bit per generation from the far edge of the word, so c0
-    and c_{n-1} stay exact in every bit for TEMPORAL_GENS <= 8 generations).
-    Flags mask to the cells this strip *owns* in the frame split: shard rows
-    [T, H-T), word 0's low T bits (lane 1) and the last word's high T bits
-    (lane 4) — the exact complement of the interior kernel's frame mask.
-    """
-    i = pl.program_id(0)
-    x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
-    rows, nl = x.shape  # (band + 16, 6)
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (rows, nl), 1)
-    zero = jnp.zeros_like(x)
-
-    def evolve(x):
-        # left[k] = x[k-1] except the two lanes whose western context is
-        # absent (the ghost's own west, and c_{n-2}'s west); mirrored east.
-        left = jnp.where((lanes == 0) | (lanes == 3), zero, pltpu.roll(x, 1, 1))
-        right = jnp.where((lanes == 2) | (lanes == 5), zero,
-                          pltpu.roll(x, nl - 1, 1))
-        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
-        return _vroll_combine(s0, s1, m0, m1, x)
-
-    T = TEMPORAL_GENS
-    He = band * pl.num_programs(0)  # extended height (shard + 2T ghost rows)
-    r = jax.lax.broadcasted_iota(jnp.int32, (band, nl), 0) + i * band
-    lane_b = jax.lax.broadcasted_iota(jnp.int32, (band, nl), 1)
-    low = jnp.uint32((1 << T) - 1)
-    wm = jnp.where(
-        lane_b == 1, low,
-        jnp.where(lane_b == 4, low << jnp.uint32(32 - T), jnp.uint32(0)),
-    )
-    # Extended rows [2T, He-2T) are shard rows [T, H-T).
-    bitmask = jnp.where((r >= 2 * T) & (r < He - 2 * T), wm, jnp.uint32(0))
-
-    prev = main_ref[:]
-    flags = []
-    for _ in range(TEMPORAL_GENS):
-        x = evolve(x)
-        g = x[8 : band + 8]
-        alive = jnp.max(jnp.where((g & bitmask) != 0, 1, 0))
-        similar = 1 - jnp.max(jnp.where(((g ^ prev) & bitmask) != 0, 1, 0))
-        flags.append((alive, similar))
-        prev = g
-    out_ref[:] = prev
-    _record_flags(i, flags, alive_ref, similar_ref)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _step_cols(X6: jnp.ndarray, interpret: bool = False):
-    """Temporal pass over the (h + 2T, 6) edge-column plane.
-
-    Returns ``(plane_T, alive_vec, similar_vec)``; the caller reads lanes 1
-    (word 0) and 4 (last word) of rows [T, h+T) — exact in every bit for all
-    shard rows (the plane carries the real vertical ghost rows inline, and
-    each edge column sees its true west and east neighbor words; see
-    ``_colstrip_kernel``). Banded exactly like ``_step_t``: the modular
-    wrap at the plane's two ends feeds garbage only into the ghost-row
-    zone, T rows clear of any shard row.
-    """
-    He, nl = X6.shape
-    band = _pick_band(He, nl, _BANDT_BYTES)
-    nb = He // _SUBLANES
-    T = TEMPORAL_GENS
-    new, alive, similar = pl.pallas_call(
-        functools.partial(_colstrip_kernel, band=band),
-        grid=(He // band,),
-        in_specs=_banded_specs(band, nl, nb),
-        out_specs=(
-            pl.BlockSpec((band, nl), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((He, nl), jnp.uint32),
-            jax.ShapeDtypeStruct((1, T), jnp.int32),
-            jax.ShapeDtypeStruct((1, T), jnp.int32),
-        ),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-        ),
-        interpret=interpret,
-    )(X6, X6, X6)
-    return new, alive[0], similar[0]
-
-
-def _overlap_step_multi(words: jnp.ndarray, topology: Topology,
-                        interpret: bool):
-    """The overlapped mesh temporal step: interior/frontier split.
-
-    The sequential form (``deep_ghost_operands`` then ``_step_tgb``) puts
-    the whole exchange latency on the critical path; here the shard splits
-    into an interior that needs no ghosts for TEMPORAL_GENS generations and
-    a T-wide frontier frame, so XLA's async collectives can fly the
-    ppermutes *while* the interior kernel runs — the TPU analog of firing
-    the reference's persistent halo requests before compute
-    (src/game_mpi.c:392-404):
-
-      1. issue the deep exchange (ghost rows, ghost-column plane)
-      2. interior: the plain torus temporal kernel over the whole shard, no
-         ghost operands — exact except a T-row/T-bit frame (frame-masked
-         flags); runs concurrently with (1)
-      3. frontier: three small kernels consuming the arrived ghosts — the
-         top/bottom T-row strips (``_step_tgb`` on T-row sub-shards) and
-         the 6-lane edge-column plane (``_step_cols``)
-      4. stitch the frontier's exact values over the interior's frame via
-         dynamic_update_slice (in-place on the dead interior buffer)
-
-    Flag ownership is disjoint and complete: strips own the top/bottom T
-    rows (full width) plus the edge words' outer T bits for middle rows;
-    the interior owns the rest. Per-generation OR/AND over the pieces
-    reproduces the whole-shard flags bit-exactly.
-    """
-    T = TEMPORAL_GENS
-    h, nwords = words.shape
-    gtop, gbot, G_ext = deep_ghost_operands(words, topology)
-    int_out, a_int, s_int = _step_t(words, interpret=interpret, frame=True)
-    top_out, a_top, s_top = _step_tgb(
-        words[0:T], gtop, words[T : 2 * T], G_ext[0 : 3 * T],
-        interpret=interpret,
-    )
-    bot_out, a_bot, s_bot = _step_tgb(
-        words[h - T : h], words[h - 2 * T : h - T], gbot,
-        G_ext[h - T : h + 2 * T], interpret=interpret,
-    )
-
-    def ext_col(c):
-        return jnp.concatenate(
-            [gtop[:, c : c + 1], words[:, c : c + 1], gbot[:, c : c + 1]],
-            axis=0,
-        )
-
-    X6 = jnp.concatenate(
-        [
-            G_ext[:, 0:1],
-            ext_col(0),
-            ext_col(1),
-            ext_col(nwords - 2),
-            ext_col(nwords - 1),
-            G_ext[:, 1:2],
-        ],
-        axis=1,
-    )
-    col_out, a_col, s_col = _step_cols(X6, interpret=interpret)
-    col_shard = col_out[T : h + T]
-    out = jax.lax.dynamic_update_slice(int_out, top_out, (0, 0))
-    out = jax.lax.dynamic_update_slice(out, bot_out, (h - T, 0))
-    out = jax.lax.dynamic_update_slice(out, col_shard[:, 1:2], (0, 0))
-    out = jax.lax.dynamic_update_slice(out, col_shard[:, 4:5], (0, nwords - 1))
-    alive = a_int | a_top | a_bot | a_col
-    similar = s_int & s_top & s_bot & s_col
-    return out, alive, similar
 
 
 # Width cap for the temporal kernel: its live set spans (band+16)-row
@@ -761,13 +579,16 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
         return _jnp_multi(
             xe, words, (slice(T, T + h), slice(1, nwords + 1))
         )
+    # The sequential banded-operand form: exchange, then one kernel pass
+    # consuming every ghost operand. An overlapped interior/frontier split
+    # (frame-masked whole-shard kernel + T-row strip and 6-lane edge-column
+    # frontier kernels + stitch) was built and measured on v5e and RETIRED:
+    # its frontier machinery cost ~0.8x of the main kernel (tiny-kernel
+    # launches, strided column extraction) to hide an exchange that costs
+    # ~0.15x here and tens of microseconds over real ICI — a structural
+    # loss at both scales (benchmarks/compare_32768_r3.json: overlap 0.40
+    # vs seq 0.49-0.88 of the single-chip rate across sessions).
     interpret = jax.default_backend() != "tpu"
-    if nwords >= 2:
-        # The overlapped interior/frontier split (the production path): the
-        # deep ppermutes fly while the interior kernel runs.
-        return _overlap_step_multi(words, topology, interpret)
-    # Single-word shards have no interior in the column direction; the
-    # sequential banded-operand form still handles them.
     gtop, gbot, G_ext = deep_ghost_operands(words, topology)
     return _step_tgb(words, gtop, gbot, G_ext, interpret=interpret)
 

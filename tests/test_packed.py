@@ -379,8 +379,8 @@ def test_ghost_operand_temporal_multi_band(monkeypatch):
 def test_banded_kernel_under_real_mesh():
     """The banded ghost-operand kernels composed with REAL shard_map
     ppermutes: kernel='packed-interp' routes the CPU-mesh temporal pass
-    through the overlapped interior/frontier kernels in interpret mode, so
-    the exchanged gtop/gbot/G_ext operands (not the jnp-network equivalent)
+    through the banded ghost-operand kernel in interpret mode, so the
+    exchanged gtop/gbot/G_ext operands (not the jnp-network equivalent)
     produce the mesh result."""
     from gol_tpu import engine
     from gol_tpu.config import GameConfig

@@ -70,8 +70,8 @@ def test_temporal_kernel_matches_8_network_generations():
 def test_mesh_form_kernels_match_network():
     # SINGLE_DEVICE topology: the ghost-operand kernels with local wrap —
     # the compiled code a pod shard runs, minus the ppermutes. The temporal
-    # form routes through the overlapped interior/frontier split (three
-    # frontier kernels + frame-masked interior + stitch) for nwords >= 2.
+    # form is the sequential banded ghost-operand kernel (_step_tgb; the
+    # overlapped interior/frontier split was measured slower and retired).
     words = _random_words(256, 48, seed=4)
     ref1 = packed_math.evolve_torus_words(words)
     new1 = sp._distributed_step(words, SINGLE_DEVICE)[0]
@@ -86,8 +86,8 @@ def test_mesh_form_kernels_match_network():
 
 
 def test_mesh_temporal_single_word_branch():
-    # nwords == 1 has no column interior; the sequential banded form
-    # (_step_tgb on the whole shard) still serves it, compiled on hardware.
+    # nwords == 1: the banded form's edge patches collapse onto the same
+    # word (gw and ge both target lane 0), compiled on hardware.
     words = _random_words(64, 1, seed=8)
     cur = words
     for _ in range(sp.TEMPORAL_GENS):

@@ -1,0 +1,115 @@
+"""Decompose the mesh-temporal step's cost on the chip.
+
+    python tools/profile_overlap.py [size]
+
+Methodology matches tools/measure_r3.py: every figure is a MARGINAL rate —
+time a fori_loop chain of N1 calls and one of N2 > N1 calls, each forced by
+an int() readback of one element, and report (t2 - t1) / (N2 - N1). The
+attach tunnel's ~90 ms fixed round trip and any dispatch cost cancel in the
+difference (block_until_ready does not reliably block under axon); chip
+throughput still drifts minute-to-minute, so treat ratios from ONE run as
+the signal and absolute ms as indicative.
+
+This tool's r3 measurements drove the retirement of the overlapped
+interior/frontier split (benchmarks/compare_32768_r3.json): the frontier
+kernels (T-row strips, a 6-lane edge-column plane, stitch) cost ~0.8x of
+the main kernel — tiny-kernel launches and strided column extraction are
+pathological on TPU — to hide an exchange measuring ~0.15x on-chip and
+tens of microseconds over real ICI.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+N1, N2 = 25, 75
+REPEATS = 3
+
+
+def probes(words, sp, SINGLE_DEVICE):
+    """(name, state->state) pieces of the mesh temporal step."""
+    gtop, gbot, G_ext = jax.jit(
+        lambda w: sp.deep_ghost_operands(w, SINGLE_DEVICE))(words)
+    int(gtop[0, 0])
+
+    # Exchange alone, chained by writing one ghost word back into the state
+    # (keeps a data dependence so the loop can't collapse).
+    def ghost_step(w):
+        gt, gb, ge = sp.deep_ghost_operands(w, SINGLE_DEVICE)
+        return jax.lax.dynamic_update_slice(w, gt[0:1, 0:1], (0, 0))
+
+    return [
+        ("step_t", lambda w: sp._step_t(w)[0]),
+        # Kernel alone: ghosts precomputed once outside the chain. The chain
+        # feeds the kernel its own output with FIXED ghosts — wrong math,
+        # right cost (shapes and memory traffic match the real pass).
+        ("tgb_kernel_only",
+         lambda w: sp._step_tgb(w, gtop, gbot, G_ext)[0]),
+        ("ghosts_only", ghost_step),
+        ("mesh_form_full",
+         lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0]),
+    ]
+
+
+def marginal(step, state):
+    """Marginal seconds per call of ``step`` (state -> state), chained."""
+    times = {}
+    for calls in (N1, N2):
+        run = jax.jit(
+            lambda s, n=calls: jax.lax.fori_loop(
+                0, n, lambda i, x: step(x), s
+            )[0, 0]
+        )
+        int(run(state))  # compile + settle
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            out = int(run(state))
+            best = min(best, time.perf_counter() - t0)
+        times[calls] = best
+    return (times[N2] - times[N1]) / (N2 - N1)
+
+
+def main() -> int:
+    size = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    from gol_tpu.ops import stencil_packed as sp
+    from gol_tpu.parallel.mesh import SINGLE_DEVICE
+
+    rng = np.random.default_rng(42)
+    grid = rng.integers(0, 2, size=(size, size), dtype=np.uint8)
+    words = jnp.asarray(
+        np.packbits(grid, axis=1, bitorder="little").view(np.uint32)
+    )
+    words.block_until_ready()
+    h, nwords = words.shape
+    log(f"shard {h}x{nwords} words, T={sp.TEMPORAL_GENS}; "
+        f"marginal over {N1}->{N2} calls")
+
+    results = {}
+    for name, step in probes(words, sp, SINGLE_DEVICE):
+        t = marginal(step, words)
+        results[name] = t
+        log(f"{name:20s} {t*1e3:8.3f} ms/call")
+
+    log("---")
+    base = results["step_t"]
+    for k, v in results.items():
+        log(f"{k:20s} {v / base:6.2f}x of step_t")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
