@@ -38,10 +38,21 @@ def words_sharding(mesh: Mesh) -> NamedSharding:
 _READ_CHUNK_BYTES = 128 << 20
 _WRITE_CHUNK_BYTES = 64 << 20
 # In-flight device->host fetches per shard. Depth 1 is the strict
-# fetch-ahead-one pipeline; deeper keeps several transfers queued so the
-# link never idles between chunks (the r2 config-5 write spent ~25s on a
-# serial 512MB D2H chain — VERDICT r2 weak #3).
-_D2H_PREFETCH_DEPTH = 4
+# fetch-ahead-one pipeline (transfers serial, the next one queued while the
+# codec drains the current — the link barely idles); deeper keeps several
+# transfers genuinely concurrent, which helps transports that aggregate
+# multiple streams and hurts ones that serialize them. The attach tunnel is
+# NON-STATIONARY on this axis: two d2h probe runs an hour apart measured
+# depth 4 at 1.7x slower, then 2.3x faster, than depth 1 for the same
+# 512MB (benchmarks/d2h_probe_r3.json holds the latest), and back-to-back
+# config-5 writes flipped the same way. Default to 2 as the middle;
+# GOL_D2H_DEPTH overrides for a known transport (a real local chip, where
+# D2H is PCIe-fast, is insensitive to this knob). Malformed values fall to
+# the default rather than poisoning every package import.
+try:
+    _D2H_PREFETCH_DEPTH = int(os.environ.get("GOL_D2H_DEPTH", "2"))
+except ValueError:
+    _D2H_PREFETCH_DEPTH = 2
 # Test hook: engage the pipelined chunked upload on the CPU backend too
 # (production gates it to accelerators, where there is a transfer to hide).
 _FORCE_READ_PIPELINE = False

@@ -3,6 +3,7 @@
 Each step is independently invocable (the attach tunnel can drop mid-way):
 
     python tools/measure_r3.py compare32k   # single-chip vs mesh-form temporal
+    python tools/measure_r3.py h2d          # codec pack + host->device probes
     python tools/measure_r3.py d2h          # raw/chunked device->host probes
     python tools/measure_r3.py config5      # 65536^2 end-to-end CLI phases
     python tools/measure_r3.py all
@@ -123,9 +124,58 @@ def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
     )
 
 
+def h2d(size: int = 65536) -> None:
+    """Read-phase decomposition: codec pack throughput (text bytes -> packed
+    words, host-only) and host->device upload throughput, measured apart so
+    the config5 Reading-file number has a written breakdown — which side is
+    the bound, storage/codec or the attach tunnel."""
+    import jax
+
+    from gol_tpu import native
+    from gol_tpu.io.text_grid import row_stride
+
+    rng = np.random.default_rng(7)
+    rows = 8192  # 8192 x 65537 text bytes ~ 512MB sample of the 4.3GB file
+    text = rng.integers(ord("0"), ord("2"), size=(rows, row_stride(size)),
+                        dtype=np.uint8)
+    text[:, -1] = ord("\n")
+    t0 = time.perf_counter()
+    packed = native.pack_text(text, size)
+    pack_s = time.perf_counter() - t0
+    text_mb = text.nbytes / (1 << 20)
+
+    words = rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)
+    t0 = time.perf_counter()
+    jax.device_put(words).block_until_ready()
+    # block_until_ready can return early over the tunnel; settle with a
+    # tiny readback tied to the uploaded buffer.
+    up = jax.device_put(words)
+    int(up[0, 0])
+    h2d_s = (time.perf_counter() - t0) / 2  # two uploads timed
+    mb = words.nbytes / (1 << 20)
+    _write(
+        "h2d_probe_r3.json",
+        {
+            "metric": "h2d_throughput",
+            "value": mb / h2d_s,
+            "unit": "MB/s",
+            "vs_baseline": None,
+            "detail": {
+                "pack_text_MBps": round(text_mb / pack_s, 1),
+                "pack_sample_bytes": text.nbytes,
+                "h2d_s_per_512MB": round(h2d_s, 3),
+            },
+            "bytes": words.nbytes,
+            "note": "codec pack rate is per-thread (read_packed fans it "
+            "over a pool); upload is one 512MB device_put over the attach "
+            "tunnel — together they bound the packed read phase.",
+        },
+    )
+
+
 def d2h(size: int = 65536) -> None:
     """Device->host throughput probes for the write phase: one-shot vs
-    chunked at prefetch depths 1 and 4 (the packed_io pipeline's knob)."""
+    chunked at prefetch depths 1, 2 and 4 (the packed_io pipeline's knob)."""
     import jax
     import jax.numpy as jnp
 
@@ -133,9 +183,7 @@ def d2h(size: int = 65536) -> None:
 
     nwords = size // 32
     rng = np.random.default_rng(1)
-    host = rng.integers(0, 2**32, size=(size, nwords), dtype=np.uint64).astype(
-        np.uint32
-    )
+    host = rng.integers(0, 2**32, size=(size, nwords), dtype=np.uint32)
     words = jnp.asarray(host)
     words.block_until_ready()
     log("words on device:", host.nbytes >> 20, "MB")
@@ -146,7 +194,7 @@ def d2h(size: int = 65536) -> None:
     results["oneshot_s"] = time.perf_counter() - t0
 
     chunk_rows = max(1, packed_io._WRITE_CHUNK_BYTES // (nwords * 4))
-    for depth in (1, 4):
+    for depth in (1, 2, 4):
         import concurrent.futures
 
         starts = list(range(0, size, chunk_rows))
@@ -222,13 +270,18 @@ def config5(size: int = 65536, gens: int = 10000) -> None:
             "wall_s": round(wall, 1),
             "size": size,
             "note": "BASELINE.md config 5 end-to-end via the CLI on one "
-            "chip: packed I/O + overlapped temporal kernel + deepened D2H "
-            "write pipeline (r2: exec 16.4s, write 25.5s, read 10.1s).",
+            "chip: packed I/O + temporal kernel + chunked D2H write "
+            "pipeline at depth GOL_D2H_DEPTH (default 2). Read/write "
+            "phases ride the attach tunnel, whose throughput drifts "
+            "several-x between sessions (benchmarks/d2h_probe_r3.json "
+            "records the same-session transfer floor); Execution time is "
+            "on-device and comparable across sessions (r2: exec 16.4s, "
+            "write 25.5s, read 10.1s).",
         },
     )
 
 
-STEPS = {"compare32k": compare32k, "d2h": d2h, "config5": config5}
+STEPS = {"compare32k": compare32k, "h2d": h2d, "d2h": d2h, "config5": config5}
 
 
 def main() -> int:

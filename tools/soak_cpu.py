@@ -11,7 +11,7 @@ per-kernel case counts at the end so coverage of each path is visible —
 pallas cases need 128-lane local shards, so their draws use wider grids.
 Round-2 record: 2828 cases across five runs; round-3 record: 844 cases
 across six runs (longest: 407 cases with 88 segmented and 94 resumed
-replays, plus 'packed-interp' draws fuzzing the overlapped deep-halo
+replays, plus 'packed-interp' draws fuzzing the banded deep-halo
 kernel composition in interpret mode), all oracle-identical. The pytest
 suite pins fixed cases; this explores the space around them.
 """
@@ -59,7 +59,7 @@ while time.time() < DEADLINE:
     density = float(rng.random())
     seed = int(rng.integers(2**31))
     # A slice of packed mesh draws routes through the interpret-mode Mosaic
-    # kernels (kernel='packed-interp') so the overlapped deep-halo temporal
+    # kernels (kernel='packed-interp') so the banded deep-halo temporal
     # composition gets fuzzed, not just the jnp network. A first-class
     # kernel name, so runner caches key correctly with no global-flag
     # toggling. Interpret mode is slow: small shapes, short runs.
