@@ -23,14 +23,10 @@ import numpy as np
 
 
 def _honor_platform_env() -> None:
-    """Some environments preload jax at interpreter start (sitecustomize),
-    consuming JAX_PLATFORMS before it is set; re-apply it via jax.config
-    (backends initialize lazily, so this works until first device use)."""
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        import jax
+    """Re-apply JAX_PLATFORMS if a site hook consumed it (shared helper)."""
+    from gol_tpu.cli import honor_platform_env
 
-        jax.config.update("jax_platforms", platforms)
+    honor_platform_env()
 
 TARGET_CELL_UPDATES_PER_SEC_PER_CHIP = 1e11  # BASELINE.md north star
 
@@ -226,8 +222,20 @@ def _bench_compare(args) -> int:
             # banded temporal pass — the honest per-chip proxy for flagship
             # mesh throughput. (An overlapped interior/frontier split was
             # measured here in r3 and retired: see _distributed_step_multi.)
+            # SINGLE_DEVICE has cols == 1, so this lane measures the
+            # rows-only kernel — the R x 1 recommended pod layout.
             paths["packed-dist-temporal"] = (
                 lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0],
+                "words",
+                sp.TEMPORAL_GENS,
+            )
+            # The 2D-mesh form (ghost-column plane engaged): a cols > 1
+            # topology with local wraps — what an R x C pod chip runs.
+            from gol_tpu.parallel.mesh import Topology
+
+            proxy_2d = Topology(shape=(1, 2), axes=())
+            paths["packed-dist-temporal-2d"] = (
+                lambda w: sp._distributed_step_multi(w, proxy_2d)[0],
                 "words",
                 sp.TEMPORAL_GENS,
             )

@@ -29,11 +29,30 @@ the loop timer (src/game_mpi_collective.c:278-328).
 from __future__ import annotations
 
 import argparse
+import os
 import re
 import sys
 import time
 
 import numpy as np
+
+
+def honor_platform_env() -> None:
+    """Re-apply JAX_PLATFORMS if a site hook imported jax before it took.
+
+    Some environments preload jax at interpreter start (sitecustomize),
+    consuming JAX_PLATFORMS before the user's value is seen; backends
+    initialize lazily, so re-applying via jax.config works until first
+    device use. Without this, ``JAX_PLATFORMS=cpu gol ... --mesh 4x1`` on
+    an 8-virtual-CPU host still lands on the accelerator backend and fails
+    device-count validation. Shared by every entry point (``gol`` console
+    script, ``python -m gol_tpu``, bench.py).
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
 
 from gol_tpu import engine, oracle
 from gol_tpu.config import DEFAULT_HEIGHT, DEFAULT_WIDTH, GameConfig
@@ -604,6 +623,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    honor_platform_env()
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in ("run", "generate", "show", "-h", "--help"):

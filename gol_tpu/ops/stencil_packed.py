@@ -381,6 +381,89 @@ def _bandtg_kernel(
     _record_flags(i, flags, alive_ref, similar_ref)
 
 
+def _bandtrow_kernel(
+    main_ref, topn_ref, botn_ref, gtop_ref, gbot_ref,
+    out_ref, alive_ref, similar_ref,
+    *, band: int, nbands: int,
+):
+    """TEMPORAL_GENS generations per pass for one FULL-WIDTH mesh shard.
+
+    The rows-only specialization of ``_bandtg_kernel`` for R x 1 meshes
+    (row-only domain decomposition): the shard spans the whole grid width,
+    so the east/west torus wrap is the shard's own lane roll — exactly the
+    single-device kernel's column handling — and the entire ghost-column
+    plane (its per-generation adder pass, the per-row edge patches, and the
+    column-phase exchange feeding it) vanishes. Only the vertical context
+    differs from ``_bandt_kernel``: the first/last band take the
+    ppermute'd TEMPORAL_GENS-row ghost blocks instead of the modular wrap.
+
+    Row-only decomposition is also the recommended pod layout for this
+    stencil: per-chip comm drops to the two N/S ghost-row blocks riding one
+    ICI ring axis (the reference's E/W column messages and 4 corner
+    requests, src/game_mpi.c:340-383, have no analog here at all).
+    """
+    i = pl.program_id(0)
+    top_ctx = jnp.where(i == 0, gtop_ref[:], topn_ref[:])
+    bot_ctx = jnp.where(i == nbands - 1, gbot_ref[:], botn_ref[:])
+    x = jnp.concatenate([top_ctx, main_ref[:], bot_ctx], axis=0)
+    nwords = x.shape[1]
+
+    def evolve_full(x):
+        left = pltpu.roll(x, 1 % nwords, 1)
+        right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
+        return _vroll_combine(s0, s1, m0, m1, x)
+
+    prev = main_ref[:]
+    flags = []
+    for _ in range(TEMPORAL_GENS):
+        x = evolve_full(x)
+        g = x[8 : band + 8]
+        alive = jnp.max(jnp.where(g != 0, 1, 0))
+        similar = 1 - jnp.max(jnp.where((g ^ prev) != 0, 1, 0))
+        flags.append((alive, similar))
+        prev = g
+    out_ref[:] = prev
+    _record_flags(i, flags, alive_ref, similar_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _step_trow(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
+               interpret: bool = False):
+    """Temporal pass for one full-width (h, nwords) shard from N/S ghost
+    blocks only (see ``_bandtrow_kernel``)."""
+    h, nwords = words.shape
+    band = _pick_band(h, nwords, _BANDT_BYTES)
+    nb = h // _SUBLANES
+    T = TEMPORAL_GENS
+    new, alive, similar = pl.pallas_call(
+        functools.partial(_bandtrow_kernel, band=band, nbands=h // band),
+        grid=(h // band,),
+        in_specs=[
+            *_banded_specs(band, nwords, nb),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, nwords), jnp.uint32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(words, words, words, gtop, gbot)
+    return new, alive[0], similar[0]
+
+
 def _banded_specs(band: int, nwords: int, nb: int):
     """The (main, top-wrap, bot-wrap) BlockSpec triple every temporal
     operand uses: a band-aligned block plus the 8-row neighbor blocks
@@ -589,6 +672,17 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
     # loss at both scales (benchmarks/compare_32768_r3.json: overlap 0.40
     # vs seq 0.49-0.88 of the single-chip rate across sessions).
     interpret = jax.default_backend() != "tpu"
+    if topology.shape[1] == 1:
+        # Row-only decomposition (R x 1 mesh): full-width shards, so the
+        # E/W wrap is the shard's own lane roll and the whole ghost-column
+        # machinery — measured at ~2/3 of the mesh form's overhead at the
+        # 16384^2 pod-shard size — drops out. The recommended pod layout.
+        rows, _cols = topology.shape
+        row_axis = ROW_AXIS if topology.distributed else None
+        gtop, gbot = halo.ghost_slices(
+            words, 0, row_axis, rows, depth=TEMPORAL_GENS
+        )
+        return _step_trow(words, gtop, gbot, interpret=interpret)
     gtop, gbot, G_ext = deep_ghost_operands(words, topology)
     return _step_tgb(words, gtop, gbot, G_ext, interpret=interpret)
 

@@ -376,6 +376,73 @@ def test_ghost_operand_temporal_multi_band(monkeypatch):
         assert int(alive[t]) == int(states[t + 1].any()), t
 
 
+@pytest.mark.parametrize("shape", [(16, 64), (16, 128 * 32), (32, 96)])
+def test_rows_only_temporal_kernel_interpret(shape):
+    """The rows-only temporal form (_step_trow, R x 1 meshes): full-width
+    shards take their E/W torus wrap from the shard's own lane roll; only
+    the N/S ghost blocks ride as operands. State and per-generation flags
+    must match the oracle exactly (local wrap = 1-row topology)."""
+    from gol_tpu.parallel import halo
+
+    h, w = shape
+    rng = np.random.default_rng(31)
+    g = rng.integers(0, 2, size=shape, dtype=np.uint8)
+    T = sp.TEMPORAL_GENS
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot = halo.ghost_slices(words, 0, None, 1, depth=T)
+    assert gtop.shape == (T, w // 32)
+    new, alive, similar = sp._step_trow(words, gtop, gbot, interpret=True)
+    got = np.asarray(sp.decode(new))
+    states = [g]
+    for _ in range(T):
+        states.append(oracle.evolve(states[-1]))
+    np.testing.assert_array_equal(got, states[-1])
+    for t in range(T):
+        assert int(alive[t]) == int(states[t + 1].any()), t
+        assert int(similar[t]) == int(np.array_equal(states[t + 1], states[t])), t
+
+
+def test_rows_only_routing_and_multi_band(monkeypatch):
+    """cols == 1 topologies route _distributed_step_multi through the
+    rows-only kernel (force_interp engages it off-TPU), including across
+    multiple bands with the i>0 SMEM flag accumulation."""
+    h, w = 48, 64
+    rng = np.random.default_rng(43)
+    g = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
+    T = sp.TEMPORAL_GENS
+    words = sp.encode(jnp.asarray(g))
+    monkeypatch.setattr(sp, "_BANDT_BYTES", 8 << 10)  # force 16-row bands
+    new, alive, similar = sp._distributed_step_multi(
+        words, SINGLE_DEVICE, force_interp=True
+    )
+    got = np.asarray(sp.decode(new))
+    states = [g]
+    for _ in range(T):
+        states.append(oracle.evolve(states[-1]))
+    np.testing.assert_array_equal(got, states[-1])
+    for t in range(T):
+        assert int(alive[t]) == int(states[t + 1].any()), t
+
+
+def test_rows_only_kernel_under_real_mesh():
+    """The rows-only kernel composed with REAL shard_map ppermutes on a
+    4x1 CPU mesh (kernel='packed-interp' routes the temporal pass through
+    _step_trow in interpret mode); glider crosses the N/S shard seams."""
+    from gol_tpu import engine as eng
+    from gol_tpu.config import GameConfig as GC
+    from gol_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(59)
+    g = rng.integers(0, 2, size=(64, 128), dtype=np.uint8)
+    lim = 2 * sp.TEMPORAL_GENS + 3
+    got = eng.simulate(
+        g, GC(gen_limit=lim), mesh=make_mesh(4, 1), kernel="packed-interp"
+    )
+    expect = oracle.run(g, GC(gen_limit=lim))
+    np.testing.assert_array_equal(got.grid, expect.grid)
+    assert got.generations == expect.generations
+
+
 def test_banded_kernel_under_real_mesh():
     """The banded ghost-operand kernels composed with REAL shard_map
     ppermutes: kernel='packed-interp' routes the CPU-mesh temporal pass

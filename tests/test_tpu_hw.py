@@ -68,10 +68,12 @@ def test_temporal_kernel_matches_8_network_generations():
 
 
 def test_mesh_form_kernels_match_network():
-    # SINGLE_DEVICE topology: the ghost-operand kernels with local wrap —
-    # the compiled code a pod shard runs, minus the ppermutes. The temporal
-    # form is the sequential banded ghost-operand kernel (_step_tgb; the
-    # overlapped interior/frontier split was measured slower and retired).
+    # The compiled code a pod shard runs, minus the ppermutes (local wrap).
+    # SINGLE_DEVICE (cols == 1) routes the temporal form through the
+    # rows-only kernel (_step_trow, the R x 1 pod layout); a cols > 1
+    # proxy topology routes the ghost-plane form (_step_tgb, R x C pods).
+    from gol_tpu.parallel.mesh import Topology
+
     words = _random_words(256, 48, seed=4)
     ref1 = packed_math.evolve_torus_words(words)
     new1 = sp._distributed_step(words, SINGLE_DEVICE)[0]
@@ -84,10 +86,19 @@ def test_mesh_form_kernels_match_network():
     assert np.array_equal(np.asarray(newt), np.asarray(cur))
     assert np.asarray(a_vec).tolist() == [1] * sp.TEMPORAL_GENS
 
+    new2d, a2_vec, _ = sp._distributed_step_multi(
+        words, Topology(shape=(1, 2), axes=())
+    )
+    assert np.array_equal(np.asarray(new2d), np.asarray(cur))
+    assert np.asarray(a2_vec).tolist() == [1] * sp.TEMPORAL_GENS
+
 
 def test_mesh_temporal_single_word_branch():
-    # nwords == 1: the banded form's edge patches collapse onto the same
-    # word (gw and ge both target lane 0), compiled on hardware.
+    # nwords == 1 compiled on hardware, both mesh forms: rows-only (the
+    # lane roll degenerates to the identity, in-word bit wrap only) and the
+    # ghost-plane form (gw and ge patches both target lane 0).
+    from gol_tpu.parallel.mesh import Topology
+
     words = _random_words(64, 1, seed=8)
     cur = words
     for _ in range(sp.TEMPORAL_GENS):
@@ -95,6 +106,10 @@ def test_mesh_temporal_single_word_branch():
     newt, a_vec, _ = sp._distributed_step_multi(words, SINGLE_DEVICE)
     assert np.array_equal(np.asarray(newt), np.asarray(cur))
     assert np.asarray(a_vec).tolist() == [1] * sp.TEMPORAL_GENS
+    new2d, _, _ = sp._distributed_step_multi(
+        words, Topology(shape=(1, 2), axes=())
+    )
+    assert np.array_equal(np.asarray(new2d), np.asarray(cur))
 
 
 def test_packed_width_cap_compiles_and_matches():

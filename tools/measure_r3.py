@@ -59,7 +59,7 @@ def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
     import jax.numpy as jnp
 
     from gol_tpu.ops import stencil_packed as sp
-    from gol_tpu.parallel.mesh import SINGLE_DEVICE
+    from gol_tpu.parallel.mesh import SINGLE_DEVICE, Topology
 
     words = jnp.asarray(_host_words(size))
     words.block_until_ready()
@@ -72,10 +72,17 @@ def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
 
         return jax.jit(run)
 
+    proxy_2d = Topology(shape=(1, 2), axes=())  # cols>1: ghost-plane form
     paths = {
         "packed-temporal-T8": lambda w: sp._step_t(w)[0],
+        # cols == 1 -> the rows-only kernel (R x 1 pod layout, full-width
+        # shards, no ghost-column machinery).
         "packed-dist-temporal": lambda w: sp._distributed_step_multi(
             w, SINGLE_DEVICE
+        )[0],
+        # cols > 1 with local wraps -> the 2D-mesh ghost-plane form.
+        "packed-dist-temporal-2d": lambda w: sp._distributed_step_multi(
+            w, proxy_2d
         )[0],
     }
     g2 = 3 * g1
@@ -99,6 +106,7 @@ def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
         res[name] = size * size / marg
         log(f"{name:26s} {marg * 1e3:8.3f} ms/gen  {res[name]:.3e} cells/s")
     ratio = res["packed-dist-temporal"] / res["packed-temporal-T8"]
+    ratio_2d = res["packed-dist-temporal-2d"] / res["packed-temporal-T8"]
     _write(
         f"compare_{size}_r3.json",
         {
@@ -107,17 +115,20 @@ def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
             "unit": "ratio",
             "vs_baseline": None,
             "detail": res,
+            "ratio_2d_form": ratio_2d,
             "size": size,
             "generations": [g1, g2],
             "note": (
                 "marginal rates, fixed-count fori_loop, one chip, repeats "
                 "interleaved across paths to cancel the tunnel chip's "
-                "minute-scale drift; packed-dist-temporal is the sequential "
-                "banded mesh form (exchange + ghost-operand kernel). The r3 "
-                "overlapped interior/frontier split measured 0.40 vs this "
-                "form's 0.49-0.88 across sessions and was retired — its "
-                "frontier kernels cost ~0.8x of the main kernel to hide an "
-                "exchange costing ~0.15x on-chip (see "
+                "minute-scale drift. packed-dist-temporal is the rows-only "
+                "kernel (R x 1 pod layout: full-width shards, E/W wrap = "
+                "own lane roll, no ghost-column machinery); -2d is the "
+                "ghost-plane form an R x C pod chip runs. The r3 "
+                "overlapped interior/frontier split measured 0.40 vs the "
+                "2d form's 0.49-0.88 across sessions and was retired — "
+                "its frontier kernels cost ~0.8x of the main kernel to "
+                "hide an exchange costing ~0.15x on-chip (see "
                 "stencil_packed._distributed_step_multi)."
             ),
         },

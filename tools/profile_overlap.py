@@ -1,6 +1,11 @@
 """Decompose the mesh-temporal step's cost on the chip.
 
-    python tools/profile_overlap.py [size]
+    python tools/profile_overlap.py [size] [N2]
+
+``N2`` is the long-chain call count (the short chain is N2 // 3); scale it
+inversely with the per-call time — the tunnel's ~10 ms timing jitter is
+divided by (N2 - N1), so a 16384^2 grid (~0.5 ms/call) needs chains ~8x
+longer than 32768^2 for the same resolution.
 
 Methodology matches tools/measure_r3.py: every figure is a MARGINAL rate —
 time a fori_loop chain of N1 calls and one of N2 > N1 calls, each forced by
@@ -40,15 +45,23 @@ REPEATS = 3
 
 
 def probes(words, sp, SINGLE_DEVICE):
-    """(name, state->state) pieces of the mesh temporal step."""
+    """(name, state->state) pieces of the mesh temporal step.
+
+    The 2D (ghost-plane) form is decomposed against a cols=2 proxy topology
+    — SINGLE_DEVICE (cols == 1) routes _distributed_step_multi through the
+    rows-only kernel, a different composition, profiled as its own lane.
+    """
+    from gol_tpu.parallel.mesh import Topology
+
+    proxy_2d = Topology(shape=(1, 2), axes=())
     gtop, gbot, G_ext = jax.jit(
-        lambda w: sp.deep_ghost_operands(w, SINGLE_DEVICE))(words)
+        lambda w: sp.deep_ghost_operands(w, proxy_2d))(words)
     int(gtop[0, 0])
 
     # Exchange alone, chained by writing one ghost word back into the state
     # (keeps a data dependence so the loop can't collapse).
     def ghost_step(w):
-        gt, gb, ge = sp.deep_ghost_operands(w, SINGLE_DEVICE)
+        gt, gb, ge = sp.deep_ghost_operands(w, proxy_2d)
         return jax.lax.dynamic_update_slice(w, gt[0:1, 0:1], (0, 0))
 
     return [
@@ -59,7 +72,9 @@ def probes(words, sp, SINGLE_DEVICE):
         ("tgb_kernel_only",
          lambda w: sp._step_tgb(w, gtop, gbot, G_ext)[0]),
         ("ghosts_only", ghost_step),
-        ("mesh_form_full",
+        ("mesh_2d_full",
+         lambda w: sp._distributed_step_multi(w, proxy_2d)[0]),
+        ("mesh_rows_full",
          lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0]),
     ]
 
@@ -84,7 +99,11 @@ def marginal(step, state):
 
 
 def main() -> int:
+    global N1, N2
     size = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    if len(sys.argv) > 2:
+        N2 = int(sys.argv[2])
+        N1 = max(1, N2 // 3)
     from gol_tpu.ops import stencil_packed as sp
     from gol_tpu.parallel.mesh import SINGLE_DEVICE
 

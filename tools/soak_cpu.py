@@ -9,11 +9,12 @@ case byte-compared against the NumPy oracle:
 (The 8-virtual-device XLA flag is set automatically when absent.) Prints the
 per-kernel case counts at the end so coverage of each path is visible —
 pallas cases need 128-lane local shards, so their draws use wider grids.
-Round-2 record: 2828 cases across five runs; round-3 record: 844 cases
-across six runs (longest: 407 cases with 88 segmented and 94 resumed
-replays, plus 'packed-interp' draws fuzzing the banded deep-halo
-kernel composition in interpret mode), all oracle-identical. The pytest
-suite pins fixed cases; this explores the space around them.
+Round-2 record: 2828 cases across five runs; round-3 record: 1517 cases
+across seven runs (longest: 673 cases with 145 segmented and 138 resumed
+replays, plus 18 'packed-interp' draws fuzzing the banded deep-halo
+kernel composition in interpret mode — the post-retirement routing), all
+oracle-identical. The pytest suite pins fixed cases; this explores the
+space around them.
 """
 import collections
 import os

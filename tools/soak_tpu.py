@@ -8,7 +8,13 @@ temporal, byte band kernel) must match the jnp reference exactly:
 
 The seed is taken from the clock and printed, so every run explores new
 shapes and any failure is replayable. Round-2 record: 213 shapes across
-three runs (compiles dominate the wall clock), all identical.
+three runs (compiles dominate the wall clock), all identical. Round-3
+record: 34 shapes in one run (seed 1785501403, the sequential banded mesh
+form), all identical; an earlier run died mid-way on a remote-compile
+service SIGTERM (infrastructure, not a kernel failure) — don't
+co-schedule the CPU soak's compile storm with this one on a shared host.
+Since the rows-only kernel landed, each draw soaks BOTH mesh temporal
+forms (rows-only via SINGLE_DEVICE, ghost-plane via the cols=2 proxy).
 """
 import os
 import sys
@@ -20,7 +26,9 @@ import jax
 import jax.numpy as jnp
 
 from gol_tpu.ops import packed_math, stencil_lax, stencil_packed as sp, stencil_pallas as spl
-from gol_tpu.parallel.mesh import SINGLE_DEVICE
+from gol_tpu.parallel.mesh import SINGLE_DEVICE, Topology
+
+PROXY_2D = Topology(shape=(1, 2), axes=())  # cols > 1: ghost-plane form
 
 if jax.default_backend() != "tpu":
     print("soak_tpu needs an attached TPU backend")
@@ -50,9 +58,18 @@ while time.time() < DEADLINE:
         for _ in range(sp.TEMPORAL_GENS):
             cur = packed_math.evolve_torus_words(cur)
         check("temporal", sp._step_t(words)[0], cur, (h, nw))
+        # SINGLE_DEVICE has cols == 1: the rows-only kernel. The cols > 1
+        # proxy draws the 2D ghost-plane form (what R x C pod chips run)
+        # with local wraps, so BOTH compiled mesh forms stay fuzzed.
         check(
-            "dist-temporal",
+            "dist-temporal-rows",
             sp._distributed_step_multi(words, SINGLE_DEVICE)[0],
+            cur,
+            (h, nw),
+        )
+        check(
+            "dist-temporal-2d",
+            sp._distributed_step_multi(words, PROXY_2D)[0],
             cur,
             (h, nw),
         )
