@@ -231,11 +231,10 @@ def _bench_compare(args) -> int:
             )
             # The 2D-mesh form (ghost-column plane engaged): a cols > 1
             # topology with local wraps — what an R x C pod chip runs.
-            from gol_tpu.parallel.mesh import Topology
+            from gol_tpu.parallel.mesh import PROXY_2D
 
-            proxy_2d = Topology(shape=(1, 2), axes=())
             paths["packed-dist-temporal-2d"] = (
-                lambda w: sp._distributed_step_multi(w, proxy_2d)[0],
+                lambda w: sp._distributed_step_multi(w, PROXY_2D)[0],
                 "words",
                 sp.TEMPORAL_GENS,
             )

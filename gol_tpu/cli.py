@@ -68,7 +68,7 @@ def atoi(s: str | None) -> int:
     return int(m.group(1)) if m else 0
 
 
-def _parse_mesh_arg(spec: str | None, distributed: bool):
+def _parse_mesh_arg(spec: str | None, distributed: bool, width: int | None = None):
     import jax
 
     from gol_tpu.parallel.mesh import make_mesh
@@ -85,7 +85,9 @@ def _parse_mesh_arg(spec: str | None, distributed: bool):
         if not m:
             raise ValueError(f"--mesh must look like RxC, got {spec!r}")
         return make_mesh(int(m.group(1)), int(m.group(2)))
-    return make_mesh(devices=jax.devices())
+    # Default factorization: row-only, unless the grid width would push the
+    # full-width shard past the temporal kernel's VMEM cap.
+    return make_mesh(devices=jax.devices(), width=width)
 
 
 def _warn_if_huge_byte_lane(width: int, height: int, mesh=None) -> bool:
@@ -210,7 +212,7 @@ def _run(args) -> int:
         from gol_tpu.parallel import bootstrap
 
         bootstrap.initialize()
-    mesh = _parse_mesh_arg(args.mesh, variant.distributed)
+    mesh = _parse_mesh_arg(args.mesh, variant.distributed, width)
     from gol_tpu.parallel.mesh import topology_for, validate_grid
 
     if mesh is not None and not topology_for(mesh).distributed:

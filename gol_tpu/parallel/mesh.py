@@ -20,7 +20,6 @@ of silently truncating.
 from __future__ import annotations
 
 import dataclasses
-import math
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -52,32 +51,66 @@ class Topology:
 
 SINGLE_DEVICE = Topology()
 MESH_TOPOLOGY_AXES = (ROW_AXIS, COL_AXIS)
+# A cols>1 topology with NO mesh axes: local torus wraps, but the kernels
+# route as for an R x C pod shard. Benchmarks/soaks/tests use it to exercise
+# the 2D ghost-plane form on one chip (SINGLE_DEVICE routes rows-only).
+PROXY_2D = Topology(shape=(1, 2), axes=())
 
 
-def choose_mesh_shape(n_devices: int) -> tuple[int, int]:
-    """Pick the most-square R x C factorization of ``n_devices``.
+def choose_mesh_shape(n_devices: int, width: int | None = None) -> tuple[int, int]:
+    """Pick the default R x C factorization of ``n_devices``: ``(n, 1)``.
 
     The reference only accepts perfect squares (``sqrt(comm_sz)`` truncation,
-    src/game_mpi_collective.c:125); a near-square factorization keeps the
-    O(perimeter) halo volume minimal while accepting any device count.
+    src/game_mpi_collective.c:125) because a near-square factorization
+    minimizes the O(perimeter) halo bytes. On TPU that objective is the
+    wrong one: halo bytes cost microseconds on ICI either way, while the
+    COLUMN-direction ghost machinery costs real per-generation compute in
+    the packed kernel (the ghost-column plane's adder pass + per-row edge
+    patches). A row-only R x 1 decomposition needs none of it — full-width
+    shards wrap E/W through their own lane roll — and measured 94.6-102%
+    of the single-chip rate on v5e vs 64-83% for the 2D form
+    (benchmarks/compare_{16384,32768}_r3.json), so it is the default.
+
+    ``width`` (the grid width, when the caller knows it) guards the one
+    case where full-width shards backfire: the temporal kernel's VMEM
+    width cap. Past it the R x 1 shard would silently fall to the ~2x
+    slower per-generation kernel, so just enough mesh columns are added
+    to bring the shard width back under the cap. Note an R x 1 default
+    also requires height % n == 0 (validate_grid errors loudly otherwise,
+    as for any explicit mesh); ``make_mesh(rows, cols)`` still builds any
+    R x C mesh.
     """
-    r = int(math.isqrt(n_devices))
-    while n_devices % r != 0:
-        r -= 1
-    return r, n_devices // r
+    if width is not None:
+        # Late import: ops imports this module at load time.
+        from gol_tpu.ops.stencil_packed import _BITS, _MAX_WORDS_T
+
+        cols = 1
+        while (
+            cols < n_devices
+            and n_devices % cols == 0
+            and width // (_BITS * cols) > _MAX_WORDS_T
+        ):
+            cols += 1
+            while n_devices % cols and cols < n_devices:
+                cols += 1
+        if n_devices % cols == 0 and width // (_BITS * cols) <= _MAX_WORDS_T:
+            return n_devices // cols, cols
+    return n_devices, 1
 
 
 def make_mesh(
     rows: int | None = None,
     cols: int | None = None,
     devices=None,
+    width: int | None = None,
 ) -> Mesh:
-    """Build the 2D ('row', 'col') device mesh."""
+    """Build the 2D ('row', 'col') device mesh. ``width`` only informs the
+    default factorization (see ``choose_mesh_shape``)."""
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if rows is None and cols is None:
-        rows, cols = choose_mesh_shape(n)
+        rows, cols = choose_mesh_shape(n, width)
     elif rows is None:
         if cols <= 0 or n % cols:
             raise ValueError(f"cannot infer mesh rows: {n} devices not divisible by cols={cols}")

@@ -147,10 +147,21 @@ class TestDistributed:
 
 
 def test_choose_mesh_shape():
-    assert choose_mesh_shape(8) == (2, 4)
-    assert choose_mesh_shape(16) == (4, 4)
+    # Row-only (n, 1) is the default: the measured-fastest decomposition
+    # (full-width shards skip the ghost-column machinery entirely).
+    assert choose_mesh_shape(8) == (8, 1)
+    assert choose_mesh_shape(16) == (16, 1)
     assert choose_mesh_shape(1) == (1, 1)
-    assert choose_mesh_shape(7) == (1, 7)
+    assert choose_mesh_shape(7) == (7, 1)
+    # Width-aware guard: past the temporal kernel's VMEM width cap
+    # (_MAX_WORDS_T words per shard), just enough mesh columns are added to
+    # keep the fast kernel eligible instead of silently falling to the
+    # per-generation path.
+    assert choose_mesh_shape(8, width=131072) == (8, 1)   # exactly at cap
+    assert choose_mesh_shape(8, width=262144) == (4, 2)
+    assert choose_mesh_shape(8, width=1048576) == (1, 8)
+    assert choose_mesh_shape(16, width=262144) == (8, 2)
+    assert choose_mesh_shape(7, width=262144) == (1, 7)   # prime: 7 cols
 
 
 def test_validate_grid_local_shape():

@@ -28,7 +28,7 @@ from gol_tpu.io import text_grid  # noqa: E402
 from gol_tpu.ops import packed_math, stencil_lax  # noqa: E402
 from gol_tpu.ops import stencil_packed as sp  # noqa: E402
 from gol_tpu.ops import stencil_pallas as spl  # noqa: E402
-from gol_tpu.parallel.mesh import SINGLE_DEVICE  # noqa: E402
+from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE  # noqa: E402
 
 
 def _random_words(height, nwords, seed=0):
@@ -72,8 +72,6 @@ def test_mesh_form_kernels_match_network():
     # SINGLE_DEVICE (cols == 1) routes the temporal form through the
     # rows-only kernel (_step_trow, the R x 1 pod layout); a cols > 1
     # proxy topology routes the ghost-plane form (_step_tgb, R x C pods).
-    from gol_tpu.parallel.mesh import Topology
-
     words = _random_words(256, 48, seed=4)
     ref1 = packed_math.evolve_torus_words(words)
     new1 = sp._distributed_step(words, SINGLE_DEVICE)[0]
@@ -86,9 +84,7 @@ def test_mesh_form_kernels_match_network():
     assert np.array_equal(np.asarray(newt), np.asarray(cur))
     assert np.asarray(a_vec).tolist() == [1] * sp.TEMPORAL_GENS
 
-    new2d, a2_vec, _ = sp._distributed_step_multi(
-        words, Topology(shape=(1, 2), axes=())
-    )
+    new2d, a2_vec, _ = sp._distributed_step_multi(words, PROXY_2D)
     assert np.array_equal(np.asarray(new2d), np.asarray(cur))
     assert np.asarray(a2_vec).tolist() == [1] * sp.TEMPORAL_GENS
 
@@ -97,8 +93,6 @@ def test_mesh_temporal_single_word_branch():
     # nwords == 1 compiled on hardware, both mesh forms: rows-only (the
     # lane roll degenerates to the identity, in-word bit wrap only) and the
     # ghost-plane form (gw and ge patches both target lane 0).
-    from gol_tpu.parallel.mesh import Topology
-
     words = _random_words(64, 1, seed=8)
     cur = words
     for _ in range(sp.TEMPORAL_GENS):
@@ -106,9 +100,7 @@ def test_mesh_temporal_single_word_branch():
     newt, a_vec, _ = sp._distributed_step_multi(words, SINGLE_DEVICE)
     assert np.array_equal(np.asarray(newt), np.asarray(cur))
     assert np.asarray(a_vec).tolist() == [1] * sp.TEMPORAL_GENS
-    new2d, _, _ = sp._distributed_step_multi(
-        words, Topology(shape=(1, 2), axes=())
-    )
+    new2d, _, _ = sp._distributed_step_multi(words, PROXY_2D)
     assert np.array_equal(np.asarray(new2d), np.asarray(cur))
 
 

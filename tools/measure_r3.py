@@ -59,7 +59,7 @@ def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
     import jax.numpy as jnp
 
     from gol_tpu.ops import stencil_packed as sp
-    from gol_tpu.parallel.mesh import SINGLE_DEVICE, Topology
+    from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
 
     words = jnp.asarray(_host_words(size))
     words.block_until_ready()
@@ -72,7 +72,7 @@ def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
 
         return jax.jit(run)
 
-    proxy_2d = Topology(shape=(1, 2), axes=())  # cols>1: ghost-plane form
+    proxy_2d = PROXY_2D  # cols>1: ghost-plane form
     paths = {
         "packed-temporal-T8": lambda w: sp._step_t(w)[0],
         # cols == 1 -> the rows-only kernel (R x 1 pod layout, full-width

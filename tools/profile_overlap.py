@@ -47,13 +47,12 @@ REPEATS = 3
 def probes(words, sp, SINGLE_DEVICE):
     """(name, state->state) pieces of the mesh temporal step.
 
-    The 2D (ghost-plane) form is decomposed against a cols=2 proxy topology
+    The 2D (ghost-plane) form is decomposed against the PROXY_2D topology
     — SINGLE_DEVICE (cols == 1) routes _distributed_step_multi through the
     rows-only kernel, a different composition, profiled as its own lane.
     """
-    from gol_tpu.parallel.mesh import Topology
+    from gol_tpu.parallel.mesh import PROXY_2D as proxy_2d
 
-    proxy_2d = Topology(shape=(1, 2), axes=())
     gtop, gbot, G_ext = jax.jit(
         lambda w: sp.deep_ghost_operands(w, proxy_2d))(words)
     int(gtop[0, 0])
@@ -102,8 +101,10 @@ def main() -> int:
     global N1, N2
     size = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
     if len(sys.argv) > 2:
-        N2 = int(sys.argv[2])
+        N2 = max(2, int(sys.argv[2]))
         N1 = max(1, N2 // 3)
+        if N1 == N2:
+            N1 = N2 - 1
     from gol_tpu.ops import stencil_packed as sp
     from gol_tpu.parallel.mesh import SINGLE_DEVICE
 

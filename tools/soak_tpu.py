@@ -26,9 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from gol_tpu.ops import packed_math, stencil_lax, stencil_packed as sp, stencil_pallas as spl
-from gol_tpu.parallel.mesh import SINGLE_DEVICE, Topology
-
-PROXY_2D = Topology(shape=(1, 2), axes=())  # cols > 1: ghost-plane form
+from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
 
 if jax.default_backend() != "tpu":
     print("soak_tpu needs an attached TPU backend")
