@@ -223,6 +223,18 @@ TEMPORAL_GENS = 8
 _BANDT_BYTES = 2 << 20
 
 
+def _bandt_target(nwords: int) -> int:
+    """Band byte target for the temporal kernels, width-aware at the cap
+    edge: at _MAX_WORDS_T-word rows (32KB at the current 8192-word cap)
+    the 2MB target's 64-row bands blow the 16MB scoped-VMEM stack (17.73M
+    measured at (1024, 8192) on v5e); a 1MB target's 32-row bands compile
+    — for every temporal form, see test_temporal_width_cap_compiles_and_
+    matches. Narrower rows keep the 2MB target whose gains were measured
+    at 16384^2/65536^2. The threshold is expressed via _MAX_WORDS_T so
+    raising the cap re-tests this edge rather than silently bypassing it."""
+    return _BANDT_BYTES if nwords < _MAX_WORDS_T else (1 << 20)
+
+
 def _vroll_combine(s0, s1, m0, m1, x):
     """Vertical combine over a whole extended block: re-rank the triple-sum
     planes by ±1 sublane torus rolls (the roll-seam rows are the callers'
@@ -433,7 +445,7 @@ def _step_trow(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
     """Temporal pass for one full-width (h, nwords) shard from N/S ghost
     blocks only (see ``_bandtrow_kernel``)."""
     h, nwords = words.shape
-    band = _pick_band(h, nwords, _BANDT_BYTES)
+    band = _pick_band(h, nwords, _bandt_target(nwords))
     nb = h // _SUBLANES
     T = TEMPORAL_GENS
     new, alive, similar = pl.pallas_call(
@@ -487,7 +499,7 @@ def _banded_specs(band: int, nwords: int, nb: int):
 @functools.partial(jax.jit, static_argnames=("interpret", "interior"))
 def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
     height, nwords = words.shape
-    band = _pick_band(height, nwords, _BANDT_BYTES)
+    band = _pick_band(height, nwords, _bandt_target(nwords))
     nb = height // _SUBLANES
     T = TEMPORAL_GENS
     new, alive, similar = pl.pallas_call(
@@ -531,7 +543,7 @@ def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
     exactly expressible as BlockSpecs with no overlap tricks.
     """
     h, nwords = words.shape
-    band = _pick_band(h, nwords, _BANDT_BYTES)
+    band = _pick_band(h, nwords, _bandt_target(nwords))
     bb = band // _SUBLANES
     nb = h // _SUBLANES
     T = TEMPORAL_GENS
@@ -573,15 +585,18 @@ def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
 
 
 # Width cap for the temporal kernel: its live set spans (band+16)-row
-# planes, so at very wide rows even the minimum band exceeds scoped VMEM
-# (32768 words: 24-row blocks x 128KB rows failed to compile when probed).
-# At the 4096-word cap (width 2^17) the 2MB target's 128-row bands compile
-# and match the oracle on v5e — the naive all-planes-live estimate says
-# ~27MB, so Mosaic's liveness is evidently tighter; treat compile-at-cap as
-# the empirical gate and re-probe (1024, 2^17) when raising _MAX_WORDS_T,
-# _BANDT_BYTES, or the network's live set. Wider falls back to the
-# single-gen kernel.
-_MAX_WORDS_T = 4 << 10
+# planes, so at very wide rows even the minimum band exceeds scoped VMEM.
+# At the 8192-word cap (width 2^18) the _bandt_target 1MB/32-row bands
+# compile and match the jnp network on v5e at (1024, 8192) — the 2MB
+# target's 64-row bands blow the 16MB scoped-VMEM stack by 1.73M there,
+# and 16384 words fails at Mosaic compile under either target. Treat
+# compile-at-cap as the empirical gate and re-probe (1024, cap) when
+# raising _MAX_WORDS_T, the band targets, or the network's live set.
+# Wider falls back to the single-gen kernel. The cap matters doubly since
+# the row-only (n, 1) default mesh: it bounds the widest grid whose
+# full-width shards keep the temporal kernel (choose_mesh_shape adds mesh
+# columns past it).
+_MAX_WORDS_T = 8 << 10
 
 
 def supports_multi(height: int, width: int, topology) -> bool:

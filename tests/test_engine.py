@@ -157,11 +157,11 @@ def test_choose_mesh_shape():
     # (_MAX_WORDS_T words per shard), just enough mesh columns are added to
     # keep the fast kernel eligible instead of silently falling to the
     # per-generation path.
-    assert choose_mesh_shape(8, width=131072) == (8, 1)   # exactly at cap
-    assert choose_mesh_shape(8, width=262144) == (4, 2)
-    assert choose_mesh_shape(8, width=1048576) == (1, 8)
-    assert choose_mesh_shape(16, width=262144) == (8, 2)
-    assert choose_mesh_shape(7, width=262144) == (1, 7)   # prime: 7 cols
+    assert choose_mesh_shape(8, width=262144) == (8, 1)   # exactly at cap
+    assert choose_mesh_shape(8, width=524288) == (4, 2)
+    assert choose_mesh_shape(8, width=2097152) == (1, 8)
+    assert choose_mesh_shape(16, width=524288) == (8, 2)
+    assert choose_mesh_shape(7, width=524288) == (1, 7)   # prime: 7 cols
 
 
 def test_validate_grid_local_shape():

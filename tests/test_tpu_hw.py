@@ -117,8 +117,12 @@ def test_packed_width_cap_compiles_and_matches():
 
 
 def test_temporal_width_cap_compiles_and_matches():
-    # The _MAX_WORDS_T=4096 empirical gate (width 2^17) at the 2MB band
-    # target (128-row bands).
+    # The _MAX_WORDS_T=8192 empirical gate (width 2^18) at the
+    # _bandt_target 1MB band target (32-row bands; the 2MB target's 64-row
+    # bands blow scoped VMEM by 1.73M here). EVERY temporal form must
+    # compile at the cap — supports_multi admits them all, and the rows-
+    # only (n, 1) default mesh makes full-width shards at the cap the
+    # routine case, not a corner.
     nwords = sp._MAX_WORDS_T
     assert sp.supports_multi(1024, nwords * 32, SINGLE_DEVICE)
     words = _random_words(1024, nwords, seed=6)
@@ -127,6 +131,12 @@ def test_temporal_width_cap_compiles_and_matches():
         cur = packed_math.evolve_torus_words(cur)
     new = sp._step_t(words)[0]
     assert np.array_equal(np.asarray(new), np.asarray(cur))
+    # Mesh forms at the cap: rows-only (what an (n, 1) shard runs) and the
+    # ghost-plane form (R x C shards) — larger live sets than _step_t.
+    new_rows = sp._distributed_step_multi(words, SINGLE_DEVICE)[0]
+    assert np.array_equal(np.asarray(new_rows), np.asarray(cur))
+    new_2d = sp._distributed_step_multi(words, PROXY_2D)[0]
+    assert np.array_equal(np.asarray(new_2d), np.asarray(cur))
 
 
 def test_byte_band_kernel_matches_lax():
