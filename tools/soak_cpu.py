@@ -9,12 +9,12 @@ case byte-compared against the NumPy oracle:
 (The 8-virtual-device XLA flag is set automatically when absent.) Prints the
 per-kernel case counts at the end so coverage of each path is visible —
 pallas cases need 128-lane local shards, so their draws use wider grids.
-Round-2 record: 2828 cases across five runs; round-3 record: 1517 cases
-across seven runs (longest: 673 cases with 145 segmented and 138 resumed
-replays, plus 18 'packed-interp' draws fuzzing the banded deep-halo
-kernel composition in interpret mode — the post-retirement routing), all
-oracle-identical. The pytest suite pins fixed cases; this explores the
-space around them.
+Round-2 record: 2828 cases across five runs; round-3 record: 2085 cases
+across eight runs (longest: 673 cases with 145 segmented and 138 resumed
+replays; the final 568-case run drew 'packed-interp' through the
+post-rows-only routing — R x 1 meshes take _step_trow, cols > 1 the
+banded ghost-plane kernel), all oracle-identical. The pytest suite pins
+fixed cases; this explores the space around them.
 """
 import collections
 import os
