@@ -536,7 +536,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=sorted(VARIANTS),
         help="which reference program to reproduce (default: the TPU-native flagship)",
     )
-    run.add_argument("--mesh", default=None, help="device mesh RxC (default: all devices)")
+    run.add_argument(
+        "--mesh", default=None,
+        help="device mesh RxC (default: all devices as Nx1 row-only — the "
+        "measured-fastest layout; mesh columns are added automatically "
+        "only when the grid width would exceed the fast kernel's "
+        "per-shard VMEM cap)")
     run.add_argument(
         "--kernel",
         default="auto",
