@@ -9,9 +9,10 @@ temporal, byte band kernel) must match the jnp reference exactly:
 The seed is taken from the clock and printed, so every run explores new
 shapes and any failure is replayable. Round-2 record: 213 shapes across
 three runs (compiles dominate the wall clock), all identical. Round-3
-record: 66 shapes across two runs (seeds 1785501403, 1785510712 — the
-second with each draw soaking BOTH mesh temporal forms, rows-only via
-SINGLE_DEVICE and ghost-plane via the cols=2 proxy), all identical; an
+record: 94 shapes across three runs (seeds 1785501403, 1785510712,
+1785520194 — the later two with each draw soaking BOTH mesh temporal
+forms, rows-only via SINGLE_DEVICE and ghost-plane via the cols=2
+proxy), all identical; an
 earlier run died mid-way on a remote-compile service SIGTERM
 (infrastructure, not a kernel failure) — don't co-schedule the CPU
 soak's compile storm with this one on a shared host.
