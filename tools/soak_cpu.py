@@ -9,8 +9,8 @@ case byte-compared against the NumPy oracle:
 (The 8-virtual-device XLA flag is set automatically when absent.) Prints the
 per-kernel case counts at the end so coverage of each path is visible —
 pallas cases need 128-lane local shards, so their draws use wider grids.
-Round-2 record: 2828 cases across five runs; round-3 record: 2568 cases
-across nine runs (longest: 673 cases with 145 segmented and 138 resumed
+Round-2 record: 2828 cases across five runs; round-3 record: 3042 cases
+across ten runs (longest: 673 cases with 145 segmented and 138 resumed
 replays; the last two runs, 568 + 483 cases, drew 'packed-interp' through
 the post-rows-only routing — R x 1 meshes take _step_trow, cols > 1 the
 banded ghost-plane kernel), all oracle-identical. The pytest suite pins
