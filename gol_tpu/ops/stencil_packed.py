@@ -223,16 +223,32 @@ TEMPORAL_GENS = 8
 _BANDT_BYTES = 2 << 20
 
 
-def _bandt_target(nwords: int) -> int:
-    """Band byte target for the temporal kernels, width-aware at the cap
-    edge: at _MAX_WORDS_T-word rows (32KB at the current 8192-word cap)
-    the 2MB target's 64-row bands blow the 16MB scoped-VMEM stack (17.73M
-    measured at (1024, 8192) on v5e); a 1MB target's 32-row bands compile
-    — for every temporal form, see test_temporal_width_cap_compiles_and_
-    matches. Narrower rows keep the 2MB target whose gains were measured
-    at 16384^2/65536^2. The threshold is expressed via _MAX_WORDS_T so
-    raising the cap re-tests this edge rather than silently bypassing it."""
-    return _BANDT_BYTES if nwords < _MAX_WORDS_T else (1 << 20)
+# Scoped-VMEM budget for a temporal kernel's (band + 2T)-row extended block,
+# with rows PADDED to whole 128-lane tiles (what Mosaic allocates). The
+# r3 rule dropped the band target only at exactly nwords >= _MAX_WORDS_T,
+# but the blowup it guards is continuous in width (advisor r3, medium): the
+# v5e compile-boundary probe (benchmarks/vmem_probe_r4.json, height 1024,
+# all three temporal forms) passes every config with extended block
+# <= 2.25MB (4096 words x 128+16 rows) and fails at 2.34MB+ (7680 words x
+# 64+16 rows; 8192 x 64+16 = 2.62MB reproduces the r3 17.73M-scoped-VMEM
+# failure). 2.25MB inclusive keeps every measured-fast config — including
+# the 65536^2 single-chip 2048-word/256-row bands — and is re-probed at the
+# boundary by test_tpu_hw.py::test_temporal_near_cap_widths.
+_BANDT_EXT_BUDGET = (2 << 20) + (256 << 10)
+
+
+def _bandt_target(height: int, nwords: int) -> int:
+    """Band byte target for the temporal kernels: the largest target whose
+    ACTUAL band (``_pick_band`` under this height's divisors) keeps the
+    padded extended block within ``_BANDT_EXT_BUDGET``. Width-continuous —
+    near-cap rows shrink the target before the cap, instead of jumping from
+    the 2MB target straight to a Mosaic OOM at the _MAX_WORDS_T edge."""
+    padded_row = max(-(-nwords // 128) * 128, 128) * 4
+    for target in (_BANDT_BYTES, 3 << 19, 1 << 20):
+        band = _pick_band(height, nwords, target)
+        if (band + 2 * TEMPORAL_GENS) * padded_row <= _BANDT_EXT_BUDGET:
+            return target
+    return 1 << 20
 
 
 def _vroll_combine(s0, s1, m0, m1, x):
@@ -396,7 +412,7 @@ def _bandtg_kernel(
 def _bandtrow_kernel(
     main_ref, topn_ref, botn_ref, gtop_ref, gbot_ref,
     out_ref, alive_ref, similar_ref,
-    *, band: int, nbands: int,
+    *, band: int, nbands: int, mask_edges: bool = False,
 ):
     """TEMPORAL_GENS generations per pass for one FULL-WIDTH mesh shard.
 
@@ -413,6 +429,15 @@ def _bandtrow_kernel(
     stencil: per-chip comm drops to the two N/S ghost-row blocks riding one
     ICI ring axis (the reference's E/W column messages and 4 corner
     requests, src/game_mpi.c:340-383, have no analog here at all).
+
+    ``mask_edges`` is the split-edge 2D form's main pass (``_step_tsplit``):
+    the E/W wrap rolled in across the shard seam is then WRONG — which is
+    fine, because seam corruption advances one BIT per generation, so after
+    TEMPORAL_GENS <= 8 generations only the outer 8 bits of the two edge
+    word columns are garbage; those columns are excluded from the flags
+    here and overwritten from the exact strip pass by the caller. Interior
+    word columns are exact either way (they only ever read the edge words'
+    inner-side bits).
     """
     i = pl.program_id(0)
     top_ctx = jnp.where(i == 0, gtop_ref[:], topn_ref[:])
@@ -426,30 +451,39 @@ def _bandtrow_kernel(
         m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
         return _vroll_combine(s0, s1, m0, m1, x)
 
+    bitmask = None
+    if mask_edges:
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
+        bitmask = jnp.where(
+            (lanes == 0) | (lanes == nwords - 1), jnp.uint32(0), jnp.uint32(0xFFFFFFFF)
+        )
     prev = main_ref[:]
     flags = []
     for _ in range(TEMPORAL_GENS):
         x = evolve_full(x)
         g = x[8 : band + 8]
-        alive = jnp.max(jnp.where(g != 0, 1, 0))
-        similar = 1 - jnp.max(jnp.where((g ^ prev) != 0, 1, 0))
+        live = g if bitmask is None else g & bitmask
+        diff = (g ^ prev) if bitmask is None else (g ^ prev) & bitmask
+        alive = jnp.max(jnp.where(live != 0, 1, 0))
+        similar = 1 - jnp.max(jnp.where(diff != 0, 1, 0))
         flags.append((alive, similar))
         prev = g
     out_ref[:] = prev
     _record_flags(i, flags, alive_ref, similar_ref)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "mask_edges"))
 def _step_trow(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
-               interpret: bool = False):
+               interpret: bool = False, mask_edges: bool = False):
     """Temporal pass for one full-width (h, nwords) shard from N/S ghost
     blocks only (see ``_bandtrow_kernel``)."""
     h, nwords = words.shape
-    band = _pick_band(h, nwords, _bandt_target(nwords))
+    band = _pick_band(h, nwords, _bandt_target(h, nwords))
     nb = h // _SUBLANES
     T = TEMPORAL_GENS
     new, alive, similar = pl.pallas_call(
-        functools.partial(_bandtrow_kernel, band=band, nbands=h // band),
+        functools.partial(_bandtrow_kernel, band=band, nbands=h // band,
+                          mask_edges=mask_edges),
         grid=(h // band,),
         in_specs=[
             *_banded_specs(band, nwords, nb),
@@ -499,7 +533,7 @@ def _banded_specs(band: int, nwords: int, nb: int):
 @functools.partial(jax.jit, static_argnames=("interpret", "interior"))
 def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
     height, nwords = words.shape
-    band = _pick_band(height, nwords, _bandt_target(nwords))
+    band = _pick_band(height, nwords, _bandt_target(height, nwords))
     nb = height // _SUBLANES
     T = TEMPORAL_GENS
     new, alive, similar = pl.pallas_call(
@@ -543,7 +577,7 @@ def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
     exactly expressible as BlockSpecs with no overlap tricks.
     """
     h, nwords = words.shape
-    band = _pick_band(h, nwords, _bandt_target(nwords))
+    band = _pick_band(h, nwords, _bandt_target(h, nwords))
     bb = band // _SUBLANES
     nb = h // _SUBLANES
     T = TEMPORAL_GENS
@@ -584,18 +618,185 @@ def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
     return new, alive[0], similar[0]
 
 
+def _stript_kernel(
+    main_ref, topn_ref, botn_ref, out_ref, alive_ref, similar_ref,
+    *, band: int, row_lo: int, row_hi: int,
+):
+    """TEMPORAL_GENS generations of the lane-FOLDED edge strip.
+
+    The operand is the (Lo+16, 6F) folded strip: F independent vertical
+    windows of the (h+2T, 6) edge strip ``[gwest, w0, w1, w_{n-2}, w_{n-1},
+    geast]`` laid side by side in the lane dimension (see ``_fold_strip``).
+    Evolution is the plain torus-roll network — every cross-seam roll
+    (between the two 3-lane halves, between folds, and at the global lane
+    wrap) delivers garbage ONLY to a lane side that tolerates it: seam
+    garbage advances one bit per generation from the word's far edge, and
+    each context lane (gwest, w1, w_{n-2}, geast) has >= 16 bits of slack
+    for T=8 (the same invariant the ghost-column plane relied on,
+    src/game_cuda.cu:64-74 being the corner-context trick upstream).
+
+    Flags cover exactly the shard's two edge word columns: rows in
+    [row_lo, row_hi) of the folded array (each fold's interior) and lanes
+    congruent to 1 or 4 mod 6 (w0 / w_{n-1}); the caller ORs/ANDs them with
+    the main pass's edge-masked flags.
+    """
+    i = pl.program_id(0)
+    x = jnp.concatenate([topn_ref[:], main_ref[:], botn_ref[:]], axis=0)
+    nlanes = x.shape[1]
+
+    r = jax.lax.broadcasted_iota(jnp.int32, (band, nlanes), 0) + i * band
+    c = jax.lax.broadcasted_iota(jnp.int32, (band, nlanes), 1)
+    cm = c - (c // 6) * 6
+    mask = (r >= row_lo) & (r < row_hi) & ((cm == 1) | (cm == 4))
+    bitmask = jnp.where(mask, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+
+    prev = main_ref[:]
+    flags = []
+    for _ in range(TEMPORAL_GENS):
+        left = pltpu.roll(x, 1 % nlanes, 1)
+        right = pltpu.roll(x, (nlanes - 1) % nlanes, 1)
+        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
+        x = _vroll_combine(s0, s1, m0, m1, x)
+        g = x[8 : band + 8]
+        alive = jnp.max(jnp.where((g & bitmask) != 0, 1, 0))
+        similar = 1 - jnp.max(jnp.where(((g ^ prev) & bitmask) != 0, 1, 0))
+        flags.append((alive, similar))
+        prev = g
+    out_ref[:] = prev
+    _record_flags(i, flags, alive_ref, similar_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _step_strip(folded: jnp.ndarray, interpret: bool = False):
+    """Run ``_stript_kernel`` over the folded strip, banded like every other
+    temporal pass (the folded array is small, but banding keeps its live set
+    bounded for tall shards). Returns ``(folded_T, alive_vec, similar_vec)``."""
+    rows, nlanes = folded.shape
+    # One 128-lane tile per row either way; cap at the 1MB target (tests
+    # shrink _BANDT_BYTES to force banding in both passes at small shapes).
+    band = _pick_band(rows, nlanes, min(_BANDT_BYTES, 1 << 20))
+    nb = rows // _SUBLANES
+    T = TEMPORAL_GENS
+    new, alive, similar = pl.pallas_call(
+        functools.partial(
+            _stript_kernel, band=band, row_lo=_SUBLANES, row_hi=rows - _SUBLANES
+        ),
+        grid=(rows // band,),
+        in_specs=_banded_specs(band, nlanes, nb),
+        out_specs=(
+            pl.BlockSpec((band, nlanes), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, nlanes), jnp.uint32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(folded, folded, folded)
+    return new, alive[0], similar[0]
+
+
+# Lane budget for the folded strip: 6 lanes per fold, at most one full
+# 128-lane tile (more folds than 21 would spill into a second tile and
+# double the strip pass's per-op cost for nothing).
+_MAX_FOLDS = 21
+
+
+def _fold_count(h: int) -> int:
+    """Most folds the shard height admits: the largest divisor of h/8 that
+    keeps 6*F lanes within one 128-lane tile."""
+    base = h // _SUBLANES
+    return max(f for f in range(1, min(_MAX_FOLDS, base) + 1) if base % f == 0)
+
+
+def _step_tsplit(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
+                 G_ext: jnp.ndarray, interpret: bool = False):
+    """Split-edge temporal pass for one 2D-mesh shard: rows-only main pass
+    plus a lane-folded exact edge strip.
+
+    The r3 ghost-plane form (``_step_tgb``) paid two structural taxes every
+    generation: per-row seam patches on the full-width operands (~2 selects
+    + broadcasts over (rows, nwords)) and a whole adder-network pass over a
+     2-lane ghost plane that still costs a full 128-lane vector op per row
+    tile — together the measured 0.64-0.96x of single-chip
+    (benchmarks/compare_{16384,32768}_r3.json). This form deletes both:
+
+    - MAIN: the shard runs the unmodified rows-only kernel (pure torus
+      rolls, zero patches). Seam corruption moves one bit per generation,
+      so after T <= 8 generations only the outer 8 bits of word columns 0
+      and nwords-1 are wrong; interior columns are exact.
+    - STRIP: the six seam-relevant word columns [gwest, w0, w1, w_{n-2},
+      w_{n-1}, geast] evolve exactly in a separate narrow pass whose row
+      dimension is FOLDED into lanes (F vertical windows side by side, 6F
+      <= 126 lanes = one tile), cutting the narrow-array tile tax by F
+      (~16x for power-of-two heights).
+    - STITCH: once per T generations the strip's exact w0/w_{n-1} columns
+      overwrite the main output's edge lanes; per-generation flags OR/AND
+      across the two passes (main's flags exclude the edge columns).
+
+    Needs nwords >= 2 (at nwords == 1 the strip's lane adjacency cannot
+    express the torus; that single-word case keeps ``_step_tgb``). At
+    nwords == 2 the strip duplicates both shard columns and the main pass
+    contributes nothing — wasteful but exact (pinned by the dryrun's
+    packed-interp lane).
+    """
+    h, nwords = words.shape
+    T = TEMPORAL_GENS
+
+    new_main, alive_m, similar_m = _step_trow(
+        words, gtop, gbot, interpret=interpret, mask_edges=True
+    )
+
+    # The (h+2T, 6) edge strip over extended rows, then its lane folding.
+    idx = [0, 1, nwords - 2, nwords - 1]
+    ext4 = jnp.concatenate(
+        [gtop[:, idx], words[:, idx], gbot[:, idx]], axis=0
+    )  # (h+16, 4)
+    strip = jnp.concatenate(
+        [G_ext[:, 0:1], ext4[:, 0:2], ext4[:, 2:4], G_ext[:, 1:2]], axis=1
+    )  # (h+16, 6)
+    F = _fold_count(h)
+    Lo = h // F
+    folded = jnp.concatenate(
+        [
+            jax.lax.slice_in_dim(strip, k * Lo, k * Lo + Lo + 2 * T, axis=0)
+            for k in range(F)
+        ],
+        axis=1,
+    )  # (Lo+16, 6F)
+    folded_T, alive_s, similar_s = _step_strip(folded, interpret=interpret)
+
+    # Unfold the exact edge columns: rows [8, Lo+8) of fold k are shard rows
+    # [k*Lo, (k+1)*Lo); lanes 1/4 mod 6 are w0/w_{n-1}.
+    out_rows = folded_T[T : Lo + T]
+    w0_col = out_rows[:, 1::6].T.reshape(h)
+    wn_col = out_rows[:, 4::6].T.reshape(h)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (h, nwords), 1)
+    new = jnp.where(lanes == 0, w0_col[:, None], new_main)
+    new = jnp.where(lanes == nwords - 1, wn_col[:, None], new)
+
+    alive = jnp.maximum(alive_m, alive_s)
+    similar = jnp.minimum(similar_m, similar_s)
+    return new, alive, similar
+
+
 # Width cap for the temporal kernel: its live set spans (band+16)-row
 # planes, so at very wide rows even the minimum band exceeds scoped VMEM.
-# At the 8192-word cap (width 2^18) the _bandt_target 1MB/32-row bands
-# compile and match the jnp network on v5e at (1024, 8192) — the 2MB
-# target's 64-row bands blow the 16MB scoped-VMEM stack by 1.73M there,
-# and 16384 words fails at Mosaic compile under either target. Treat
-# compile-at-cap as the empirical gate and re-probe (1024, cap) when
-# raising _MAX_WORDS_T, the band targets, or the network's live set.
-# Wider falls back to the single-gen kernel. The cap matters doubly since
-# the row-only (n, 1) default mesh: it bounds the widest grid whose
-# full-width shards keep the temporal kernel (choose_mesh_shape adds mesh
-# columns past it).
+# At the 8192-word cap (width 2^18) the width-continuous _bandt_target
+# picks 32-row bands, which compile and match the jnp network on v5e at
+# (1024, 8192); 16384 words fails at Mosaic compile under every target.
+# Between 2048 and 8192 words the compile boundary was mapped by
+# tools/probe_vmem_r4.py (benchmarks/vmem_probe_r4.json) and encoded as
+# _BANDT_EXT_BUDGET; re-run the probe when raising _MAX_WORDS_T, the band
+# targets, or the network's live set. Wider falls back to the single-gen
+# kernel. The cap matters doubly since the row-only (n, 1) default mesh:
+# it bounds the widest grid whose full-width shards keep the temporal
+# kernel (choose_mesh_shape adds mesh columns past it).
 _MAX_WORDS_T = 8 << 10
 
 
@@ -699,6 +900,12 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
         )
         return _step_trow(words, gtop, gbot, interpret=interpret)
     gtop, gbot, G_ext = deep_ghost_operands(words, topology)
+    if nwords >= 2:
+        # The split-edge form: rows-only main pass + lane-folded exact edge
+        # strip (see _step_tsplit) — replaces the r3 ghost-plane form whose
+        # per-generation patches + 2-lane adder pass cost 0.64-0.96x of
+        # single-chip on any R x C mesh with mesh columns.
+        return _step_tsplit(words, gtop, gbot, G_ext, interpret=interpret)
     return _step_tgb(words, gtop, gbot, G_ext, interpret=interpret)
 
 

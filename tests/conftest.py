@@ -22,6 +22,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 if not os.environ.get("GOL_TPU_HW"):
+    # Align the ENV VAR with the forced platform, not just jax.config: the
+    # CLI re-applies JAX_PLATFORMS from the environment at import time
+    # (gol_tpu/platform_env.py), so a stale accelerator value there would
+    # override this suite's CPU forcing the moment a test imports cli.
+    os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
 # else: hardware lane — leave the attached backend alone so
 # tests/test_tpu_hw.py runs on the real chip:
@@ -36,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Hardware-lane evidence artifact: GOL_TPU_HW=1 runs record every hardware
 # test's outcome to benchmarks/tpu_hw_r<N>.json so the "verified on v5e"
 # claims in kernel comments are auditable files, not git-log prose.
-_HW_ARTIFACT_ROUND = 3
+_HW_ARTIFACT_ROUND = 4
 _hw_results: list[dict] = []
 
 

@@ -474,3 +474,153 @@ def test_banded_kernel_under_real_mesh():
     expect8 = oracle.run(g8, cfg8)
     np.testing.assert_array_equal(got8.grid, expect8.grid)
     assert got8.generations == expect8.generations
+
+
+# ---------------------------------------------------------------------------
+# The split-edge 2D mesh form (_step_tsplit): rows-only main pass with
+# edge-masked flags + lane-folded exact edge strip + stitch. Replaces the
+# ghost-plane form for nwords >= 2 shards (r4; VERDICT r3 item 1).
+
+
+def test_fold_count():
+    # Largest divisor of h/8 with 6F lanes within one 128-lane tile.
+    assert sp._fold_count(16384) == 16    # 2048 -> 16 (powers of two cap at 16)
+    assert sp._fold_count(32768) == 16
+    assert sp._fold_count(16) == 2
+    assert sp._fold_count(24) == 3
+    assert sp._fold_count(1344) == 21     # 168 = 8*21 -> the full tile
+    assert sp._fold_count(344) == 1       # 43 prime > 21: no folding
+    assert sp._MAX_FOLDS * 6 <= 128
+
+
+@pytest.mark.parametrize("shape", [(16, 64), (16, 96), (32, 96), (16, 128 * 32)])
+def test_split_edge_temporal_kernel_interpret(shape):
+    """State and per-generation flags must match the oracle exactly (local
+    torus wrap = 1x1 topology), including the nwords == 2 degenerate strip
+    (duplicated columns, main pass fully overwritten) and nwords == 3
+    (w1 == w_{n-2})."""
+    h, w = shape
+    rng = np.random.default_rng(67)
+    g = rng.integers(0, 2, size=shape, dtype=np.uint8)
+    T = sp.TEMPORAL_GENS
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    got = np.asarray(sp.decode(new))
+    states = [g]
+    for _ in range(T):
+        states.append(oracle.evolve(states[-1]))
+    np.testing.assert_array_equal(got, states[-1])
+    for t in range(T):
+        assert int(alive[t]) == int(states[t + 1].any()), t
+        assert int(similar[t]) == int(np.array_equal(states[t + 1], states[t])), t
+
+
+def test_split_edge_strip_owns_edge_words():
+    # All life confined to the two edge word columns: the main pass sees
+    # nothing (its flags exclude those lanes), so the strip pass alone must
+    # produce both the exact state and the per-generation flags.
+    h, nwords = 16, 128
+    g = np.zeros((h, nwords * 32), np.uint8)
+    g[7:10, 1] = 1    # blinker in word 0, feeding across the wrap seam
+    g[3:5, nwords * 32 - 2 : nwords * 32] = 1  # block (still life) east edge
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    expect = g
+    for _ in range(sp.TEMPORAL_GENS):
+        expect = oracle.evolve(expect)
+    np.testing.assert_array_equal(np.asarray(sp.decode(new)), expect)
+    assert all(int(a) == 1 for a in alive)
+    # Blinker keeps flipping: never similar.
+    assert all(int(s) == 0 for s in similar)
+
+
+def test_split_edge_still_life_similarity():
+    # A block fully inside the west edge word: similar must be 1 every
+    # generation — the strip's similarity plane is exact, and the main
+    # pass's masked flags stay neutral (similar=1) rather than poisoning
+    # the AND.
+    h, nwords = 16, 8
+    g = np.zeros((h, nwords * 32), np.uint8)
+    g[6:8, 2:4] = 1
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    np.testing.assert_array_equal(np.asarray(sp.decode(new)), g)
+    assert all(int(a) == 1 for a in alive)
+    assert all(int(s) == 1 for s in similar)
+
+
+def test_split_edge_multi_band_and_folds(monkeypatch):
+    """Banding in BOTH passes (main bands + strip bands) and h with a
+    non-power-of-two fold count; the unjitted entries re-read the patched
+    band constant."""
+    h, w = 48, 160  # base h/8 = 6 -> F = 6; 5-word strip indices distinct
+    rng = np.random.default_rng(71)
+    g = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
+    T = sp.TEMPORAL_GENS
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
+    monkeypatch.setattr(sp, "_BANDT_BYTES", 8 << 10)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    got = np.asarray(sp.decode(new))
+    states = [g]
+    for _ in range(T):
+        states.append(oracle.evolve(states[-1]))
+    np.testing.assert_array_equal(got, states[-1])
+    for t in range(T):
+        assert int(alive[t]) == int(states[t + 1].any()), t
+        assert int(similar[t]) == int(np.array_equal(states[t + 1], states[t])), t
+
+
+def test_split_edge_routing(monkeypatch):
+    """cols > 1 topologies with nwords >= 2 route _distributed_step_multi
+    through the split-edge form; single-word shards keep the ghost-plane
+    form (_step_tgb)."""
+    calls = []
+    real = sp._step_tsplit
+
+    def spy(words, gtop, gbot, G_ext, interpret=False):
+        calls.append(words.shape)
+        return real(words, gtop, gbot, G_ext, interpret=interpret)
+
+    monkeypatch.setattr(sp, "_step_tsplit", spy)
+    rng = np.random.default_rng(73)
+    g = rng.integers(0, 2, size=(16, 128), dtype=np.uint8)
+    words = sp.encode(jnp.asarray(g))
+    from gol_tpu.parallel.mesh import PROXY_2D
+
+    new, alive, _ = sp._distributed_step_multi(words, PROXY_2D, force_interp=True)
+    assert calls == [(16, 4)]
+    expect = g
+    for _ in range(sp.TEMPORAL_GENS):
+        expect = oracle.evolve(expect)
+    np.testing.assert_array_equal(np.asarray(sp.decode(new)), expect)
+
+    # Single-word shards: still the ghost-plane form.
+    calls.clear()
+    g1 = rng.integers(0, 2, size=(16, 32), dtype=np.uint8)
+    sp._distributed_step_multi(sp.encode(jnp.asarray(g1)), PROXY_2D,
+                               force_interp=True)
+    assert calls == []
+
+
+def test_bandt_target_width_continuous():
+    """The temporal band target shrinks BEFORE the width cap (advisor r3
+    medium): every chosen band keeps the padded extended block within the
+    probed compile budget, and the measured-fast configs are preserved."""
+    for nwords in [64, 512, 2048, 4096, 5120, 7168, 7680, 8184, 8192]:
+        target = sp._bandt_target(1024, nwords)
+        band = sp._pick_band(1024, nwords, target)
+        padded = max(-(-nwords // 128) * 128, 128) * 4
+        assert (band + 16) * padded <= sp._BANDT_EXT_BUDGET, nwords
+    # The measured-fast configs survive: 65536^2 single chip (2048 words,
+    # 256-row bands) and 16384^2 (512 words, 1024-row bands).
+    assert sp._bandt_target(65536, 2048) == sp._BANDT_BYTES
+    assert sp._pick_band(65536, 2048, sp._bandt_target(65536, 2048)) == 256
+    assert sp._pick_band(16384, 512, sp._bandt_target(16384, 512)) == 1024
+    # Near-cap widths drop the target before the cap, not at it: 7680 words
+    # at the 2MB target Mosaic-OOMed on v5e (benchmarks/vmem_probe_r4.json).
+    assert sp._bandt_target(1024, 7680) < sp._BANDT_BYTES
+    assert sp._bandt_target(1024, 8184) < sp._BANDT_BYTES

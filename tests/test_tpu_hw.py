@@ -155,3 +155,37 @@ def test_engine_end_to_end_vs_oracle(convention):
     want = oracle.run(g, cfg)
     assert got.generations == want.generations
     assert np.array_equal(got.grid, want.grid)
+
+
+def test_temporal_near_cap_widths_compile_and_match():
+    # The advisor's just-under-cap probes: 7680 words (where the r3 rule's
+    # 2MB target Mosaic-OOMed, benchmarks/vmem_probe_r4.json) and 8184 (a
+    # non-tile-multiple row). The width-continuous _bandt_target must pick
+    # compiling bands for every temporal form, and results must match the
+    # jnp network.
+    for nwords in (7680, 8184):
+        words = _random_words(64, nwords, seed=8)
+        cur = words
+        for _ in range(sp.TEMPORAL_GENS):
+            cur = packed_math.evolve_torus_words(cur)
+        assert np.array_equal(np.asarray(sp._step_t(words)[0]), np.asarray(cur)), nwords
+        rows = sp._distributed_step_multi(words, SINGLE_DEVICE)[0]
+        assert np.array_equal(np.asarray(rows), np.asarray(cur)), nwords
+        two_d = sp._distributed_step_multi(words, PROXY_2D)[0]
+        assert np.array_equal(np.asarray(two_d), np.asarray(cur)), nwords
+
+
+def test_split_edge_form_compiled_matches():
+    # The r4 split-edge 2D form compiled on the chip (not interpret mode):
+    # random soup exercises main-pass torus rolls, the lane-folded strip,
+    # the stitch, and the combined flags.
+    rng = np.random.default_rng(13)
+    g = rng.integers(0, 2, size=(512, 4096), dtype=np.uint8)
+    words = sp.encode(jnp.asarray(g))
+    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext)
+    cur = words
+    for _ in range(sp.TEMPORAL_GENS):
+        cur = packed_math.evolve_torus_words(cur)
+    assert np.array_equal(np.asarray(new), np.asarray(cur))
+    assert np.asarray(alive).tolist() == [1] * sp.TEMPORAL_GENS

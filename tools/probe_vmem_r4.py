@@ -1,0 +1,142 @@
+"""Map the temporal kernels' Mosaic-compile boundary near the width cap.
+
+The advisor flagged that ``_bandt_target`` only drops to the 1MB band target
+at exactly ``nwords >= _MAX_WORDS_T``, while the scoped-VMEM live set it
+guards against grows continuously with width — so near-cap widths (roughly
+7200-8191 words) under the 2MB target were suspected to Mosaic-OOM. This
+probe compiles every temporal form at a ladder of widths x band targets on
+the real chip and records pass/fail plus the verbatim error text (the error
+strings also pin ``engine._is_compile_failure`` — see
+tests/test_engine.py::test_compile_failure_real_error_text).
+
+    python tools/probe_vmem_r4.py          # full matrix -> benchmarks/vmem_probe_r4.json
+
+Compile-only (``.lower().compile()``): no data upload, each probe costs one
+remote compile (~20-40s cold).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.ops import stencil_packed as sp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "vmem_probe_r4.json")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _compile(form: str, height: int, nwords: int, target: int):
+    """Lower+compile one temporal form at an explicit band target.
+
+    Patches ``sp._bandt_target`` (the selection under probe) and clears the
+    step functions' jit caches so every probe re-traces with its own target.
+    """
+    band = sp._pick_band(height, nwords, target)
+    words = jax.ShapeDtypeStruct((height, nwords), jnp.uint32)
+    g8 = jax.ShapeDtypeStruct((sp.TEMPORAL_GENS, nwords), jnp.uint32)
+    gext = jax.ShapeDtypeStruct((height + 2 * sp.TEMPORAL_GENS, 2), jnp.uint32)
+
+    orig = sp._bandt_target
+    sp._bandt_target = lambda *a, **k: target
+    try:
+        if form == "t":  # single-device torus (_bandt_kernel)
+            sp._step_t.clear_cache()
+            sp._step_t.lower(words).compile()
+        elif form == "trow":  # rows-only mesh shard (_bandtrow_kernel)
+            sp._step_trow.clear_cache()
+            sp._step_trow.lower(words, g8, g8).compile()
+        elif form == "tgb":  # 2D mesh shard w/ ghost plane (_bandtg_kernel)
+            sp._step_tgb.clear_cache()
+            sp._step_tgb.lower(words, g8, g8, gext).compile()
+        else:
+            raise ValueError(form)
+    finally:
+        sp._bandt_target = orig
+    return band
+
+
+def main() -> None:
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    height = 1024
+    results = []
+    error_samples = {}
+    # Widths from the proven-safe 2048 words (65536^2 single chip) up to the
+    # cap, plus the advisor's named 8184; targets 2MB (current wide default),
+    # 1.5MB, 1MB (current at-cap value).
+    widths = [2048, 3072, 4096, 5120, 6144, 7168, 7680, 8184, 8192]
+    targets = [2 << 20, 3 << 19, 1 << 20]
+    for form in ("t", "trow", "tgb"):
+        for nwords in widths:
+            for target in targets:
+                t0 = time.time()
+                try:
+                    band = _compile(form, height, nwords, target)
+                    ok, err_type, err_text = True, None, None
+                    log(f"{form} {nwords}w target={target>>20}MB band={band}: OK "
+                        f"({time.time()-t0:.0f}s)")
+                except Exception as e:  # noqa: BLE001 - recording, not handling
+                    ok = False
+                    err_type = f"{type(e).__module__}.{type(e).__name__}"
+                    err_text = str(e)
+                    band = sp._pick_band(height, nwords, target)
+                    log(f"{form} {nwords}w target={target>>20}MB band={band}: "
+                        f"FAIL {err_type}: {err_text[:120]} ({time.time()-t0:.0f}s)")
+                    error_samples.setdefault(err_type, err_text[:4000])
+                results.append({
+                    "form": form, "height": height, "nwords": nwords,
+                    "target_bytes": target, "band": band, "ok": ok,
+                    "err_type": err_type,
+                    "err_head": err_text[:300] if err_text else None,
+                    "secs": round(time.time() - t0, 1),
+                })
+                _dump(results, error_samples)
+
+    # One guaranteed-huge failure for error-text capture: double the cap.
+    for form, nwords in (("t", 16384),):
+        try:
+            _compile(form, height, nwords, 1 << 20)
+            log(f"{form} {nwords}w: unexpectedly OK")
+        except Exception as e:  # noqa: BLE001
+            err_type = f"{type(e).__module__}.{type(e).__name__}"
+            error_samples.setdefault(err_type, str(e)[:4000])
+            log(f"{form} {nwords}w: FAIL {err_type} (captured)")
+
+    # An HBM RESOURCE_EXHAUSTED for the other error family: ~32GB on a 16GB
+    # chip, at execute time.
+    try:
+        jnp.zeros((2 << 30, 16), jnp.uint8).block_until_ready()
+        log("HBM probe: unexpectedly OK")
+    except Exception as e:  # noqa: BLE001
+        err_type = f"{type(e).__module__}.{type(e).__name__}"
+        error_samples.setdefault("hbm:" + err_type, str(e)[:4000])
+        log(f"HBM probe: FAIL {err_type} (captured)")
+    _dump(results, error_samples)
+    log("wrote", OUT)
+
+
+def _dump(results, error_samples):
+    with open(OUT, "w") as f:
+        json.dump({
+            "purpose": "near-cap Mosaic compile boundary, r4 (advisor medium)",
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "probes": results,
+            "error_samples": error_samples,
+        }, f, indent=1)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()
