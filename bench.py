@@ -23,8 +23,13 @@ import numpy as np
 
 
 def _honor_platform_env() -> None:
-    """Re-apply JAX_PLATFORMS if a site hook consumed it (shared helper)."""
-    from gol_tpu.cli import honor_platform_env
+    """Re-apply JAX_PLATFORMS if a site hook consumed it (shared helper).
+
+    Imported from the dependency-free platform_env module, NOT via
+    gol_tpu.cli — pulling cli here would load every jax-importing module
+    before the re-application, the ordering hazard the helper exists to
+    prevent."""
+    from gol_tpu.platform_env import honor_platform_env
 
     honor_platform_env()
 

@@ -36,23 +36,12 @@ import time
 
 import numpy as np
 
+from gol_tpu.platform_env import honor_platform_env
 
-def honor_platform_env() -> None:
-    """Re-apply JAX_PLATFORMS if a site hook imported jax before it took.
-
-    Some environments preload jax at interpreter start (sitecustomize),
-    consuming JAX_PLATFORMS before the user's value is seen; backends
-    initialize lazily, so re-applying via jax.config works until first
-    device use. Without this, ``JAX_PLATFORMS=cpu gol ... --mesh 4x1`` on
-    an 8-virtual-CPU host still lands on the accelerator backend and fails
-    device-count validation. Shared by every entry point (``gol`` console
-    script, ``python -m gol_tpu``, bench.py).
-    """
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        import jax
-
-        jax.config.update("jax_platforms", platforms)
+# Applied at import time, before the jax-importing gol_tpu modules below
+# load — main() calls it again (idempotent), but the import-time call is
+# what guarantees no transitive module-level device touch can precede it.
+honor_platform_env()
 
 from gol_tpu import engine, oracle
 from gol_tpu.config import DEFAULT_HEIGHT, DEFAULT_WIDTH, GameConfig
@@ -68,7 +57,12 @@ def atoi(s: str | None) -> int:
     return int(m.group(1)) if m else 0
 
 
-def _parse_mesh_arg(spec: str | None, distributed: bool, width: int | None = None):
+def _parse_mesh_arg(
+    spec: str | None,
+    distributed: bool,
+    width: int | None = None,
+    height: int | None = None,
+):
     import jax
 
     from gol_tpu.parallel.mesh import make_mesh
@@ -85,9 +79,9 @@ def _parse_mesh_arg(spec: str | None, distributed: bool, width: int | None = Non
         if not m:
             raise ValueError(f"--mesh must look like RxC, got {spec!r}")
         return make_mesh(int(m.group(1)), int(m.group(2)))
-    # Default factorization: row-only, unless the grid width would push the
-    # full-width shard past the temporal kernel's VMEM cap.
-    return make_mesh(devices=jax.devices(), width=width)
+    # Default factorization: row-heaviest that divides the grid, unless the
+    # width would push full-width shards past the temporal kernel's VMEM cap.
+    return make_mesh(devices=jax.devices(), width=width, height=height)
 
 
 def _warn_if_huge_byte_lane(width: int, height: int, mesh=None) -> bool:
@@ -212,7 +206,7 @@ def _run(args) -> int:
         from gol_tpu.parallel import bootstrap
 
         bootstrap.initialize()
-    mesh = _parse_mesh_arg(args.mesh, variant.distributed, width)
+    mesh = _parse_mesh_arg(args.mesh, variant.distributed, width, height)
     from gol_tpu.parallel.mesh import topology_for, validate_grid
 
     if mesh is not None and not topology_for(mesh).distributed:
@@ -538,10 +532,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--mesh", default=None,
-        help="device mesh RxC (default: all devices as Nx1 row-only — the "
-        "measured-fastest layout; mesh columns are added automatically "
-        "only when the grid width would exceed the fast kernel's "
-        "per-shard VMEM cap)")
+        help="device mesh RxC (default: the row-heaviest factorization that "
+        "divides the grid — row-only when possible, the measured-fastest "
+        "layout; mesh columns are added automatically when the height "
+        "doesn't divide row-only or the grid width would exceed the fast "
+        "kernel's per-shard VMEM cap)")
     run.add_argument(
         "--kernel",
         default="auto",

@@ -57,45 +57,70 @@ MESH_TOPOLOGY_AXES = (ROW_AXIS, COL_AXIS)
 PROXY_2D = Topology(shape=(1, 2), axes=())
 
 
-def choose_mesh_shape(n_devices: int, width: int | None = None) -> tuple[int, int]:
-    """Pick the default R x C factorization of ``n_devices``: ``(n, 1)``.
+def choose_mesh_shape(
+    n_devices: int, width: int | None = None, height: int | None = None
+) -> tuple[int, int]:
+    """Pick the default R x C factorization of ``n_devices``: row-heaviest.
 
     The reference only accepts perfect squares (``sqrt(comm_sz)`` truncation,
     src/game_mpi_collective.c:125) because a near-square factorization
     minimizes the O(perimeter) halo bytes. On TPU that objective is the
     wrong one: halo bytes cost microseconds on ICI either way, while the
     COLUMN-direction ghost machinery costs real per-generation compute in
-    the packed kernel (the ghost-column plane's adder pass + per-row edge
-    patches). A row-only R x 1 decomposition needs none of it — full-width
-    shards wrap E/W through their own lane roll — and measured 94.6-102%
-    of the single-chip rate on v5e vs 64-83% for the 2D form
-    (benchmarks/compare_{16384,32768}_r3.json), so it is the default.
+    the packed kernel. A row-only R x 1 decomposition needs none of it —
+    full-width shards wrap E/W through their own lane roll. On the r3
+    measurement protocol the pod-shard ratio to single-chip spanned
+    0.79-1.61 across six runs (benchmarks/pod_shard_r3.json; the tunnel's
+    drift dominates — see benchmarks/README.md for the r4 protocol and
+    series) while the 2D ghost-plane form measured 0.64-0.96
+    (compare_{16384,32768}_r3.json), so row-heavy is the default.
 
-    ``width`` (the grid width, when the caller knows it) guards the one
-    case where full-width shards backfire: the temporal kernel's VMEM
-    width cap. Past it the R x 1 shard would silently fall to the ~2x
-    slower per-generation kernel, so just enough mesh columns are added
-    to bring the shard width back under the cap. Note an R x 1 default
-    also requires height % n == 0 (validate_grid errors loudly otherwise,
-    as for any explicit mesh); ``make_mesh(rows, cols)`` still builds any
-    R x C mesh.
+    ``width``/``height`` (the grid shape, when the caller knows it) refine
+    the choice:
+
+    - a factorization whose rows divide ``height`` (and cols divide
+      ``width``) is preferred over one validate_grid would reject — e.g.
+      100 rows on 8 devices picks (4, 2), since (8, 1) cannot shard it;
+    - the temporal kernel's VMEM width cap: past it an R x 1 shard would
+      silently fall to the ~2x slower per-generation kernel, so just
+      enough mesh columns are added to bring the shard width back under
+      the cap. When NO factorization can (or none that divides the grid),
+      the choice falls back row-heavy and warns on stderr that the
+      temporal kernel is disengaged — pick an explicit ``--mesh`` to
+      trade the layout by hand.
     """
-    if width is not None:
-        # Late import: ops imports this module at load time.
-        from gol_tpu.ops.stencil_packed import _BITS, _MAX_WORDS_T
+    # Late import: ops imports this module at load time.
+    from gol_tpu.ops.stencil_packed import _BITS, _MAX_WORDS_T
 
-        cols = 1
-        while (
-            cols < n_devices
-            and n_devices % cols == 0
-            and width // (_BITS * cols) > _MAX_WORDS_T
-        ):
-            cols += 1
-            while n_devices % cols and cols < n_devices:
-                cols += 1
-        if n_devices % cols == 0 and width // (_BITS * cols) <= _MAX_WORDS_T:
-            return n_devices // cols, cols
-    return n_devices, 1
+    def divides_grid(r: int, c: int) -> bool:
+        if height is not None and height % r:
+            return False
+        return not (width is not None and width % c)
+
+    def under_cap(c: int) -> bool:
+        return width is None or width // (_BITS * c) <= _MAX_WORDS_T
+
+    # Row-heaviest first: cols ascending.
+    candidates = [
+        (n_devices // c, c) for c in range(1, n_devices + 1) if n_devices % c == 0
+    ]
+    # Nothing divides the grid -> keep (n, 1) and let validate_grid raise
+    # its loud divisibility error for the default mesh too.
+    pool = [rc for rc in candidates if divides_grid(*rc)] or candidates
+    for r, c in pool:
+        if under_cap(c):
+            return r, c
+    r, c = pool[0]
+    import sys
+
+    sys.stderr.write(
+        f"gol_tpu: no {n_devices}-device mesh factorization keeps shards "
+        f"within the temporal kernel's width cap ({_MAX_WORDS_T * _BITS} "
+        f"cells) for a width-{width} grid; defaulting to {r}x{c} on the "
+        "~2x slower per-generation kernel — pass an explicit --mesh to "
+        "choose the trade yourself\n"
+    )
+    return r, c
 
 
 def make_mesh(
@@ -103,14 +128,15 @@ def make_mesh(
     cols: int | None = None,
     devices=None,
     width: int | None = None,
+    height: int | None = None,
 ) -> Mesh:
-    """Build the 2D ('row', 'col') device mesh. ``width`` only informs the
-    default factorization (see ``choose_mesh_shape``)."""
+    """Build the 2D ('row', 'col') device mesh. ``width``/``height`` only
+    inform the default factorization (see ``choose_mesh_shape``)."""
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     if rows is None and cols is None:
-        rows, cols = choose_mesh_shape(n, width)
+        rows, cols = choose_mesh_shape(n, width, height)
     elif rows is None:
         if cols <= 0 or n % cols:
             raise ValueError(f"cannot infer mesh rows: {n} devices not divisible by cols={cols}")

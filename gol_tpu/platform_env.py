@@ -1,0 +1,30 @@
+"""Re-apply JAX_PLATFORMS before any jax-importing module loads.
+
+Kept deliberately free of jax-importing dependencies: some environments
+preload jax at interpreter start (sitecustomize), consuming JAX_PLATFORMS
+before the user's value is seen. Backends initialize lazily, so re-applying
+via jax.config works — but only if it happens before anything touches a
+device. Entry points (``gol`` console script, ``python -m gol_tpu``,
+bench.py) call this FIRST, above their gol_tpu imports, so no future
+module-level device touch in a transitively imported module can order
+itself ahead of the re-application (the hazard the round-3 advisor flagged
+in the def-sandwiched-in-imports layout this module replaces).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    """Idempotent: safe to call from every entry point, any number of times.
+
+    Without this, ``JAX_PLATFORMS=cpu gol ... --mesh 4x1`` on an
+    8-virtual-CPU host still lands on the accelerator backend and fails
+    device-count validation.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)

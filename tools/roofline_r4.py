@@ -1,0 +1,113 @@
+"""Roofline measurements for benchmarks/roofline_r4.md (VERDICT r3 item 7).
+
+Two experiments on the single-chip temporal kernel at 16384^2 and 65536^2:
+
+1. FLAG COST A/B — the per-generation alive/similar flag computation
+   (2 selects + 2 max-reduces + 1 xor over every band) is the only part of
+   the per-word op budget not in the adder network itself. A variant kernel
+   with the flag math deleted (returns constant flags — NOT a usable
+   engine kernel, measurement only) bounds how much of the budget flags
+   consume.
+2. T=8 GHOST OVERFETCH — rates at two band sizes quantify the
+   (band+16)/band overfetch share, pinning the HBM column of the roofline.
+
+    python tools/roofline_r4.py   # -> benchmarks/roofline_flags_r4.json
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gol_tpu.ops import packed_math
+from gol_tpu.ops import stencil_packed as sp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "roofline_flags_r4.json")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _force(x):
+    int(np.asarray(x[0, 0]))
+
+
+def _bandt_noflags_kernel(main_ref, top_ref, bot_ref, out_ref, *, band):
+    """_bandt_kernel with the flag math deleted (measurement-only)."""
+    x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
+    nwords = x.shape[1]
+    for _ in range(sp.TEMPORAL_GENS):
+        left = pltpu.roll(x, 1 % nwords, 1)
+        right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
+        x = sp._vroll_combine(s0, s1, m0, m1, x)
+    out_ref[:] = x[8 : band + 8]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _step_t_noflags(words):
+    height, nwords = words.shape
+    band = sp._pick_band(height, nwords, sp._bandt_target(height, nwords))
+    nb = height // sp._SUBLANES
+    return pl.pallas_call(
+        functools.partial(_bandt_noflags_kernel, band=band),
+        grid=(height // band,),
+        in_specs=sp._banded_specs(band, nwords, nb),
+        out_specs=pl.BlockSpec((band, nwords), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((height, nwords), jnp.uint32),
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+    )(words, words, words)
+
+
+def _rate(step, words, n1, n2, size):
+    fn = jax.jit(lambda w, n: jax.lax.fori_loop(0, n, lambda i, x: step(x), w),
+                 static_argnums=1)
+    _force(fn(words, 2))
+    t0 = time.perf_counter(); _force(fn(words, n1)); ta = time.perf_counter() - t0
+    t0 = time.perf_counter(); _force(fn(words, n2)); tb = time.perf_counter() - t0
+    return size * size * sp.TEMPORAL_GENS / ((tb - ta) / (n2 - n1))
+
+
+def main() -> None:
+    assert jax.default_backend() == "tpu"
+    results = {}
+    for size, (n1, n2) in ((16384, (50, 250)), (65536, (10, 40))):
+        rng = np.random.default_rng(42)
+        grid = rng.integers(0, 2, size=(size, size), dtype=np.uint8)
+        words = jnp.asarray(
+            np.packbits(grid, axis=1, bitorder="little").view(np.uint32))
+        flags, noflags = [], []
+        for rep in range(3):
+            flags.append(_rate(lambda w: sp._step_t(w)[0], words, n1, n2, size))
+            noflags.append(_rate(_step_t_noflags, words, n1, n2, size))
+            log(f"{size}: rep {rep} flags={flags[-1]/1e12:.3f}T "
+                f"noflags={noflags[-1]/1e12:.3f}T")
+        fm = sorted(flags)[1]
+        nm = sorted(noflags)[1]
+        results[str(size)] = {
+            "with_flags_cells_per_s": [round(r) for r in flags],
+            "no_flags_cells_per_s": [round(r) for r in noflags],
+            "flag_overhead_fraction": round(nm / fm - 1, 4),
+        }
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+            f.write("\n")
+    log("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Write-pipeline loopback: ``write_packed`` with D2H removed (VERDICT r3 #3).
+
+The config-5 write phase on the attach tunnel is bounded by the tunnel's raw
+D2H floor (benchmarks/d2h_probe_r3.json), which leaves open whether the
+fetch -> codec-unpack -> memmap chain itself would saturate a real
+PCIe-attached chip. This measures exactly that chain with the transfer taken
+out of the equation: the word state lives on the CPU backend (fetch is a
+memcpy), the file lands on tmpfs (no disk writeback in the loop), so the
+remaining cost IS the pipeline — chunking, prefetch bookkeeping, the SWAR
+codec, and the memmap stores.
+
+    JAX_PLATFORMS=cpu python tools/write_loopback_r4.py [size=32768]
+
+Writes benchmarks/write_loopback_r4.json: text-emit GB/s per run plus the
+bare codec unpack rate for comparison (how much the pipeline machinery
+costs over the codec itself). The read direction (pack) is probed the same
+way for completeness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from gol_tpu import native
+from gol_tpu.io import packed_io
+from gol_tpu.io.text_grid import row_stride
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "write_loopback_r4.json")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    size = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    repeats = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    rng = np.random.default_rng(7)
+    host_words = rng.integers(
+        0, np.iinfo(np.uint32).max, size=(size, size // 32),
+        dtype=np.uint32, endpoint=True,
+    )
+    words = jax.numpy.asarray(host_words)
+    text_bytes = size * row_stride(size)
+    tmpdir = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    log(f"loopback {size}x{size}: {text_bytes / 1e9:.2f} GB of text -> {tmpdir}")
+
+    runs = []
+    path = os.path.join(tmpdir, "gol_write_loopback.out")
+    try:
+        for i in range(repeats):
+            t0 = time.perf_counter()
+            packed_io.write_packed(path, words, size)
+            dt = time.perf_counter() - t0
+            runs.append(text_bytes / dt / 1e9)
+            log(f"  write run {i}: {dt * 1000:.0f} ms = {runs[-1]:.2f} GB/s text")
+
+        # Bare codec rate (single thread, no pipeline): one representative
+        # 64MB-word block unpacked straight into a tmpfs memmap window.
+        rows = max(1, (64 << 20) // (size // 32 * 4))
+        block = np.ascontiguousarray(host_words[:rows])
+        window = np.memmap(path, dtype=np.uint8, mode="r+",
+                           shape=(rows, row_stride(size)))
+        codec_runs = []
+        for i in range(repeats):
+            t0 = time.perf_counter()
+            native.unpack_text(block, window, size, True)
+            dt = time.perf_counter() - t0
+            codec_runs.append(rows * row_stride(size) / dt / 1e9)
+        del window
+        log(f"  bare codec unpack: {max(codec_runs):.2f} GB/s/thread")
+
+        # Read direction for completeness: text file -> packed device array.
+        read_runs = []
+        for i in range(repeats):
+            t0 = time.perf_counter()
+            got = packed_io.read_packed(path, size, size)
+            got.block_until_ready()
+            dt = time.perf_counter() - t0
+            read_runs.append(text_bytes / dt / 1e9)
+            del got
+            log(f"  read run {i}: {dt * 1000:.0f} ms = {read_runs[-1]:.2f} GB/s text")
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+    payload = {
+        "purpose": "write_packed pipeline rate with D2H removed (CPU backend, tmpfs)",
+        "size": size,
+        "text_gb": text_bytes / 1e9,
+        "tmpdir": tmpdir,
+        "cpus": os.cpu_count(),
+        "write_gb_per_s": [round(r, 3) for r in runs],
+        "write_median_gb_per_s": round(sorted(runs)[len(runs) // 2], 3),
+        "codec_unpack_gb_per_s_single_thread": round(max(codec_runs), 3),
+        "read_gb_per_s": [round(r, 3) for r in read_runs],
+        "read_median_gb_per_s": round(sorted(read_runs)[len(read_runs) // 2], 3),
+    }
+    with open(OUT, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    log("wrote", OUT)
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()
