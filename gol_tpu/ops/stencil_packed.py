@@ -152,8 +152,8 @@ def _band_kernel(main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref, *,
     new = _vertical_combine(s0, s1, m0, m1, mid, t0, t1, b0, b1, band)
     out_ref[:] = new
 
-    alive = jnp.max(jnp.where(new != 0, 1, 0))
-    similar = 1 - jnp.max(jnp.where((new ^ mid) != 0, 1, 0))
+    alive = jnp.any(new != 0).astype(jnp.int32)
+    similar = 1 - jnp.any((new ^ mid) != 0).astype(jnp.int32)
 
     @pl.when(i == 0)
     def _init():
@@ -226,15 +226,20 @@ _BANDT_BYTES = 2 << 20
 # Scoped-VMEM budget for a temporal kernel's (band + 2T)-row extended block,
 # with rows PADDED to whole 128-lane tiles (what Mosaic allocates). The
 # r3 rule dropped the band target only at exactly nwords >= _MAX_WORDS_T,
-# but the blowup it guards is continuous in width (advisor r3, medium): the
-# v5e compile-boundary probe (benchmarks/vmem_probe_r4.json, height 1024,
-# all three temporal forms) passes every config with extended block
-# <= 2.25MB (4096 words x 128+16 rows) and fails at 2.34MB+ (7680 words x
-# 64+16 rows; 8192 x 64+16 = 2.62MB reproduces the r3 17.73M-scoped-VMEM
-# failure). 2.25MB inclusive keeps every measured-fast config — including
-# the 65536^2 single-chip 2048-word/256-row bands — and is re-probed at the
-# boundary by test_tpu_hw.py::test_temporal_near_cap_widths.
-_BANDT_EXT_BUDGET = (2 << 20) + (256 << 10)
+# but the blowup it guards is continuous in width (advisor r3, medium).
+# Mapped on v5e by compile probes over ALL THREE temporal forms
+# (benchmarks/vmem_probe_r4.json + cap_raise_r4.json): the largest extended
+# block that compiles in every form is 7168 words x (64+16) rows =
+# 2,293,760 bytes (scoped usage runs ~6.6x the extended block, right under
+# the 16MB limit there); 2,359,296 bytes already fails for the MESH forms
+# at wide rows (their two full-width 8-row ghost operands add ~0.8MB:
+# 12288 words x (32+16) rows overflowed scoped VMEM by 348KB) and
+# 2,457,600+ fails every form (7680 x 80). The budget is the
+# all-forms-measured-OK maximum, inclusive — which also keeps the headline
+# 65536^2 config (2048 words x 272 rows = 2,228,224) on its measured-fast
+# 2MB/256-row bands. Re-probed at the boundary by
+# test_tpu_hw.py::test_temporal_near_cap_widths.
+_BANDT_EXT_BUDGET = (2 << 20) + (192 << 10)
 
 
 def _bandt_target(height: int, nwords: int) -> int:
@@ -360,8 +365,8 @@ def _bandt_kernel(
         g = x[8 : band + 8]
         live = g if bitmask is None else g & bitmask
         diff = (g ^ prev) if bitmask is None else (g ^ prev) & bitmask
-        alive = jnp.max(jnp.where(live != 0, 1, 0))
-        similar = 1 - jnp.max(jnp.where(diff != 0, 1, 0))
+        alive = jnp.any(live != 0).astype(jnp.int32)
+        similar = 1 - jnp.any(diff != 0).astype(jnp.int32)
         flags.append((alive, similar))
         prev = g
     out_ref[:] = prev
@@ -401,8 +406,8 @@ def _bandtg_kernel(
     for _ in range(TEMPORAL_GENS):
         x, G = _evolve_with_ghost_plane(x, G, lanes, glanes)
         g = x[8 : band + 8]
-        alive = jnp.max(jnp.where(g != 0, 1, 0))
-        similar = 1 - jnp.max(jnp.where((g ^ prev) != 0, 1, 0))
+        alive = jnp.any(g != 0).astype(jnp.int32)
+        similar = 1 - jnp.any((g ^ prev) != 0).astype(jnp.int32)
         flags.append((alive, similar))
         prev = g
     out_ref[:] = prev
@@ -412,7 +417,7 @@ def _bandtg_kernel(
 def _bandtrow_kernel(
     main_ref, topn_ref, botn_ref, gtop_ref, gbot_ref,
     out_ref, alive_ref, similar_ref,
-    *, band: int, nbands: int, mask_edges: bool = False,
+    *, band: int, nbands: int,
 ):
     """TEMPORAL_GENS generations per pass for one FULL-WIDTH mesh shard.
 
@@ -429,15 +434,8 @@ def _bandtrow_kernel(
     stencil: per-chip comm drops to the two N/S ghost-row blocks riding one
     ICI ring axis (the reference's E/W column messages and 4 corner
     requests, src/game_mpi.c:340-383, have no analog here at all).
-
-    ``mask_edges`` is the split-edge 2D form's main pass (``_step_tsplit``):
-    the E/W wrap rolled in across the shard seam is then WRONG — which is
-    fine, because seam corruption advances one BIT per generation, so after
-    TEMPORAL_GENS <= 8 generations only the outer 8 bits of the two edge
-    word columns are garbage; those columns are excluded from the flags
-    here and overwritten from the exact strip pass by the caller. Interior
-    word columns are exact either way (they only ever read the edge words'
-    inner-side bits).
+    (``_bandtrow_stitch_kernel`` is this kernel adapted as the split-edge
+    2D form's main pass: edge-masked flags + fused edge-column stitch.)
     """
     i = pl.program_id(0)
     top_ctx = jnp.where(i == 0, gtop_ref[:], topn_ref[:])
@@ -451,30 +449,22 @@ def _bandtrow_kernel(
         m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
         return _vroll_combine(s0, s1, m0, m1, x)
 
-    bitmask = None
-    if mask_edges:
-        lanes = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
-        bitmask = jnp.where(
-            (lanes == 0) | (lanes == nwords - 1), jnp.uint32(0), jnp.uint32(0xFFFFFFFF)
-        )
     prev = main_ref[:]
     flags = []
     for _ in range(TEMPORAL_GENS):
         x = evolve_full(x)
         g = x[8 : band + 8]
-        live = g if bitmask is None else g & bitmask
-        diff = (g ^ prev) if bitmask is None else (g ^ prev) & bitmask
-        alive = jnp.max(jnp.where(live != 0, 1, 0))
-        similar = 1 - jnp.max(jnp.where(diff != 0, 1, 0))
+        alive = jnp.any(g != 0).astype(jnp.int32)
+        similar = 1 - jnp.any((g ^ prev) != 0).astype(jnp.int32)
         flags.append((alive, similar))
         prev = g
     out_ref[:] = prev
     _record_flags(i, flags, alive_ref, similar_ref)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "mask_edges"))
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def _step_trow(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
-               interpret: bool = False, mask_edges: bool = False):
+               interpret: bool = False):
     """Temporal pass for one full-width (h, nwords) shard from N/S ghost
     blocks only (see ``_bandtrow_kernel``)."""
     h, nwords = words.shape
@@ -482,8 +472,7 @@ def _step_trow(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
     nb = h // _SUBLANES
     T = TEMPORAL_GENS
     new, alive, similar = pl.pallas_call(
-        functools.partial(_bandtrow_kernel, band=band, nbands=h // band,
-                          mask_edges=mask_edges),
+        functools.partial(_bandtrow_kernel, band=band, nbands=h // band),
         grid=(h // band,),
         in_specs=[
             *_banded_specs(band, nwords, nb),
@@ -618,6 +607,93 @@ def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
     return new, alive[0], similar[0]
 
 
+def _bandtrow_stitch_kernel(
+    main_ref, topn_ref, botn_ref, gtop_ref, gbot_ref, w0_ref, wn_ref,
+    out_ref, alive_ref, similar_ref,
+    *, band: int, nbands: int,
+):
+    """``_bandtrow_kernel`` with the split-edge stitch fused into the output
+    write: the exact edge word columns (computed by the strip pass, which
+    runs FIRST) arrive as (band, 1) operands and replace lanes 0/nwords-1
+    in ``out_ref`` — two selects per band per T generations, instead of a
+    whole-shard read+write XLA pass after the kernel (which measured ~15%
+    of the main pass in HBM traffic at 16384^2). Flags stay edge-masked;
+    the strip pass owns the edge columns' flags.
+    """
+    i = pl.program_id(0)
+    top_ctx = jnp.where(i == 0, gtop_ref[:], topn_ref[:])
+    bot_ctx = jnp.where(i == nbands - 1, gbot_ref[:], botn_ref[:])
+    x = jnp.concatenate([top_ctx, main_ref[:], bot_ctx], axis=0)
+    nwords = x.shape[1]
+
+    def evolve_full(x):
+        left = pltpu.roll(x, 1 % nwords, 1)
+        right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
+        return _vroll_combine(s0, s1, m0, m1, x)
+
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (band, nwords), 1)
+    bitmask = jnp.where(
+        (lanes == 0) | (lanes == nwords - 1), jnp.uint32(0), jnp.uint32(0xFFFFFFFF)
+    )
+    prev = main_ref[:]
+    flags = []
+    for _ in range(TEMPORAL_GENS):
+        x = evolve_full(x)
+        g = x[8 : band + 8]
+        live = g & bitmask
+        diff = (g ^ prev) & bitmask
+        alive = jnp.any(live != 0).astype(jnp.int32)
+        similar = 1 - jnp.any(diff != 0).astype(jnp.int32)
+        flags.append((alive, similar))
+        prev = g
+    stitched = jnp.where(lanes == 0, jnp.broadcast_to(w0_ref[:], prev.shape), prev)
+    out_ref[:] = jnp.where(
+        lanes == nwords - 1, jnp.broadcast_to(wn_ref[:], prev.shape), stitched
+    )
+    _record_flags(i, flags, alive_ref, similar_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _step_trow_stitch(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
+                      w0_col: jnp.ndarray, wn_col: jnp.ndarray,
+                      interpret: bool = False):
+    """Main pass of the split-edge form: rows-only evolution with the
+    strip's exact edge columns stitched in at the output write."""
+    h, nwords = words.shape
+    band = _pick_band(h, nwords, _bandt_target(h, nwords))
+    nb = h // _SUBLANES
+    T = TEMPORAL_GENS
+    new, alive, similar = pl.pallas_call(
+        functools.partial(_bandtrow_stitch_kernel, band=band, nbands=h // band),
+        grid=(h // band,),
+        in_specs=[
+            *_banded_specs(band, nwords, nb),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((band, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, T), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, nwords), jnp.uint32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+            jax.ShapeDtypeStruct((1, T), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(words, words, words, gtop, gbot, w0_col, wn_col)
+    return new, alive[0], similar[0]
+
+
 def _stript_kernel(
     main_ref, topn_ref, botn_ref, out_ref, alive_ref, similar_ref,
     *, band: int, row_lo: int, row_hi: int,
@@ -658,8 +734,8 @@ def _stript_kernel(
         m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
         x = _vroll_combine(s0, s1, m0, m1, x)
         g = x[8 : band + 8]
-        alive = jnp.max(jnp.where((g & bitmask) != 0, 1, 0))
-        similar = 1 - jnp.max(jnp.where(((g ^ prev) & bitmask) != 0, 1, 0))
+        alive = jnp.any((g & bitmask) != 0).astype(jnp.int32)
+        similar = 1 - jnp.any(((g ^ prev) & bitmask) != 0).astype(jnp.int32)
         flags.append((alive, similar))
         prev = g
     out_ref[:] = prev
@@ -701,6 +777,35 @@ def _step_strip(folded: jnp.ndarray, interpret: bool = False):
     return new, alive[0], similar[0]
 
 
+def _tsplit_operands(words: jnp.ndarray, topology: Topology):
+    """Ghost/edge operands for the split-edge form: ``(gtop, gbot, cols4,
+    G_ext)``.
+
+    Same wire traffic as ``deep_ghost_operands`` (T-row N/S ghost blocks,
+    whole-word ghost columns riding the column exchange), but the shard's
+    own edge columns are extracted ONCE into the compact ``cols4`` and
+    every downstream strip/G_ext consumer reads that, not the big array —
+    the r3-shaped operand build (row-extended concat + per-consumer lane
+    extracts) measured ~45% of a whole pass at 16384^2 in device time.
+    Measured dead ends, for the record: a Pallas extraction kernel cannot
+    beat these fused XLA slices — BlockSpec lane dims must be
+    128-multiples (whole-tile reads moved 2/(nwords/128) of the array and
+    lost ~2%), and manual ``make_async_copy`` slices of a tiled HBM ref
+    hit the same constraint ("Slice shape along dimension 1 must be
+    aligned to tiling (128)", v5e probe).
+    """
+    h, nwords = words.shape
+    rows, _cols = topology.shape
+    row_axis = ROW_AXIS if topology.distributed else None
+    gtop, gbot = halo.ghost_slices(words, 0, row_axis, rows, depth=TEMPORAL_GENS)
+    cols4 = jnp.concatenate([words[:, :2], words[:, nwords - 2:]], axis=1)
+    west = jnp.concatenate([gtop[:, 0], cols4[:, 0], gbot[:, 0]])
+    east = jnp.concatenate([gtop[:, -1], cols4[:, 3], gbot[:, -1]])
+    gwest, geast = halo.exchange_columns(west, east, topology)
+    G_ext = jnp.stack([gwest, geast], axis=1)
+    return gtop, gbot, cols4, G_ext
+
+
 # Lane budget for the folded strip: 6 lanes per fold, at most one full
 # 128-lane tile (more folds than 21 would spill into a second tile and
 # double the strip pass's per-op cost for nothing).
@@ -715,7 +820,8 @@ def _fold_count(h: int) -> int:
 
 
 def _step_tsplit(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
-                 G_ext: jnp.ndarray, interpret: bool = False):
+                 cols4: jnp.ndarray, G_ext: jnp.ndarray,
+                 interpret: bool = False):
     """Split-edge temporal pass for one 2D-mesh shard: rows-only main pass
     plus a lane-folded exact edge strip.
 
@@ -735,9 +841,13 @@ def _step_tsplit(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
       dimension is FOLDED into lanes (F vertical windows side by side, 6F
       <= 126 lanes = one tile), cutting the narrow-array tile tax by F
       (~16x for power-of-two heights).
-    - STITCH: once per T generations the strip's exact w0/w_{n-1} columns
-      overwrite the main output's edge lanes; per-generation flags OR/AND
-      across the two passes (main's flags exclude the edge columns).
+    - STITCH: the strip runs FIRST, and its exact w0/w_{n-1} columns ride
+      into the main-pass kernel as (band, 1) operands that replace the two
+      edge lanes at the output write — fused, because a post-kernel
+      whole-shard select measured ~15% of the main pass in pure HBM
+      traffic at 16384^2 (device-time profile, compare_16384_r4.json's
+      first series). Per-generation flags OR/AND across the two passes
+      (main's flags exclude the edge columns).
 
     Needs nwords >= 2 (at nwords == 1 the strip's lane adjacency cannot
     express the torus; that single-word case keeps ``_step_tgb``). At
@@ -748,38 +858,41 @@ def _step_tsplit(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
     h, nwords = words.shape
     T = TEMPORAL_GENS
 
-    new_main, alive_m, similar_m = _step_trow(
-        words, gtop, gbot, interpret=interpret, mask_edges=True
+    # The (h+2T, 6) edge strip over extended rows. The shard rows' edge
+    # columns arrive pre-extracted (``cols4`` from the _edge_cols kernel —
+    # XLA-level lane extracts from the big array measured ~45% of a whole
+    # pass at 16384^2); only the tiny T-row ghost blocks are sliced here.
+    west2 = jnp.concatenate([gtop[:, :2], cols4[:, :2], gbot[:, :2]], axis=0)
+    east2 = jnp.concatenate(
+        [gtop[:, nwords - 2:], cols4[:, 2:], gbot[:, nwords - 2:]], axis=0
     )
-
-    # The (h+2T, 6) edge strip over extended rows, then its lane folding.
-    idx = [0, 1, nwords - 2, nwords - 1]
-    ext4 = jnp.concatenate(
-        [gtop[:, idx], words[:, idx], gbot[:, idx]], axis=0
-    )  # (h+16, 4)
-    strip = jnp.concatenate(
-        [G_ext[:, 0:1], ext4[:, 0:2], ext4[:, 2:4], G_ext[:, 1:2]], axis=1
+    E = jnp.concatenate(
+        [G_ext[:, 0:1], west2, east2, G_ext[:, 1:2]], axis=1
     )  # (h+16, 6)
     F = _fold_count(h)
     Lo = h // F
-    folded = jnp.concatenate(
-        [
-            jax.lax.slice_in_dim(strip, k * Lo, k * Lo + Lo + 2 * T, axis=0)
-            for k in range(F)
-        ],
-        axis=1,
-    )  # (Lo+16, 6F)
+    # Fold k covers extended rows [k*Lo, k*Lo + Lo + 16): its Lo-row body
+    # and both 8-row context flanks are plain reshape views of E shifted by
+    # 0 / 8 / 16 rows — no per-fold slicing.
+    body = E[8 : h + 8].reshape(F, Lo, 6)
+    top = E[:h].reshape(F, Lo, 6)[:, :8]
+    bot = E[16 : h + 16].reshape(F, Lo, 6)[:, Lo - 8:]
+    folded = (
+        jnp.concatenate([top, body, bot], axis=1)
+        .transpose(1, 0, 2)
+        .reshape(Lo + 2 * T, 6 * F)
+    )
     folded_T, alive_s, similar_s = _step_strip(folded, interpret=interpret)
 
     # Unfold the exact edge columns: rows [8, Lo+8) of fold k are shard rows
     # [k*Lo, (k+1)*Lo); lanes 1/4 mod 6 are w0/w_{n-1}.
-    out_rows = folded_T[T : Lo + T]
-    w0_col = out_rows[:, 1::6].T.reshape(h)
-    wn_col = out_rows[:, 4::6].T.reshape(h)
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (h, nwords), 1)
-    new = jnp.where(lanes == 0, w0_col[:, None], new_main)
-    new = jnp.where(lanes == nwords - 1, wn_col[:, None], new)
+    out_rows = folded_T[T : Lo + T].reshape(Lo, F, 6)
+    w0_col = out_rows[:, :, 1].T.reshape(h, 1)
+    wn_col = out_rows[:, :, 4].T.reshape(h, 1)
 
+    new, alive_m, similar_m = _step_trow_stitch(
+        words, gtop, gbot, w0_col, wn_col, interpret=interpret
+    )
     alive = jnp.maximum(alive_m, alive_s)
     similar = jnp.minimum(similar_m, similar_s)
     return new, alive, similar
@@ -899,13 +1012,15 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
             words, 0, row_axis, rows, depth=TEMPORAL_GENS
         )
         return _step_trow(words, gtop, gbot, interpret=interpret)
-    gtop, gbot, G_ext = deep_ghost_operands(words, topology)
     if nwords >= 2:
         # The split-edge form: rows-only main pass + lane-folded exact edge
         # strip (see _step_tsplit) — replaces the r3 ghost-plane form whose
         # per-generation patches + 2-lane adder pass cost 0.64-0.96x of
         # single-chip on any R x C mesh with mesh columns.
-        return _step_tsplit(words, gtop, gbot, G_ext, interpret=interpret)
+        gtop, gbot, cols4, G_ext = _tsplit_operands(words, topology)
+        return _step_tsplit(words, gtop, gbot, cols4, G_ext,
+                            interpret=interpret)
+    gtop, gbot, G_ext = deep_ghost_operands(words, topology)
     return _step_tgb(words, gtop, gbot, G_ext, interpret=interpret)
 
 
@@ -1055,8 +1170,8 @@ def _dist_band_kernel(
     new = _vertical_combine(s0, s1, m0, m1, mid, t0, t1, b0, b1, band)
     out_ref[:] = new
 
-    alive = jnp.max(jnp.where(new != 0, 1, 0))
-    similar = 1 - jnp.max(jnp.where((new ^ mid) != 0, 1, 0))
+    alive = jnp.any(new != 0).astype(jnp.int32)
+    similar = 1 - jnp.any((new ^ mid) != 0).astype(jnp.int32)
 
     @pl.when(i == 0)
     def _init():

@@ -68,12 +68,13 @@ def choose_mesh_shape(
     wrong one: halo bytes cost microseconds on ICI either way, while the
     COLUMN-direction ghost machinery costs real per-generation compute in
     the packed kernel. A row-only R x 1 decomposition needs none of it —
-    full-width shards wrap E/W through their own lane roll. On the r3
-    measurement protocol the pod-shard ratio to single-chip spanned
-    0.79-1.61 across six runs (benchmarks/pod_shard_r3.json; the tunnel's
-    drift dominates — see benchmarks/README.md for the r4 protocol and
-    series) while the 2D ghost-plane form measured 0.64-0.96
-    (compare_{16384,32768}_r3.json), so row-heavy is the default.
+    full-width shards wrap E/W through their own lane roll. In DEVICE time
+    (the r4 protocol's published series — wall clock over the attach
+    tunnel spans +/-40%, benchmarks/README.md) the rows-only pod shard
+    runs at 0.9997 of the single-chip kernel and the r4 split-edge 2D
+    form at 0.85-0.86 (benchmarks/configs_r4.json,
+    compare_{16384,32768}_r4.json; the r3 ghost-plane form it replaced
+    measured 0.64-0.96 wall), so row-heavy is the default.
 
     ``width``/``height`` (the grid shape, when the caller knows it) refine
     the choice:

@@ -504,8 +504,9 @@ def test_split_edge_temporal_kernel_interpret(shape):
     g = rng.integers(0, 2, size=shape, dtype=np.uint8)
     T = sp.TEMPORAL_GENS
     words = sp.encode(jnp.asarray(g))
-    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
-    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    gtop, gbot, cols4, G_ext = sp._tsplit_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, cols4, G_ext,
+                                          interpret=True)
     got = np.asarray(sp.decode(new))
     states = [g]
     for _ in range(T):
@@ -525,8 +526,9 @@ def test_split_edge_strip_owns_edge_words():
     g[7:10, 1] = 1    # blinker in word 0, feeding across the wrap seam
     g[3:5, nwords * 32 - 2 : nwords * 32] = 1  # block (still life) east edge
     words = sp.encode(jnp.asarray(g))
-    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
-    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    gtop, gbot, cols4, G_ext = sp._tsplit_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, cols4, G_ext,
+                                          interpret=True)
     expect = g
     for _ in range(sp.TEMPORAL_GENS):
         expect = oracle.evolve(expect)
@@ -545,8 +547,9 @@ def test_split_edge_still_life_similarity():
     g = np.zeros((h, nwords * 32), np.uint8)
     g[6:8, 2:4] = 1
     words = sp.encode(jnp.asarray(g))
-    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
-    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    gtop, gbot, cols4, G_ext = sp._tsplit_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, cols4, G_ext,
+                                          interpret=True)
     np.testing.assert_array_equal(np.asarray(sp.decode(new)), g)
     assert all(int(a) == 1 for a in alive)
     assert all(int(s) == 1 for s in similar)
@@ -561,9 +564,10 @@ def test_split_edge_multi_band_and_folds(monkeypatch):
     g = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
     T = sp.TEMPORAL_GENS
     words = sp.encode(jnp.asarray(g))
-    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
     monkeypatch.setattr(sp, "_BANDT_BYTES", 8 << 10)
-    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext, interpret=True)
+    gtop, gbot, cols4, G_ext = sp._tsplit_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, cols4, G_ext,
+                                          interpret=True)
     got = np.asarray(sp.decode(new))
     states = [g]
     for _ in range(T):
@@ -581,9 +585,9 @@ def test_split_edge_routing(monkeypatch):
     calls = []
     real = sp._step_tsplit
 
-    def spy(words, gtop, gbot, G_ext, interpret=False):
+    def spy(words, gtop, gbot, cols4, G_ext, interpret=False):
         calls.append(words.shape)
-        return real(words, gtop, gbot, G_ext, interpret=interpret)
+        return real(words, gtop, gbot, cols4, G_ext, interpret=interpret)
 
     monkeypatch.setattr(sp, "_step_tsplit", spy)
     rng = np.random.default_rng(73)

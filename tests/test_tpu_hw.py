@@ -182,8 +182,8 @@ def test_split_edge_form_compiled_matches():
     rng = np.random.default_rng(13)
     g = rng.integers(0, 2, size=(512, 4096), dtype=np.uint8)
     words = sp.encode(jnp.asarray(g))
-    gtop, gbot, G_ext = sp.deep_ghost_operands(words, SINGLE_DEVICE)
-    new, alive, similar = sp._step_tsplit(words, gtop, gbot, G_ext)
+    gtop, gbot, cols4, G_ext = sp._tsplit_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit(words, gtop, gbot, cols4, G_ext)
     cur = words
     for _ in range(sp.TEMPORAL_GENS):
         cur = packed_math.evolve_torus_words(cur)

@@ -20,7 +20,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -73,27 +72,35 @@ def _step_t_noflags(words):
     )(words, words, words)
 
 
-def _rate(step, words, n1, n2, size):
-    fn = jax.jit(lambda w, n: jax.lax.fori_loop(0, n, lambda i, x: step(x), w),
+def _rate(step, words, n, size):
+    """Cells/s from DEVICE time — wall-clock marginals over the attach
+    tunnel go negative between drift spikes; device time was repeatable to
+    3 decimals across sessions (benchmarks/compare_*_r4). Shares
+    measure_r4's trace->op_profile extraction (incl. its cleanup and
+    error tolerance)."""
+    from tools.measure_r4 import _device_time_per_pass
+
+    fn = jax.jit(lambda w, m: jax.lax.fori_loop(0, m, lambda i, x: step(x), w),
                  static_argnums=1)
     _force(fn(words, 2))
-    t0 = time.perf_counter(); _force(fn(words, n1)); ta = time.perf_counter() - t0
-    t0 = time.perf_counter(); _force(fn(words, n2)); tb = time.perf_counter() - t0
-    return size * size * sp.TEMPORAL_GENS / ((tb - ta) / (n2 - n1))
+    ms = _device_time_per_pass(fn, words, n)
+    if ms is None:
+        raise RuntimeError("device-time extraction unavailable (xprof)")
+    return size * size * sp.TEMPORAL_GENS / (ms / 1000.0)
 
 
 def main() -> None:
     assert jax.default_backend() == "tpu"
     results = {}
-    for size, (n1, n2) in ((16384, (50, 250)), (65536, (10, 40))):
+    for size, n in ((16384, 50), (65536, 10)):
         rng = np.random.default_rng(42)
         grid = rng.integers(0, 2, size=(size, size), dtype=np.uint8)
         words = jnp.asarray(
             np.packbits(grid, axis=1, bitorder="little").view(np.uint32))
         flags, noflags = [], []
         for rep in range(3):
-            flags.append(_rate(lambda w: sp._step_t(w)[0], words, n1, n2, size))
-            noflags.append(_rate(_step_t_noflags, words, n1, n2, size))
+            flags.append(_rate(lambda w: sp._step_t(w)[0], words, n, size))
+            noflags.append(_rate(_step_t_noflags, words, n, size))
             log(f"{size}: rep {rep} flags={flags[-1]/1e12:.3f}T "
                 f"noflags={noflags[-1]/1e12:.3f}T")
         fm = sorted(flags)[1]
