@@ -35,8 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.ops import packed_math
-from gol_tpu.parallel import halo
-from gol_tpu.parallel.mesh import ROW_AXIS, Topology
+from gol_tpu.parallel import collectives, halo
+from gol_tpu.parallel.mesh import ROW_AXIS, SINGLE_DEVICE as SINGLE_DEVICE_TOPOLOGY, Topology
 
 _BITS = packed_math.BITS
 _SUBLANES = 8  # 32-bit tile granule: every row offset/extent must divide by 8
@@ -320,6 +320,75 @@ def _record_flags(i, flags, alive_ref, similar_ref):
             similar_ref[0, t] = similar_ref[0, t] & similar
 
 
+def _record_summary(i, vals, summ_ref):
+    """Accumulate the fast-flag pass summary ``(in_alive, out_alive, simT,
+    sim1)`` across the sequential band grid: OR for the alive pair, AND for
+    the similarity pair."""
+
+    @pl.when(i == 0)
+    def _init():
+        for j, v in enumerate(vals):
+            summ_ref[0, j] = v
+
+    @pl.when(i > 0)
+    def _accumulate():
+        summ_ref[0, 0] = summ_ref[0, 0] | vals[0]
+        summ_ref[0, 1] = summ_ref[0, 1] | vals[1]
+        summ_ref[0, 2] = summ_ref[0, 2] & vals[2]
+        summ_ref[0, 3] = summ_ref[0, 3] & vals[3]
+
+
+def _derive_or_replay(summary, exact_thunk, topology=None):
+    """Per-generation flag vectors from the pass summary, exact always.
+
+    Both exit conditions are MONOTONE within a pass OVER THE WHOLE TORUS:
+    an empty generation stays empty forever (no cell has three neighbors),
+    and a generation equal to its predecessor is a still life, equal
+    forever after. Hence, for the GLOBAL summary:
+
+    - ``out_alive == 1``  => no generation died  => alive_vec all ones;
+      ``in_alive == 0``   => all were empty      => alive_vec all zeros
+      (and ``out_alive`` is 0 too, so ``full(out_alive)`` covers both);
+    - ``simT == 0``       => no adjacent pair was equal => zeros;
+      ``sim1 == 1``       => the input was already still => ones
+      (``full(simT)`` covers both).
+
+    Monotonicity does NOT hold per shard — a shard is an open system, and
+    a cross-boundary transient can enter and die out between a shard's
+    summary taps (g0/g1 and g7/g8), making its local summary lie (found
+    by adversarial search: tests/test_packed.py::
+    test_fast_flag_cross_shard_transient pins a 4-shard grid whose
+    locally-derived, engine-voted similarity vector fires a generation
+    early). So under a mesh the four scalars are VOTED globally first —
+    alive pair by any_flag, similarity pair by all_agree — and the
+    derivation happens on the closed-system summary; the replay predicate
+    is then replicated across shards, so every shard replays together
+    (the replay kernel is collective-free either way).
+
+    Only a transition INSIDE the pass — global death (in=1, out=0) or
+    global stillness onset (simT=1, sim1=0) — needs the per-generation
+    flag kernel, and each happens at most once per run, right before the
+    run exits; ``lax.cond`` pays that replay only when it fires. This
+    removes 14 of the 16 per-pass flag reductions that measured 29-34% of
+    the whole kernel (benchmarks/roofline_flags_r4.json).
+    """
+    in_alive, out_alive = summary[0, 0], summary[0, 1]
+    simT, sim1 = summary[0, 2], summary[0, 3]
+    if topology is not None and topology.distributed:
+        in_alive = collectives.any_flag(in_alive, topology).astype(jnp.int32)
+        out_alive = collectives.any_flag(out_alive, topology).astype(jnp.int32)
+        simT = collectives.all_agree(simT, topology).astype(jnp.int32)
+        sim1 = collectives.all_agree(sim1, topology).astype(jnp.int32)
+    need = ((in_alive == 1) & (out_alive == 0)) | ((simT == 1) & (sim1 == 0))
+    T = TEMPORAL_GENS
+
+    def derived():
+        return (jnp.full((T,), out_alive, jnp.int32),
+                jnp.full((T,), simT, jnp.int32))
+
+    return jax.lax.cond(need, exact_thunk, derived)
+
+
 def _bandt_kernel(
     main_ref, top_ref, bot_ref, out_ref, alive_ref, similar_ref,
     *, band: int, interior=None,
@@ -412,6 +481,141 @@ def _bandtg_kernel(
         prev = g
     out_ref[:] = prev
     _record_flags(i, flags, alive_ref, similar_ref)
+
+
+def _fast_target(height: int, nwords: int) -> int:
+    """Band target for the fast-flag kernels: the temporal target capped at
+    512-row bands. Their summary bookkeeping extends operand liveness in a
+    way Mosaic's scoped-VMEM scheduler is sensitive to: 1024-row and
+    2048-row fast bands Mosaic-OOMed at 17.4-17.5M scoped (shapes where
+    the exact kernel fits), and the measured boundary moved between two
+    equivalent formulations of the same summary math — so the cap keeps a
+    2x margin below the failures instead of riding the boundary. The
+    extra ghost-row overfetch at the capped shapes is <= 1.6% and the
+    fast path still measures 1.2x the exact kernel end to end."""
+    row_bytes = max(nwords, 128) * 4
+    return min(_bandt_target(height, nwords), 512 * row_bytes)
+
+
+def _fast_pass_body(i, x, main_ref, out_ref, summ_ref, band):
+    """Shared body of the fast-flag kernels: evolve the extended block
+    TEMPORAL_GENS generations and record the pass summary. Callers differ
+    only in how ``x``'s top/bottom context rows are sourced.
+
+    Liveness note: the summary scalars are computed in place (the g_1
+    plane is never retained) — keeping it live across the unrolled
+    generations grew the scoped-VMEM stack past 16M at the 65536^2
+    band configuration; see also the 512-row band cap in ``_fast_target``.
+    """
+    nwords = x.shape[1]
+    g0 = main_ref[:]
+    in_alive = jnp.any(g0 != 0).astype(jnp.int32)
+    prev = g0
+    for t in range(TEMPORAL_GENS):
+        left = pltpu.roll(x, 1 % nwords, 1)
+        right = pltpu.roll(x, (nwords - 1) % nwords, 1)
+        m0, m1, s0, s1 = packed_math.row_sums(x, left, right)
+        x = _vroll_combine(s0, s1, m0, m1, x)
+        g = x[8 : band + 8]
+        if t == 0:
+            sim1 = 1 - jnp.any((g ^ g0) != 0).astype(jnp.int32)
+        if t == TEMPORAL_GENS - 1:
+            simT = 1 - jnp.any((g ^ prev) != 0).astype(jnp.int32)
+            out_alive = jnp.any(g != 0).astype(jnp.int32)
+        prev = g
+    out_ref[:] = prev
+    _record_summary(i, (in_alive, out_alive, simT, sim1), summ_ref)
+
+
+def _bandt_fast_kernel(main_ref, top_ref, bot_ref, out_ref, summ_ref, *, band: int):
+    """``_bandt_kernel`` with the per-generation flag math replaced by the
+    four pass-level summary scalars (see ``_derive_or_replay``)."""
+    i = pl.program_id(0)
+    x = jnp.concatenate([top_ref[:], main_ref[:], bot_ref[:]], axis=0)
+    _fast_pass_body(i, x, main_ref, out_ref, summ_ref, band)
+
+
+def _bandtrow_fast_kernel(
+    main_ref, topn_ref, botn_ref, gtop_ref, gbot_ref, out_ref, summ_ref,
+    *, band: int, nbands: int,
+):
+    """``_bandtrow_kernel`` with pass-summary flags (see ``_derive_or_replay``)."""
+    i = pl.program_id(0)
+    top_ctx = jnp.where(i == 0, gtop_ref[:], topn_ref[:])
+    bot_ctx = jnp.where(i == nbands - 1, gbot_ref[:], botn_ref[:])
+    x = jnp.concatenate([top_ctx, main_ref[:], bot_ctx], axis=0)
+    _fast_pass_body(i, x, main_ref, out_ref, summ_ref, band)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _step_t_fast(words: jnp.ndarray, interpret: bool = False):
+    """Fast-flag torus pass: summary scalars per pass, with the exact
+    per-generation kernel replayed under lax.cond only on the (at most
+    once-per-run) pass where an exit fires mid-pass."""
+    height, nwords = words.shape
+    band = _pick_band(height, nwords, _fast_target(height, nwords))
+    nb = height // _SUBLANES
+    new, summ = pl.pallas_call(
+        functools.partial(_bandt_fast_kernel, band=band),
+        grid=(height // band,),
+        in_specs=_banded_specs(band, nwords, nb),
+        out_specs=(
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((height, nwords), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 4), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(words, words, words)
+    alive, similar = _derive_or_replay(
+        summ, lambda: _step_t(words, interpret=interpret)[1:]
+    )
+    return new, alive, similar
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "topology"))
+def _step_trow_fast(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
+                    topology: Topology = SINGLE_DEVICE_TOPOLOGY,
+                    interpret: bool = False):
+    """Fast-flag rows-only pass (see ``_step_t_fast``). ``topology`` is
+    needed because the summary scalars must be voted ACROSS shards before
+    the monotone derivation — see ``_derive_or_replay``."""
+    h, nwords = words.shape
+    band = _pick_band(h, nwords, _fast_target(h, nwords))
+    nb = h // _SUBLANES
+    new, summ = pl.pallas_call(
+        functools.partial(_bandtrow_fast_kernel, band=band, nbands=h // band),
+        grid=(h // band,),
+        in_specs=[
+            *_banded_specs(band, nwords, nb),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, nwords), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((band, nwords), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, nwords), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 4), jnp.int32),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(words, words, words, gtop, gbot)
+    alive, similar = _derive_or_replay(
+        summ, lambda: _step_trow(words, gtop, gbot, interpret=interpret)[1:],
+        topology,
+    )
+    return new, alive, similar
 
 
 def _bandtrow_kernel(
@@ -1011,7 +1215,8 @@ def _distributed_step_multi(words: jnp.ndarray, topology: Topology,
         gtop, gbot = halo.ghost_slices(
             words, 0, row_axis, rows, depth=TEMPORAL_GENS
         )
-        return _step_trow(words, gtop, gbot, interpret=interpret)
+        return _step_trow_fast(words, gtop, gbot, topology=topology,
+                                interpret=interpret)
     if nwords >= 2:
         # The split-edge form: rows-only main pass + lane-folded exact edge
         # strip (see _step_tsplit) — replaces the r3 ghost-plane form whose
@@ -1072,7 +1277,7 @@ def packed_step_multi(cur: jnp.ndarray, topology: Topology, *,
         return _distributed_step_multi(cur, topology, force_jnp, force_interp)
     if force_jnp or jax.default_backend() != "tpu":
         return _jnp_multi(cur, cur, (slice(None), slice(None)))
-    return _step_t(cur)
+    return _step_t_fast(cur)
 
 
 def exchange_packed(words: jnp.ndarray, topology: Topology):

@@ -628,3 +628,120 @@ def test_bandt_target_width_continuous():
     # at the 2MB target Mosaic-OOMed on v5e (benchmarks/vmem_probe_r4.json).
     assert sp._bandt_target(1024, 7680) < sp._BANDT_BYTES
     assert sp._bandt_target(1024, 8184) < sp._BANDT_BYTES
+
+
+# ---------------------------------------------------------------------------
+# Fast-flag passes (r4): pass-level summaries + monotone derivation, with the
+# exact kernel replayed under lax.cond only when an exit fires mid-pass.
+
+
+class TestFastFlagPasses:
+    """_step_t_fast/_step_trow_fast must produce bit-identical state AND
+    per-generation flag vectors to the exact kernels across every monotone
+    case: no-exit soup, death inside the pass (rerun), stillness onset
+    inside the pass (rerun), already-still input, and empty input."""
+
+    def _grids(self):
+        rng = np.random.default_rng(83)
+        soup = rng.integers(0, 2, size=(32, 128), dtype=np.uint8)
+        death = np.zeros((32, 128), np.uint8)
+        death[10, 10:12] = 1  # domino: dies at generation 1 (in-pass death)
+        onset = np.zeros((32, 128), np.uint8)
+        onset[10:12, 10] = onset[10, 11] = 1  # L-tromino -> block at gen 1:
+        # similarity first true at generation 2 (g2 == g1), sim1 == 0
+        still = np.zeros((32, 128), np.uint8)
+        still[10:12, 10:12] = 1  # block: already still, sim1 == 1
+        empty = np.zeros((32, 128), np.uint8)
+        return {"soup": soup, "death": death, "onset": onset,
+                "still": still, "empty": empty}
+
+    def test_torus_fast_matches_exact(self):
+        for name, g in self._grids().items():
+            words = sp.encode(jnp.asarray(g))
+            new_e, a_e, s_e = sp._step_t(words, interpret=True)
+            new_f, a_f, s_f = sp._step_t_fast(words, interpret=True)
+            np.testing.assert_array_equal(
+                np.asarray(new_f), np.asarray(new_e), err_msg=name)
+            assert np.asarray(a_f).tolist() == np.asarray(a_e).tolist(), name
+            assert np.asarray(s_f).tolist() == np.asarray(s_e).tolist(), name
+
+    def test_rows_only_fast_matches_exact(self):
+        from gol_tpu.parallel import halo
+
+        for name, g in self._grids().items():
+            words = sp.encode(jnp.asarray(g))
+            gtop, gbot = halo.ghost_slices(words, 0, None, 1,
+                                           depth=sp.TEMPORAL_GENS)
+            new_e, a_e, s_e = sp._step_trow(words, gtop, gbot, interpret=True)
+            new_f, a_f, s_f = sp._step_trow_fast(words, gtop, gbot,
+                                                 interpret=True)
+            np.testing.assert_array_equal(
+                np.asarray(new_f), np.asarray(new_e), err_msg=name)
+            assert np.asarray(a_f).tolist() == np.asarray(a_e).tolist(), name
+            assert np.asarray(s_f).tolist() == np.asarray(s_e).tolist(), name
+
+    def test_derivation_against_oracle_per_generation(self):
+        # Independent ground truth (not just exact-kernel agreement): flag
+        # vectors vs the oracle's per-generation states.
+        for name, g in self._grids().items():
+            words = sp.encode(jnp.asarray(g))
+            _, a_f, s_f = sp._step_t_fast(words, interpret=True)
+            states = [g]
+            for _ in range(sp.TEMPORAL_GENS):
+                states.append(oracle.evolve(states[-1]))
+            for t in range(sp.TEMPORAL_GENS):
+                assert int(a_f[t]) == int(states[t + 1].any()), (name, t)
+                assert int(s_f[t]) == int(
+                    np.array_equal(states[t + 1], states[t])), (name, t)
+
+
+def test_fast_flag_early_exits_under_real_mesh():
+    """Engine-level integration of the fast-flag pass: the blocked replay
+    consumes the DERIVED vectors (and the lax.cond replay on exit passes)
+    under real shard_map on a 4x1 mesh — exit generations must match the
+    oracle exactly for both exit kinds."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    still = np.zeros((32, 128), np.uint8)
+    still[14:16, 60:62] = 1
+    dying = np.zeros((32, 128), np.uint8)
+    dying[15, 60:62] = 1
+    onset = np.zeros((32, 128), np.uint8)
+    onset[14:16, 60] = onset[14, 61] = 1  # becomes a block at gen 1
+    for name, g in (("still", still), ("dying", dying), ("onset", onset)):
+        cfg = GameConfig(gen_limit=50)
+        got = engine.simulate(g, cfg, mesh=make_mesh(4, 1),
+                              kernel="packed-interp")
+        want = oracle.run(g, cfg)
+        assert got.generations == want.generations, name
+        np.testing.assert_array_equal(got.grid, want.grid, err_msg=name)
+
+
+def test_fast_flag_cross_shard_transient():
+    """Adversarial counterexample for the fast-flag derivation (found by
+    search, r4 code review): a shard is an OPEN system, so monotonicity
+    does not hold per shard — here a cross-boundary transient enters
+    shard 2 after its g0/g1 summary taps and dies before g7/g8, so the
+    shard's LOCAL summary claims stillness for the whole pass. Without
+    voting the four summary scalars globally before deriving
+    (_derive_or_replay), the engine-voted similarity vector fires a
+    generation early. Pinned end-to-end on a real 4x1 mesh with
+    similarity checked every generation."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    # 16-row shards (supports_multi needs h >= 16, or the temporal fast
+    # pass never engages — 8-row shards run the per-generation kernels).
+    cfg = GameConfig(gen_limit=30, similarity_frequency=1)
+    cases = [
+        ([31, 27, 30, 31, 29, 27, 28, 30, 29, 30, 27],
+         [68, 70, 68, 67, 70, 60, 69, 70, 65, 60, 65]),
+        ([29, 30, 30, 29, 30, 31], [64, 65, 63, 66, 66, 68]),
+    ]
+    for rows, cols in cases:
+        g = np.zeros((64, 128), np.uint8)
+        g[rows, cols] = 1
+        want = oracle.run(g, cfg)
+        got = engine.simulate(g, cfg, mesh=make_mesh(4, 1),
+                              kernel="packed-interp")
+        assert got.generations == want.generations, (rows, cols)
+        np.testing.assert_array_equal(got.grid, want.grid)

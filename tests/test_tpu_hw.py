@@ -189,3 +189,28 @@ def test_split_edge_form_compiled_matches():
         cur = packed_math.evolve_torus_words(cur)
     assert np.array_equal(np.asarray(new), np.asarray(cur))
     assert np.asarray(alive).tolist() == [1] * sp.TEMPORAL_GENS
+
+
+def test_fast_flag_pass_shapes_compile_and_match():
+    # The fast-flag kernels' scoped-VMEM footprint is schedule-sensitive
+    # (1024/2048-row bands OOMed where the exact kernel fit — hence the
+    # 512-row _fast_target cap); pin the capped configs on hardware,
+    # including the tall-narrow shape that exposed the hazard.
+    for shape in ((2048, 256), (512, 2048), (64, 8192)):
+        words = _random_words(*shape, seed=17)
+        cur = words
+        for _ in range(sp.TEMPORAL_GENS):
+            cur = packed_math.evolve_torus_words(cur)
+        new, a_vec, s_vec = sp._step_t_fast(words)
+        assert np.array_equal(np.asarray(new), np.asarray(cur)), shape
+        assert np.asarray(a_vec).tolist() == [1] * sp.TEMPORAL_GENS, shape
+        assert np.asarray(s_vec).tolist() == [0] * sp.TEMPORAL_GENS, shape
+    # An in-pass exit on hardware: a domino dies at generation 1 — the
+    # lax.cond exact replay must produce the oracle's flag vectors: dead
+    # from slot 0, and similar (empty == empty) from slot 1 on.
+    g = np.zeros((256, 2048), np.uint8)
+    g[100, 100:102] = 1
+    words = sp.encode(jnp.asarray(g))
+    _, a_vec, s_vec = sp._step_t_fast(words)
+    assert np.asarray(a_vec).tolist() == [0] * sp.TEMPORAL_GENS
+    assert np.asarray(s_vec).tolist() == [0] + [1] * (sp.TEMPORAL_GENS - 1)
