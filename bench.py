@@ -482,8 +482,21 @@ def main(argv: list[str] | None = None) -> int:
     else:
         grid = rng.integers(0, 2, size=(args.size, args.size), dtype=np.uint8)
         device_grid = engine.put_grid(grid, mesh)
-        runner = engine.make_runner(grid.shape, config, mesh, kernel)
-    compiled = runner.lower(device_grid).compile()
+        # 'auto' (not the pre-resolved name) when the user named no kernel:
+        # auto builds the _KernelFallback ladder, so a Mosaic compile
+        # failure demotes like the CLI path; an explicit --kernel stays
+        # strict — silent demotion would mislabel the bench.
+        runner = engine.make_runner(grid.shape, config, mesh,
+                                    args.kernel or "auto")
+    # compile_runner, not runner.lower(): on a fallback-ladder runner a
+    # Mosaic compile failure must demote (packed -> packed-jnp -> lax)
+    # exactly as the CLI path does, not crash the bench.
+    compiled = engine.compile_runner(runner, device_grid)
+    # Post-compile, the ladder has settled: report the kernel that will
+    # actually be measured (a demotion makes the pre-resolved header line
+    # stale), and carry it in the JSON record.
+    kernel = getattr(runner, "kernel_name", kernel)
+    print(f"bench: compiled kernel={kernel}", file=sys.stderr)
 
     best_s = float("inf")
     generations = 0
@@ -528,6 +541,8 @@ def main(argv: list[str] | None = None) -> int:
                 # across rounds as the kernels outgrew dispatch overhead)
                 "grid": f"{args.size}x{args.size}",
                 "chips": n_chips,
+                # The post-compile (ladder-settled) kernel actually measured.
+                "kernel": kernel,
             }
         )
     )

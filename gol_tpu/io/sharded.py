@@ -128,5 +128,32 @@ def read_gathered(path: str, width: int, height: int, mesh: Mesh | None) -> jax.
 
 
 def write_gathered(path: str, grid: jax.Array) -> None:
-    """Gather-to-master write (src/game_mpi.c:429-467)."""
+    """Gather-to-master write (src/game_mpi.c:429-467).
+
+    Multi-process: ``jax.device_get`` on the global array would raise on the
+    non-addressable shards, so each process assembles its addressable
+    windows and the full grid is reconstructed on every host with
+    ``multihost_utils.process_allgather`` — the reference's
+    MPI_Recv-per-rank gather loop (src/game_mpi.c:441-458) — and the lead
+    process writes serially, like its rank 0 (src/game_mpi.c:462). The
+    closing barrier keeps peers from reading a half-written file. Every
+    host briefly holds the full grid; that is this debug lane's contract
+    (the reference's rank 0 does too) — the collective/async lanes
+    (write_sharded) stay gather-free.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        height, width = grid.shape
+        local = np.zeros((height, width), np.uint8)
+        for shard in grid.addressable_shards:
+            local[shard.index] = np.asarray(shard.data, dtype=np.uint8)
+        stacked = np.asarray(multihost_utils.process_allgather(local))
+        # Each global cell is owned by >= 1 process (exactly one unless
+        # replicated); everyone else contributed zeros — max reassembles.
+        full = stacked.max(axis=0).astype(np.uint8)
+        if jax.process_index() == 0:
+            text_grid.write_grid(path, full)
+        multihost_utils.sync_global_devices("gol_tpu:write_gathered")
+        return
     text_grid.write_grid(path, np.asarray(jax.device_get(grid), dtype=np.uint8))

@@ -112,14 +112,18 @@ def choose_mesh_shape(
         if under_cap(c):
             return r, c
     r, c = pool[0]
-    import sys
+    import warnings
 
-    sys.stderr.write(
-        f"gol_tpu: no {n_devices}-device mesh factorization keeps shards "
-        f"within the temporal kernel's width cap ({_MAX_WORDS_T * _BITS} "
-        f"cells) for a width-{width} grid; defaulting to {r}x{c} on the "
-        "~2x slower per-generation kernel — pass an explicit --mesh to "
-        "choose the trade yourself\n"
+    # warnings.warn, not raw stderr (advisor r4): embedders/tests can
+    # filter it, and repeated make_mesh calls dedupe per call site.
+    warnings.warn(
+        f"no {n_devices}-device mesh factorization keeps shards within "
+        f"the temporal kernel's width cap ({_MAX_WORDS_T * _BITS} cells) "
+        f"for a width-{width} grid; defaulting to {r}x{c} on the ~2x "
+        "slower per-generation kernel — pass an explicit --mesh to choose "
+        "the trade yourself",
+        RuntimeWarning,
+        stacklevel=2,
     )
     return r, c
 

@@ -41,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Hardware-lane evidence artifact: GOL_TPU_HW=1 runs record every hardware
 # test's outcome to benchmarks/tpu_hw_r<N>.json so the "verified on v5e"
 # claims in kernel comments are auditable files, not git-log prose.
-_HW_ARTIFACT_ROUND = 4
+_HW_ARTIFACT_ROUND = 5
 _hw_results: list[dict] = []
 
 

@@ -67,6 +67,21 @@ def main() -> int:
             with open(os.path.join(workdir, f"gens_{kernel}.txt"), "w") as f:
                 f.write(str(generations))
 
+    # The master-scatter lane (C2, --variant mpi): every process parses the
+    # whole input (the scatter), and the gather-to-lead write reassembles
+    # the grid across processes via process_allgather — the one lane whose
+    # I/O is NOT window-disjoint, matching src/game_mpi.c:201-239,429-467.
+    device_grid = sharded.read_gathered(
+        os.path.join(workdir, "input.txt"), width, height, mesh
+    )
+    runner = engine.make_runner((height, width), config, mesh, "packed")
+    final, gen = runner(device_grid)
+    generations = int(gen)
+    sharded.write_gathered(os.path.join(workdir, "out_mpi.txt"), final)
+    if pid == 0:
+        with open(os.path.join(workdir, "gens_mpi.txt"), "w") as f:
+            f.write(str(generations))
+
     # The packed-I/O lane (C3's MPI-IO at word granularity): each process
     # packs/unpacks only its addressable file windows, word state end to end.
     from gol_tpu.io import packed_io

@@ -80,7 +80,7 @@ def cluster_run(request, tmp_path_factory):
 def test_process_cluster_matches_oracle(cluster_run):
     tmp_path, g = cluster_run
     expect = oracle.run(g, GameConfig(gen_limit=40))
-    for lane in ("lax", "packed", "packedio"):
+    for lane in ("lax", "packed", "mpi", "packedio"):
         got = text_grid.read_grid(str(tmp_path / f"out_{lane}.txt"), 64, 64)
         gens = int((tmp_path / f"gens_{lane}.txt").read_text())
         np.testing.assert_array_equal(np.asarray(got), expect.grid)

@@ -580,23 +580,25 @@ def test_split_edge_multi_band_and_folds(monkeypatch):
 
 def test_split_edge_routing(monkeypatch):
     """cols > 1 topologies with nwords >= 2 route _distributed_step_multi
-    through the split-edge form; single-word shards keep the ghost-plane
-    form (_step_tgb)."""
+    through the FAST split-edge form (with the topology threaded in, so
+    the summary vote sees the mesh); single-word shards keep the
+    ghost-plane form (_step_tgb)."""
     calls = []
-    real = sp._step_tsplit
+    real = sp._step_tsplit_fast
 
-    def spy(words, gtop, gbot, cols4, G_ext, interpret=False):
-        calls.append(words.shape)
-        return real(words, gtop, gbot, cols4, G_ext, interpret=interpret)
+    def spy(words, gtop, gbot, cols4, G_ext, topology=None, interpret=False):
+        calls.append((words.shape, topology))
+        return real(words, gtop, gbot, cols4, G_ext, topology=topology,
+                    interpret=interpret)
 
-    monkeypatch.setattr(sp, "_step_tsplit", spy)
+    monkeypatch.setattr(sp, "_step_tsplit_fast", spy)
     rng = np.random.default_rng(73)
     g = rng.integers(0, 2, size=(16, 128), dtype=np.uint8)
     words = sp.encode(jnp.asarray(g))
     from gol_tpu.parallel.mesh import PROXY_2D
 
     new, alive, _ = sp._distributed_step_multi(words, PROXY_2D, force_interp=True)
-    assert calls == [(16, 4)]
+    assert calls == [((16, 4), PROXY_2D)]
     expect = g
     for _ in range(sp.TEMPORAL_GENS):
         expect = oracle.evolve(expect)
@@ -742,6 +744,102 @@ def test_fast_flag_cross_shard_transient():
         g[rows, cols] = 1
         want = oracle.run(g, cfg)
         got = engine.simulate(g, cfg, mesh=make_mesh(4, 1),
+                              kernel="packed-interp")
+        assert got.generations == want.generations, (rows, cols)
+        np.testing.assert_array_equal(got.grid, want.grid)
+
+
+class TestSplitFastFlags:
+    """The fast-flag split-edge composition (_step_tsplit_fast) must be
+    bit-identical — state AND per-generation flag vectors — to the exact
+    split form across every monotone case, including life confined to the
+    edge columns (strip-owned summary) and mid-pass transitions (replay)."""
+
+    def _grids(self):
+        rng = np.random.default_rng(97)
+        soup = rng.integers(0, 2, size=(32, 128), dtype=np.uint8)
+        death = np.zeros((32, 128), np.uint8)
+        death[10, 10:12] = 1  # domino: dies at generation 1 (in-pass death)
+        onset = np.zeros((32, 128), np.uint8)
+        onset[10:12, 10] = onset[10, 11] = 1  # L-tromino -> block at gen 1
+        still = np.zeros((32, 128), np.uint8)
+        still[10:12, 10:12] = 1
+        empty = np.zeros((32, 128), np.uint8)
+        edge = np.zeros((32, 128), np.uint8)
+        edge[7:10, 1] = 1  # blinker inside the west edge word: only the
+        edge[3:5, 126:128] = 1  # strip's summary sees any of this
+        edge_death = np.zeros((32, 128), np.uint8)
+        edge_death[10, 126:128] = 1  # domino in the east edge word
+        return {"soup": soup, "death": death, "onset": onset, "still": still,
+                "empty": empty, "edge": edge, "edge_death": edge_death}
+
+    def test_split_fast_matches_exact(self):
+        for name, g in self._grids().items():
+            words = sp.encode(jnp.asarray(g))
+            ops = sp._tsplit_operands(words, SINGLE_DEVICE)
+            new_e, a_e, s_e = sp._step_tsplit(words, *ops, interpret=True)
+            new_f, a_f, s_f = sp._step_tsplit_fast(words, *ops, interpret=True)
+            np.testing.assert_array_equal(
+                np.asarray(new_f), np.asarray(new_e), err_msg=name)
+            assert np.asarray(a_f).tolist() == np.asarray(a_e).tolist(), name
+            assert np.asarray(s_f).tolist() == np.asarray(s_e).tolist(), name
+
+    def test_split_fast_derivation_against_oracle(self):
+        for name, g in self._grids().items():
+            words = sp.encode(jnp.asarray(g))
+            ops = sp._tsplit_operands(words, SINGLE_DEVICE)
+            _, a_f, s_f = sp._step_tsplit_fast(words, *ops, interpret=True)
+            states = [g]
+            for _ in range(sp.TEMPORAL_GENS):
+                states.append(oracle.evolve(states[-1]))
+            for t in range(sp.TEMPORAL_GENS):
+                assert int(a_f[t]) == int(states[t + 1].any()), (name, t)
+                assert int(s_f[t]) == int(
+                    np.array_equal(states[t + 1], states[t])), (name, t)
+
+    def test_split_fast_multi_band_and_folds(self, monkeypatch):
+        # Banding engaged in both fast passes at a non-power-of-two fold
+        # count (distinct shape from the exact-form test so the patched
+        # band constant is read at a fresh trace).
+        h, w = 48, 224
+        rng = np.random.default_rng(101)
+        g = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
+        monkeypatch.setattr(sp, "_BANDT_BYTES", 8 << 10)
+        words = sp.encode(jnp.asarray(g))
+        ops = sp._tsplit_operands(words, SINGLE_DEVICE)
+        new_f, a_f, s_f = sp._step_tsplit_fast(words, *ops, interpret=True)
+        states = [g]
+        for _ in range(sp.TEMPORAL_GENS):
+            states.append(oracle.evolve(states[-1]))
+        np.testing.assert_array_equal(np.asarray(sp.decode(new_f)), states[-1])
+        for t in range(sp.TEMPORAL_GENS):
+            assert int(a_f[t]) == int(states[t + 1].any()), t
+            assert int(s_f[t]) == int(
+                np.array_equal(states[t + 1], states[t])), t
+
+
+def test_split_fast_cross_shard_transient():
+    """The split-composition analog of test_fast_flag_cross_shard_transient
+    on an R x C mesh with C > 1: transients clustered on BOTH shard seams
+    (row 32, column 128) of a 2x2 mesh die inside a temporal pass, so
+    per-shard summaries lie about stillness. Cases found by simulating the
+    derivation + blocked replay from oracle states over random seeds
+    (tools/search_split_transient.py): deriving from UNVOTED per-shard
+    summaries reports 3 and 1 generations respectively; the shipped
+    globally-voted derivation must match the oracle (4 and 3)."""
+    from gol_tpu.parallel.mesh import make_mesh
+
+    cfg = GameConfig(gen_limit=30, similarity_frequency=1)
+    cases = [
+        ([32, 33, 32, 32, 34, 33, 34, 32, 32, 31, 34, 32, 34],
+         [130, 128, 125, 127, 128, 129, 128, 129, 131, 131, 124, 130, 132]),
+        ([32, 33, 32, 34, 34, 31], [130, 131, 127, 130, 131, 129]),
+    ]
+    for rows, cols in cases:
+        g = np.zeros((64, 256), np.uint8)
+        g[rows, cols] = 1
+        want = oracle.run(g, cfg)
+        got = engine.simulate(g, cfg, mesh=make_mesh(2, 2),
                               kernel="packed-interp")
         assert got.generations == want.generations, (rows, cols)
         np.testing.assert_array_equal(got.grid, want.grid)

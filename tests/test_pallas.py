@@ -71,11 +71,33 @@ def test_auto_resolution_on_cpu():
     # kernel, but packed still wins where it fits — its off-TPU hot paths are
     # the jnp adder network, 18x the lax stencil on CPU at 4096².
     assert resolve_kernel("auto", 4096, 4096, SINGLE_DEVICE).name == "packed"
-    # Shapes the packed kernel can't take (width not a multiple of 32, or
-    # lane-misaligned heights on one device) fall back to lax, never pallas.
+    # Widths that don't pack fall back to lax, never pallas.
     assert resolve_kernel("auto", 4096, 4090, SINGLE_DEVICE).name == "lax"
-    assert resolve_kernel("auto", 30, 4096, SINGLE_DEVICE).name == "lax"
+    # Lane-misaligned heights on one device can't tile the compiled Pallas
+    # kernel but still pack: the jnp word network, not byte lax (r4 verdict
+    # weak #5 — distributed shards always had this; now single-device too).
+    assert resolve_kernel("auto", 30, 4096, SINGLE_DEVICE).name == "packed-jnp"
+    assert resolve_kernel("auto", 100, 128, SINGLE_DEVICE).name == "packed-jnp"
     assert get_kernel("pallas").name == "pallas"
+
+
+def test_auto_packed_jnp_odd_height_matches_oracle():
+    """The auto lane's odd-height single-device route (packed-jnp) is
+    oracle-identical end to end, temporal blocking engaged (its relaxed
+    supports_multi admits any single-device packing shape)."""
+    from gol_tpu import engine
+    from gol_tpu.config import GameConfig
+    from gol_tpu.ops import stencil_packed as sp
+
+    assert sp.supports_multi_jnp(100, 128, SINGLE_DEVICE)
+    assert not sp.supports(100, 128, SINGLE_DEVICE)
+    rng = np.random.default_rng(31)
+    g = rng.integers(0, 2, size=(100, 128), dtype=np.uint8)
+    cfg = GameConfig(gen_limit=25)
+    got = engine.simulate(g, cfg)  # kernel='auto'
+    want = oracle.run(g, cfg)
+    assert got.generations == want.generations
+    np.testing.assert_array_equal(got.grid, want.grid)
 
 
 def test_misaligned_distributed_pallas_rejected():

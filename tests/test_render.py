@@ -112,3 +112,42 @@ def test_bench_workload_resolution():
         assert (a.size, a.packed_state) == (16384, False), flags
     a = resolve("--size", "4096")
     assert (a.size, a.packed_state) == (4096, False)
+
+
+def test_bench_aot_compile_demotes(monkeypatch, capsys):
+    """bench.py compiles through engine.compile_runner on a ladder runner
+    (VERDICT r4 weak #4): a Mosaic-shaped compile failure in the packed
+    kernel demotes down the ladder exactly as the CLI path does — the
+    bench records the fallback kernel instead of crashing."""
+    import bench
+    from gol_tpu import engine
+    from gol_tpu.ops import stencil_packed
+
+    orig_step = stencil_packed.packed_step
+    orig_multi = stencil_packed.packed_step_multi
+
+    def step(cur, topo, *, force_jnp=False, force_interp=False):
+        if not force_jnp:
+            raise RuntimeError("simulated Mosaic compile OOM")
+        return orig_step(cur, topo, force_jnp=True)
+
+    def multi(cur, topo, *, force_jnp=False, force_interp=False):
+        if not force_jnp:
+            raise RuntimeError("simulated Mosaic compile OOM")
+        return orig_multi(cur, topo, force_jnp=True)
+
+    monkeypatch.setattr(stencil_packed, "packed_step", step)
+    monkeypatch.setattr(stencil_packed, "packed_step_multi", multi)
+    from gol_tpu import engine as _e
+
+    _e.make_runner.cache_clear()
+    try:
+        rc = bench.main(["--size", "64", "--gen-limit", "5", "--repeats", "1"])
+    finally:
+        _e.make_runner.cache_clear()
+    out = capsys.readouterr()
+    assert rc == 0, out.err
+    assert "falling back to 'packed-jnp'" in out.err
+    line = json.loads(out.out.strip().splitlines()[-1])
+    assert line["metric"] == "cell_updates_per_sec_per_chip"
+    assert line["value"] > 0

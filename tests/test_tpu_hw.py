@@ -191,6 +191,34 @@ def test_split_edge_form_compiled_matches():
     assert np.asarray(alive).tolist() == [1] * sp.TEMPORAL_GENS
 
 
+def test_split_fast_form_compiled_matches():
+    # The r5 fast-flag split composition compiled on the chip: joint
+    # strip+main summaries on soup (no replay), and a mid-pass death with
+    # the transient INSIDE an edge word column — the strip summary alone
+    # sees the in_alive -> out_alive transition, so the joint derivation
+    # must fire the exact-replay lax.cond and reproduce the oracle's flag
+    # vectors.
+    rng = np.random.default_rng(19)
+    g = rng.integers(0, 2, size=(512, 4096), dtype=np.uint8)
+    words = sp.encode(jnp.asarray(g))
+    ops = sp._tsplit_operands(words, SINGLE_DEVICE)
+    new, alive, similar = sp._step_tsplit_fast(words, *ops)
+    cur = words
+    for _ in range(sp.TEMPORAL_GENS):
+        cur = packed_math.evolve_torus_words(cur)
+    assert np.array_equal(np.asarray(new), np.asarray(cur))
+    assert np.asarray(alive).tolist() == [1] * sp.TEMPORAL_GENS
+    assert np.asarray(similar).tolist() == [0] * sp.TEMPORAL_GENS
+
+    g2 = np.zeros((512, 4096), np.uint8)
+    g2[100, 4064:4066] = 1  # domino in the east edge word: dies at gen 1
+    words2 = sp.encode(jnp.asarray(g2))
+    ops2 = sp._tsplit_operands(words2, SINGLE_DEVICE)
+    _, a_vec, s_vec = sp._step_tsplit_fast(words2, *ops2)
+    assert np.asarray(a_vec).tolist() == [0] * sp.TEMPORAL_GENS
+    assert np.asarray(s_vec).tolist() == [0] + [1] * (sp.TEMPORAL_GENS - 1)
+
+
 def test_fast_flag_pass_shapes_compile_and_match():
     # The fast-flag kernels' scoped-VMEM footprint is schedule-sensitive
     # (1024/2048-row bands OOMed where the exact kernel fit — hence the

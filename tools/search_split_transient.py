@@ -1,0 +1,153 @@
+"""Search for cross-shard-transient counterexamples on an R x C mesh.
+
+The fast-flag derivation is only sound on the GLOBAL pass summary (a shard
+is an open system; see stencil_packed._derive_or_replay). This searcher
+finds concrete grids where the UNVOTED per-shard derivation would make the
+engine exit on the wrong generation under the split-edge 2D form — pinning
+material for tests/test_packed.py's split-composition transient test (the
+R x C analog of test_fast_flag_cross_shard_transient).
+
+Pure NumPy: the derivation + engine replay are simulated from oracle
+states, so thousands of candidates run in seconds; hits are then validated
+through the real packed-interp engine path by the test itself.
+
+Usage: python tools/search_split_transient.py [n_seeds]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from gol_tpu import oracle  # noqa: E402
+
+T = 8  # stencil_packed.TEMPORAL_GENS
+BLOCK = 16  # engine._TERMINATION_BLOCK
+
+
+def shard_views(g, rows, cols):
+    H, W = g.shape
+    hs, ws = H // rows, W // cols
+    return [
+        g[r * hs : (r + 1) * hs, c * ws : (c + 1) * ws]
+        for r in range(rows)
+        for c in range(cols)
+    ]
+
+
+def run_engine_sim(g0, rows, cols, gen_limit, voted):
+    """Simulate the blocked C-convention engine with fast-flag passes of T
+    generations, similarity_frequency=1. Returns the reported generation
+    count. ``voted``: derive from the global summary (shipped behavior) or
+    per shard (the broken form the vote exists to prevent)."""
+    states = [g0.astype(np.uint8)]
+    # Enough states for the whole bounded run.
+    for _ in range(gen_limit + BLOCK + 1):
+        states.append(oracle.evolve(states[-1]))
+
+    def flags_for_pass(p0):
+        """(alive_vec, similar_vec) for the pass covering states p0..p0+T."""
+        n = rows * cols
+        summaries = []  # per shard: in_alive, out_alive, simT, sim1
+        for s in range(n):
+            sv = [shard_views(states[p0 + k], rows, cols)[s] for k in range(T + 1)]
+            in_alive = int(sv[0].any())
+            out_alive = int(sv[T].any())
+            sim1 = int(np.array_equal(sv[1], sv[0]))
+            simT = int(np.array_equal(sv[T], sv[T - 1]))
+            summaries.append((in_alive, out_alive, simT, sim1))
+        if voted:
+            in_a = max(s[0] for s in summaries)
+            out_a = max(s[1] for s in summaries)
+            simT = min(s[2] for s in summaries)
+            sim1 = min(s[3] for s in summaries)
+            summaries = [(in_a, out_a, simT, sim1)] * n
+        a_vecs, s_vecs = [], []
+        for s, (in_a, out_a, simT, sim1) in enumerate(summaries):
+            need = (in_a == 1 and out_a == 0) or (simT == 1 and sim1 == 0)
+            if need:  # exact replay: true per-generation local flags
+                sv = [shard_views(states[p0 + k], rows, cols)[s] for k in range(T + 1)]
+                a = [int(sv[k + 1].any()) for k in range(T)]
+                sm = [int(np.array_equal(sv[k + 1], sv[k])) for k in range(T)]
+            else:
+                a = [out_a] * T
+                sm = [simT] * T
+            a_vecs.append(a)
+            s_vecs.append(sm)
+        alive = [max(v[k] for v in a_vecs) for k in range(T)]
+        similar = [min(v[k] for v in s_vecs) for k in range(T)]
+        return alive, similar
+
+    # Blocked C loop, freq=1 (fires every generation).
+    gen, completed = 1, 0
+    alive = bool(g0.any())
+    similar = False
+    while alive and not similar and gen <= gen_limit:
+        t = min(BLOCK, gen_limit - gen + 1)
+        a_all, s_all = [], []
+        for j in range(t // T):
+            a, s = flags_for_pass(completed + T * j)
+            a_all += a
+            s_all += s
+        for k in range(t % T):
+            st = states[completed + (t // T) * T + k + 1]
+            pv = states[completed + (t // T) * T + k]
+            a_all.append(int(st.any()))
+            s_all.append(int(np.array_equal(st, pv)))
+        # scalar replay
+        for i in range(t):
+            sim_i = bool(s_all[i])
+            alive = bool(a_all[i])
+            if not sim_i:
+                gen += 1
+            similar = sim_i
+            if not (alive and not sim_i and gen <= gen_limit):
+                break
+        completed += i + 1
+    return gen - 1
+
+
+def main():
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 3000
+    rows, cols = 2, 2
+    H, W = 64, 256  # 32x128 shards: nwords=4 >= 2 -> split-edge form
+    gen_limit = 30
+    rng = np.random.default_rng(0)
+    hits = []
+    for seed in range(n_seeds):
+        r = np.random.default_rng(seed)
+        g = np.zeros((H, W), np.uint8)
+        # Sparse cells clustered near the column seam (W//2) and a row seam
+        # (H//2): transients must CROSS shard boundaries to make a local
+        # summary lie.
+        n_cells = int(r.integers(6, 14))
+        rr = r.integers(H // 2 - 4, H // 2 + 4, size=n_cells)
+        cc = r.integers(W // 2 - 5, W // 2 + 5, size=n_cells)
+        g[rr, cc] = 1
+        want = run_engine_sim(g, rows, cols, gen_limit, voted=True)
+        broken = run_engine_sim(g, rows, cols, gen_limit, voted=False)
+        if want != broken:
+            # Sanity: voted must equal the true oracle count.
+            true = oracle.run(g, __import__("gol_tpu.config", fromlist=["GameConfig"]).GameConfig(gen_limit=gen_limit, similarity_frequency=1)).generations
+            hits.append((seed, sorted(set(map(int, rr))), sorted(set(map(int, cc))), want, broken, true))
+            print(f"seed {seed}: voted={want} broken={broken} oracle={true} "
+                  f"rows={sorted(set(map(int,rr)))} cols={sorted(set(map(int,cc)))}")
+            if len(hits) >= 4:
+                break
+    if not hits:
+        print("no counterexample found", file=sys.stderr)
+        return 1
+    for seed, rr, cc, want, broken, true in hits:
+        r = np.random.default_rng(seed)
+        n_cells = int(r.integers(6, 14))
+        rrr = r.integers(H // 2 - 4, H // 2 + 4, size=n_cells).tolist()
+        ccc = r.integers(W // 2 - 5, W // 2 + 5, size=n_cells).tolist()
+        print(f"  pin: rows={rrr} cols={ccc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
