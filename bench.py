@@ -29,9 +29,12 @@ def _honor_platform_env() -> None:
     gol_tpu.cli — pulling cli here would load every jax-importing module
     before the re-application, the ordering hazard the helper exists to
     prevent."""
-    from gol_tpu.platform_env import honor_platform_env
+    from gol_tpu.platform_env import configure_cli_logging, honor_platform_env
 
     honor_platform_env()
+    # Kernel-demotion warnings and IO-retry notices must reach stderr here
+    # exactly as in the CLI — stdout stays reserved for the one JSON line.
+    configure_cli_logging()
 
 TARGET_CELL_UPDATES_PER_SEC_PER_CHIP = 1e11  # BASELINE.md north star
 
@@ -60,6 +63,7 @@ def _bench_halo(args) -> int:
         MESH_TOPOLOGY_AXES,
         grid_sharding,
         make_mesh,
+        shard_map,
         topology_for,
     )
 
@@ -96,7 +100,7 @@ def _bench_halo(args) -> int:
 
     @jax.jit
     def exchange_once(g):
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=mesh,
             in_specs=jax.sharding.PartitionSpec(*MESH_TOPOLOGY_AXES),
@@ -128,7 +132,7 @@ def _bench_halo(args) -> int:
     if sp.supports_multi(local_h, local_w, topo):
         spec = jax.sharding.PartitionSpec(*MESH_TOPOLOGY_AXES)
         words = jax.jit(
-            jax.shard_map(packed_math.encode, mesh=mesh,
+            shard_map(packed_math.encode, mesh=mesh,
                           in_specs=spec, out_specs=spec)
         )(device_grid)
 
@@ -137,7 +141,7 @@ def _bench_halo(args) -> int:
 
         @jax.jit
         def deep_once(w):
-            return jax.shard_map(deep_body, mesh=mesh,
+            return shard_map(deep_body, mesh=mesh,
                                  in_specs=spec,
                                  out_specs=jax.sharding.PartitionSpec())(w)
 

@@ -36,7 +36,7 @@ import time
 
 import numpy as np
 
-from gol_tpu.platform_env import honor_platform_env
+from gol_tpu.platform_env import configure_cli_logging, honor_platform_env
 
 # Applied at import time, before the jax-importing gol_tpu modules below
 # load — main() calls it again (idempotent), but the import-time call is
@@ -131,7 +131,63 @@ def _write_phase(variant: Variant, path: str, grid) -> None:
         sharded.write_sharded(path, grid, parallel=(variant.io == "sharded_async"))
 
 
+def _checkpointing(args) -> bool:
+    # `is not None`, not truthiness: --checkpoint-every 0 must reach the
+    # validator and be rejected loudly, not silently disable the lane.
+    return (
+        args.checkpoint_every is not None
+        or args.auto_resume
+        or args.checkpoint_dir is not None
+    )
+
+
+def _validate_checkpoint_args(args) -> None:
+    """Normalize and cross-check the crash-safety flags before any lane runs
+    (so a contradictory combination never half-starts a checkpoint dir)."""
+    if not _checkpointing(args):
+        return
+    if args.checkpoint_dir is None:
+        args.checkpoint_dir = "./checkpoints"
+    if args.checkpoint_every is None and not args.auto_resume:
+        raise ValueError(
+            "--checkpoint-dir needs --checkpoint-every N (write checkpoints) "
+            "and/or --auto-resume (restart from the newest one)"
+        )
+    if args.checkpoint_every is not None and args.checkpoint_every <= 0:
+        raise ValueError(
+            f"--checkpoint-every must be positive, got {args.checkpoint_every}"
+        )
+    if args.checkpoint_keep < 1:
+        raise ValueError(
+            f"--checkpoint-keep must be >= 1, got {args.checkpoint_keep}"
+        )
+    if args.snapshot_every:
+        raise ValueError(
+            "checkpointing does not compose with --snapshot-every: a "
+            "checkpoint IS a resumable snapshot plus a crash-consistent "
+            "manifest — use one or the other"
+        )
+    if args.auto_resume and args.resume_gen:
+        raise ValueError(
+            "--auto-resume discovers the resume generation from the "
+            "checkpoint manifests; --resume-gen contradicts it"
+        )
+    if args.host:
+        raise ValueError(
+            "checkpointing rides the segmented device loop; --host has none"
+        )
+
+
 def _run(args) -> int:
+    from gol_tpu.resilience import faults
+
+    if args.fault_plan:
+        faults.install(faults.FaultPlan.parse(args.fault_plan))
+    else:
+        # from_env() is None when GOL_FAULTS is unset, so a plan armed by a
+        # previous in-process run (the crash-recovery harness) is cleared —
+        # each run gets exactly the faults IT asked for.
+        faults.install(faults.FaultPlan.from_env())
     variant = get_variant(args.variant)
     width, height = atoi(args.width), atoi(args.height)
     if variant.force_square:
@@ -155,6 +211,7 @@ def _run(args) -> int:
     )
     output_path = args.output or f"./{variant.output_file}"
 
+    _validate_checkpoint_args(args)
     if args.resume_gen < 0:
         raise ValueError(f"--resume-gen must be >= 0, got {args.resume_gen}")
     if args.resume_gen > config.gen_limit:
@@ -231,7 +288,10 @@ def _run(args) -> int:
     if variant.io_timings:
         print(f"Reading file:\t{read_ms:.2f} msecs")
 
-    if args.snapshot_every:
+    if _checkpointing(args):
+        run_fn = _prepare_checkpointed(args, variant, config, mesh, device_grid,
+                                       height, width, packed=False)
+    elif args.snapshot_every:
         run_fn = _prepare_segmented(args, variant, config, mesh, device_grid, height, width)
     elif args.resume_gen:
         run_fn = _prepare_resumed(args, config, mesh, device_grid, height, width,
@@ -300,7 +360,10 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
     if variant.io_timings:
         print(f"Reading file:\t{read_ms:.2f} msecs")
 
-    if args.snapshot_every:
+    if _checkpointing(args):
+        run_fn = _prepare_checkpointed(args, variant, config, mesh, words,
+                                       height, width, packed=True)
+    elif args.snapshot_every:
         run_fn = _prepare_packed_segmented(args, config, mesh, words, height, width)
     elif args.resume_gen:
         run_fn = _prepare_resumed(args, config, mesh, words, height, width,
@@ -392,6 +455,107 @@ def _prepare_resumed(args, config, mesh, state, height, width, *, packed, kernel
             state, jnp.int32(gen0), jnp.int32(counter0), jnp.int32(config.gen_limit)
         )
         return final, report(int(gen))
+
+    return run_fn
+
+
+def _checkpoint_codec(args, variant, mesh, width, height):
+    """Payload encoding for the checkpoint lane: the packed lane stores the
+    bitpacked words (zarr when tensorstore is available — every host writes
+    only its shards — else the packed text codec); the byte lane stores a
+    text grid through the variant's own I/O strategy. All three are
+    topology-independent, so checkpoints restore across mesh changes."""
+    from gol_tpu.resilience.checkpoint import PayloadCodec
+
+    if args.packed_io:
+        from gol_tpu.io import packed_io, ts_store
+
+        if ts_store.HAVE_TENSORSTORE:
+            return PayloadCodec(
+                format="zarr-words",
+                suffix=".zarr",
+                write=lambda path, state: ts_store.write_words(path, state, width),
+                read=lambda path: ts_store.read_words(path, width, height, mesh),
+                self_retrying=True,  # ts_store runs DEFAULT_IO_RETRY itself
+            )
+        return PayloadCodec(
+            format="packed-text",
+            suffix=".out",
+            write=lambda path, state: packed_io.write_packed(path, state, width),
+            read=lambda path: packed_io.read_packed(path, width, height, mesh),
+        )
+    return PayloadCodec(
+        format="text-grid",
+        suffix=".out",
+        write=lambda path, state: _write_phase(variant, path, state),
+        read=lambda path: _read_phase(variant, path, width, height, mesh),
+    )
+
+
+def _prepare_checkpointed(args, variant, config, mesh, state, height, width, *,
+                          packed):
+    """The crash-safe lane: --checkpoint-every writes an atomic checkpoint
+    (fresh payload + manifest committed last; resilience/checkpoint.py) at
+    every segment boundary, and --auto-resume restarts from the newest
+    manifest every process can read — no --resume-gen arithmetic. Resumed
+    runs are bit-exact with uninterrupted ones: the segmented loop carries
+    the exact resume scalars (engine.resume_scalars), so the final output
+    file and the reported Generations are byte-identical either way.
+    """
+    import jax.numpy as jnp
+
+    from gol_tpu.resilience.checkpoint import CheckpointManager, run_fingerprint
+
+    mgr = CheckpointManager(
+        args.checkpoint_dir,
+        height=height,
+        width=width,
+        codec=_checkpoint_codec(args, variant, mesh, width, height),
+        keep=args.checkpoint_keep,
+        # Fingerprinted on the INITIAL state (before any restore): a reused
+        # checkpoint dir holding a different input's checkpoints must never
+        # hand that run's state to this one.
+        run_fingerprint=run_fingerprint(state, tag=config.convention),
+    )
+    completed = args.resume_gen
+    if args.auto_resume:
+        # Checkpoints past --gen-limit are skipped, mirroring the
+        # --resume-gen validator: a rerun with a reduced limit resumes from
+        # the newest checkpoint at or below it, or starts fresh.
+        restored = mgr.restore(max_generation=config.gen_limit)
+        if restored is not None:
+            state, info = restored
+            completed = info.generation
+
+    runner = (
+        engine.make_packed_segment_runner((height, width), config, mesh)
+        if packed
+        else engine.make_segment_runner((height, width), config, mesh, args.kernel)
+    )
+    gen0, counter0 = engine.resume_scalars(config, completed)
+    _, g, _, _ = runner(state, jnp.int32(gen0), jnp.int32(counter0), jnp.int32(0))
+    int(g)  # zero-step call: compile + program upload outside the timer
+
+    segment = args.checkpoint_every or max(1, config.gen_limit)
+    if packed:
+        segments = lambda: engine.simulate_packed_segments(
+            state, (height, width), config, mesh, segment, completed=completed
+        )
+    else:
+        segments = lambda: engine.simulate_segments(
+            state, config, mesh, args.kernel, segment, completed=completed
+        )
+
+    def run_fn():
+        final, generations = state, completed
+        for generations, final, stopped in segments():
+            if args.checkpoint_every and not stopped:
+                # Early-exited states are final output, not mid-run state —
+                # a checkpoint of one would replay as mid-run on resume and
+                # change the reported count (the --resume-gen caveat).
+                _, counter = engine.resume_scalars(config, generations)
+                mgr.save(final, generations, counter)
+        return final, generations
 
     return run_fn
 
@@ -604,6 +768,44 @@ def build_parser() -> argparse.ArgumentParser:
         help="stream the file directly to/from bitpacked device state via the "
         "native codec (width must divide by 32 x mesh cols)",
     )
+    run.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=None,
+        metavar="N",
+        help="write a crash-consistent checkpoint (fresh payload + atomically "
+        "committed manifest) every N generations; a crash at any point "
+        "leaves the newest prior checkpoint readable",
+    )
+    run.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        metavar="D",
+        help="checkpoint directory (default ./checkpoints)",
+    )
+    run.add_argument(
+        "--checkpoint-keep",
+        type=int,
+        default=2,
+        metavar="K",
+        help="retain the K newest checkpoints (default 2; >= 1)",
+    )
+    run.add_argument(
+        "--auto-resume",
+        action="store_true",
+        help="restart from the newest valid checkpoint manifest in "
+        "--checkpoint-dir (every process must be able to read it on "
+        "multihost runs) — no --resume-gen arithmetic; resumed runs are "
+        "bit-exact with uninterrupted ones",
+    )
+    run.add_argument(
+        "--fault-plan",
+        default=None,
+        metavar="SPEC",
+        help="fault injection for the crash-recovery harness, k=v comma "
+        "list (see gol_tpu/resilience/faults.py; also honored from the "
+        "GOL_FAULTS env var). Testing only.",
+    )
     run.set_defaults(func=_run)
 
     shw = sub.add_parser("show", help="render a grid in the terminal (VT100, src/game.c:42-58)")
@@ -626,6 +828,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     honor_platform_env()
+    configure_cli_logging()
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in ("run", "generate", "show", "-h", "--help"):

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -30,14 +31,18 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.config import Convention, DEFAULT_CONFIG, GameConfig
+from gol_tpu.resilience.retry import RetryPolicy
 from gol_tpu.ops import Kernel, fallback_chain, resolve_kernel
 from gol_tpu.parallel import collectives
 from gol_tpu.parallel.mesh import (
     Topology,
     grid_sharding,
+    shard_map,
     topology_for,
     validate_grid,
 )
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -82,7 +87,9 @@ def _similarity_vote(fire, cur, new, similar_local, topology: Topology):
     # The compare's output is device-varying under shard_map; the False arm
     # must be cast to match (vma tracking rejects mixed-variance branches).
     false_arm = jnp.asarray(False)
-    if topology.distributed:
+    if topology.distributed and hasattr(jax.lax, "pcast"):
+        # Older jax has no vma tracking (and no pcast) — there the plain
+        # False arm is already accepted, so skipping the cast is exact.
         false_arm = jax.lax.pcast(false_arm, topology.axes, to="varying")
     sim_local = jax.lax.cond(
         fire,
@@ -486,7 +493,8 @@ class _KernelFallback:
     shape (src/game.c:224-245 runs anything malloc can hold); this wrapper
     matches that bar: on a first-call *compile* failure (``
     _is_compile_failure`` — user errors like wrong-shaped operands still
-    raise) it warns on stderr and retries with the next kernel
+    raise) it logs a warning (the ``gol_tpu.engine`` logger; the CLI routes
+    it to stderr) and retries with the next kernel
     (packed -> packed-jnp -> lax). Once any call has succeeded the ladder is
     frozen — later failures are real errors and propagate (a mid-run
     demotion would silently change the measured kernel).
@@ -514,56 +522,63 @@ class _KernelFallback:
         """The currently-selected ladder entry (telemetry/tests)."""
         return self._names[self._idx]
 
+    # Per-ladder-entry retry for tunnel-wrapper-only failures: 2 attempts,
+    # no backoff (the remote helper either restarted or it didn't — see
+    # _TUNNEL_ONLY_MARKS). The same RetryPolicy machinery wraps tensorstore
+    # IO and the multihost create barrier (gol_tpu/resilience/retry.py), so
+    # there is exactly one retry implementation in the tree.
+    _TUNNEL_RETRY = RetryPolicy(attempts=2, base_delay=0.0)
+
     def _attempt(self, thunk):
         """Run ``thunk`` against the current ladder entry, demoting on
         compile-shaped failures — the single copy of the ladder policy,
         shared by ``__call__`` and ``compile_aot``."""
-        import sys
 
-        retried_idx = -1  # one tunnel-outage retry per ladder entry
+        def log_tunnel_retry(attempt, err, _delay):
+            # Full error text in the log record (advisor r4): enough to
+            # distinguish a real VMEM blowup from an infra outage after the
+            # fact — logging handlers, not this site, decide any truncation.
+            logger.warning(
+                "kernel %r compile failed for %s with only attach-tunnel "
+                "helper marks (transient helper outage?); retrying once "
+                "before demoting (%s: %s)",
+                self._names[self._idx], self._context,
+                type(err).__name__, err,
+            )
+
         while True:
             try:
-                out = thunk()
+                out = self._TUNNEL_RETRY.call(
+                    thunk,
+                    retryable=lambda e: (
+                        not self._settled and _is_tunnel_wrapper_only(e)
+                    ),
+                    on_retry=log_tunnel_retry,
+                )
             except Exception as err:
-                if (
-                    not self._settled
-                    and _is_tunnel_wrapper_only(err)
-                    and retried_idx != self._idx
-                ):
-                    retried_idx = self._idx
-                    sys.stderr.write(
-                        f"gol_tpu: kernel {self._names[self._idx]!r} compile "
-                        f"failed for {self._context} with only attach-tunnel "
-                        "helper marks (transient helper outage?); retrying "
-                        f"once before demoting ({type(err).__name__}: "
-                        f"{str(err)[:500]})\n"
-                    )
-                    continue
                 demotable = (
                     not self._settled
                     and self._idx + 1 < len(self._names)
                     and _is_compile_failure(err)
                 )
                 if demotable and jax.process_count() > 1:
-                    sys.stderr.write(
-                        f"gol_tpu: kernel {self._names[self._idx]!r} failed "
-                        f"to compile for {self._context}, but this is a "
-                        f"{jax.process_count()}-process run — refusing the "
-                        "process-local demotion (peers may have compiled; "
-                        "mixed kernels deadlock at the next collective). "
-                        "Pick the fallback explicitly on every process.\n"
+                    logger.error(
+                        "kernel %r failed to compile for %s, but this is a "
+                        "%d-process run — refusing the process-local "
+                        "demotion (peers may have compiled; mixed kernels "
+                        "deadlock at the next collective). Pick the "
+                        "fallback explicitly on every process.",
+                        self._names[self._idx], self._context,
+                        jax.process_count(),
                     )
                     raise
                 if not demotable:
                     raise
-                # Enough of the error to distinguish a real VMEM blowup from
-                # an infra outage when reading logs after the fact
-                # (advisor r4).
-                sys.stderr.write(
-                    f"gol_tpu: kernel {self._names[self._idx]!r} failed to "
-                    f"compile for {self._context}; falling back to "
-                    f"{self._names[self._idx + 1]!r} "
-                    f"({type(err).__name__}: {str(err)[:500]})\n"
+                logger.warning(
+                    "kernel %r failed to compile for %s; falling back to "
+                    "%r (%s: %s)",
+                    self._names[self._idx], self._context,
+                    self._names[self._idx + 1], type(err).__name__, err,
                 )
                 self._idx += 1
                 continue
@@ -674,7 +689,7 @@ def _build_runner(
             out_specs = (P(*topology.axes), P())
 
         if topology.distributed:
-            fn = jax.shard_map(
+            fn = shard_map(
                 local_fn,
                 mesh=mesh,
                 in_specs=in_specs,

@@ -25,6 +25,7 @@ from gol_tpu import native
 from gol_tpu.io.text_grid import create_sized, row_stride
 from gol_tpu.ops.packed_math import BITS
 from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
+from gol_tpu.resilience import STAGING_SUFFIX
 
 
 def words_sharding(mesh: Mesh) -> NamedSharding:
@@ -139,12 +140,23 @@ def read_packed(path: str, width: int, height: int, mesh: Mesh | None = None) ->
 
 
 def write_packed(path: str, words: jax.Array, width: int) -> None:
-    """Bitpacked device array -> text grid file (no gather, no cell bytes)."""
+    """Bitpacked device array -> text grid file (no gather, no cell bytes).
+
+    Single-process writes are crash-consistent: the bytes land in a
+    ``<path>.inprogress`` sibling that atomically replaces ``path`` only
+    once complete, so overwriting a prior snapshot can never leave a torn
+    file as the only copy. Multi-process runs keep the in-place shared-file
+    write (every host owns disjoint windows of ONE file; a per-host rename
+    would commit partial state) — their durability story is the manifested
+    checkpoint lane (resilience/checkpoint.py), not this writer.
+    """
     height, nwords = words.shape
     if nwords * BITS != width:
         raise ValueError(f"width {width} != {nwords} words x {BITS}")
-    create_sized(path, height * row_stride(width))
-    mm = np.memmap(path, dtype=np.uint8, mode="r+", shape=(height, row_stride(width)))
+    atomic = jax.process_count() == 1
+    dest = path + STAGING_SUFFIX if atomic else path
+    create_sized(dest, height * row_stride(width))
+    mm = np.memmap(dest, dtype=np.uint8, mode="r+", shape=(height, row_stride(width)))
 
     # One unpack pool shared by every shard (bounded by core count): nesting
     # a fresh pool per shard would scale threads as shards x default_workers.
@@ -208,3 +220,5 @@ def write_packed(path: str, words: jax.Array, width: int) -> None:
     finally:
         unpack_pool.shutdown()
     mm.flush()
+    if atomic:
+        os.replace(dest, path)

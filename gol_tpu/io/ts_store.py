@@ -13,6 +13,16 @@ grid aligns with the mesh's shard blocks, so
 - reads reassemble a sharded `jax.Array` via per-shard chunk reads,
 - the store works over any TensorStore kvstore (file://, gs://, s3://).
 
+Failure semantics (resilience pass): ``write_words`` NEVER deletes the only
+durable copy of prior state — overwriting an existing file-backed store
+writes to a fresh ``<path>.inprogress`` sibling and swaps it in only after
+every shard is durable, so a crash mid-write leaves the previous store
+readable (as ``path`` or, in the two-rename commit window, ``path.replaced``
+— ``read_words`` checks both). Shard-write failures are awaited to
+completion, aggregated, and reported with the failing shard indices; opens,
+transient shard writes, and the multihost create barrier retry under the
+unified ``resilience.retry`` policy.
+
 Snapshots stored this way carry the same no-sidecar resume property as text
 snapshots: the array plus its generation count (in the store path, like
 gen_NNNNNN) is a complete checkpoint (engine.resume_scalars).
@@ -20,7 +30,10 @@ gen_NNNNNN) is a complete checkpoint (engine.resume_scalars).
 
 from __future__ import annotations
 
+import logging
 import math
+import os
+import shutil
 
 import jax
 import numpy as np
@@ -28,6 +41,14 @@ from jax.sharding import Mesh
 
 from gol_tpu.ops.packed_math import BITS
 from gol_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
+from gol_tpu.resilience import REPLACED_SUFFIX, STAGING_SUFFIX, faults
+from gol_tpu.resilience.retry import (
+    DEFAULT_IO_RETRY,
+    RetryPolicy,
+    is_transient_io,
+)
+
+logger = logging.getLogger(__name__)
 
 try:  # tensorstore ships with orbax; gate so the POSIX lanes never need it
     import tensorstore as ts
@@ -36,6 +57,13 @@ try:  # tensorstore ships with orbax; gate so the POSIX lanes never need it
 except ImportError:  # pragma: no cover - present in this image
     ts = None
     HAVE_TENSORSTORE = False
+
+# Suffixes of the two-phase overwrite commit (shared package-wide so the
+# checkpoint GC sweeps the same names the writers stage). ``.inprogress``
+# holds the new store until every shard is durable; ``.replaced`` holds the
+# old store for the instant between the two renames of the swap.
+_INPROGRESS = STAGING_SUFFIX
+_REPLACED = REPLACED_SUFFIX
 
 
 def _require():
@@ -60,6 +88,22 @@ def _spec(path: str, shape=None, chunks=None):
     return spec
 
 
+def _open(path: str, retry: RetryPolicy, shape=None, chunks=None, **kw):
+    """ts.open with the fault hook and transient-outage retry applied."""
+
+    def attempt():
+        faults.on_ts_open()
+        return ts.open(_spec(path, shape, chunks), **kw).result()
+
+    return retry.call(
+        attempt,
+        retryable=is_transient_io,
+        on_retry=lambda n, err, delay: logger.warning(
+            "tensorstore open of %s failed (attempt %d, retrying in %.2fs): "
+            "%s: %s", path, n, delay, type(err).__name__, err),
+    )
+
+
 def _shard_chunks(shape, mesh: Mesh | None):
     """Chunk grid aligned to the mesh decomposition: one chunk per shard
     block (or row-block chunks on a single device so writes parallelize)."""
@@ -72,12 +116,86 @@ def _shard_chunks(shape, mesh: Mesh | None):
     return (math.ceil(h / mr), math.ceil(w / mc))
 
 
-def write_words(path: str, words: jax.Array, width: int) -> None:
-    """Bitpacked device state -> sharded zarr store.
+def _write_shards(store, shards, retry: RetryPolicy) -> None:
+    """Submit every shard write, await ALL of them, aggregate failures.
+
+    The old form raised on the first ``f.result()``, leaving later futures
+    unawaited and the store silently partial with no record of which shards
+    made it. Here every future is drained each round; transient failures are
+    re-submitted under the retry policy, and whatever remains raises ONE
+    error naming the failed shard indices.
+    """
+    pending = list(enumerate(shards))
+    delay = retry.base_delay
+    for attempt in range(1, retry.attempts + 1):
+        outcomes = []  # (index, shard, error-or-None)
+        futures = []
+        for i, shard in pending:
+            try:
+                faults.on_ts_shard_write(i)
+                rows, wcols = shard.index[0], shard.index[1]
+                block = np.asarray(shard.data)
+                futures.append((i, shard, store[rows, wcols].write(block)))
+            except Exception as e:  # submit-time failure still gets awaited peers
+                outcomes.append((i, shard, e))
+        for i, shard, fut in futures:
+            try:
+                fut.result()
+                outcomes.append((i, shard, None))
+            except Exception as e:
+                outcomes.append((i, shard, e))
+        failures = [(i, shard, e) for i, shard, e in outcomes if e is not None]
+        if not failures:
+            return
+        hard = [(i, e) for i, _, e in failures if not is_transient_io(e)]
+        if hard or attempt >= retry.attempts:
+            indices = sorted(i for i, _, _ in failures)
+            detail = "; ".join(
+                f"shard {i}: {type(e).__name__}: {e}" for i, _, e in failures
+            )
+            raise OSError(
+                f"write_words: {len(failures)}/{len(shards)} shard writes "
+                f"failed (shard indices {indices}): {detail}"
+            )
+        logger.warning(
+            "write_words: %d transient shard-write failure(s) (indices %s), "
+            "retrying in %.2fs", len(failures),
+            sorted(i for i, _, _ in failures), delay)
+        pending = [(i, shard) for i, shard, _ in failures]
+        if delay > 0:
+            import time
+
+            time.sleep(delay)
+        delay = retry.next_delay(delay)
+
+
+def _swap_in(path: str, staged: str) -> None:
+    """Commit ``staged`` over ``path``: old aside, new in, old gone. Between
+    the renames the prior state survives as ``path.replaced`` — at no point
+    do zero durable copies exist."""
+    old = path + _REPLACED
+    if os.path.exists(old):
+        shutil.rmtree(old, ignore_errors=True)
+    os.rename(path, old)
+    os.rename(staged, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def write_words(
+    path: str,
+    words: jax.Array,
+    width: int,
+    *,
+    retry: RetryPolicy = DEFAULT_IO_RETRY,
+) -> None:
+    """Bitpacked device state -> sharded zarr store, crash-consistently.
 
     Each process writes only its addressable shards; chunk boundaries equal
     shard boundaries, so no write crosses a chunk another host owns (the
     multi-writer-safety MPI_File_write_all gets from its subarray views).
+    Overwriting an existing file-backed store stages into ``.inprogress``
+    and swaps after all shards land (see module docstring); remote kvstores
+    (``://`` paths) cannot rename and keep the direct-write behavior.
     """
     _require()
     height, nwords = words.shape
@@ -85,37 +203,73 @@ def write_words(path: str, words: jax.Array, width: int) -> None:
         raise ValueError(f"width {width} != {nwords} words x {BITS}")
     mesh = getattr(words.sharding, "mesh", None)
     chunks = _shard_chunks((height, nwords), mesh)
-    if jax.process_count() > 1:
+
+    multihost = jax.process_count() > 1
+    file_backed = "://" not in path
+    stage = file_backed and os.path.exists(path)
+    if multihost and file_backed:
+        # The staging decision feeds barrier NAMES and the target path, so
+        # every process must make the same call: the lead's view of the
+        # shared FS wins (a peer with a stale attribute cache disagreeing
+        # would otherwise join differently-named barriers, or write its
+        # shards into the live store while the lead stages).
+        from jax.experimental import multihost_utils
+
+        stage = bool(np.asarray(multihost_utils.process_allgather(
+            np.asarray(stage, np.int32))).ravel()[0])
+    staged = None
+    target = path
+    if stage:
+        # Never destroy the only durable copy: build the new store beside it.
+        staged = path + _INPROGRESS
+        target = staged
+    if multihost:
         # Multi-host: only the lead process creates (a concurrent
         # delete_existing on every host would clobber peers' shards); a
-        # device barrier orders create before any peer's write.
+        # device barrier orders create before any peer's write. Barriers are
+        # never retried — a process unilaterally re-entering a barrier its
+        # peers already passed can only join the WRONG barrier, so a
+        # transient collective failure is fatal by design (the per-process
+        # retries cover the tensorstore open/write calls around it).
         from jax.experimental import multihost_utils
 
         if jax.process_index() == 0:
-            ts.open(
-                _spec(path, (height, nwords), chunks),
-                create=True,
-                delete_existing=True,
-            ).result()
-        multihost_utils.sync_global_devices(f"gol_tpu.ts_store.create:{path}")
-        store = ts.open(_spec(path)).result()
+            if staged is not None:
+                shutil.rmtree(staged, ignore_errors=True)
+            _open(target, retry, (height, nwords), chunks,
+                  create=True, delete_existing=True)
+        multihost_utils.sync_global_devices(
+            f"gol_tpu.ts_store.create:{target}")
+        store = _open(target, retry)
     else:
-        store = ts.open(
-            _spec(path, (height, nwords), chunks),
-            create=True,
-            delete_existing=True,
-        ).result()
-    futures = []
-    for shard in words.addressable_shards:
-        rows, wcols = shard.index[0], shard.index[1]
-        block = np.asarray(shard.data)
-        futures.append(store[rows, wcols].write(block))
-    for f in futures:
-        f.result()
+        if staged is not None:
+            shutil.rmtree(staged, ignore_errors=True)
+        store = _open(target, retry, (height, nwords), chunks,
+                      create=True, delete_existing=True)
+    _write_shards(store, list(words.addressable_shards), retry)
+    if staged is not None:
+        if multihost:
+            from jax.experimental import multihost_utils
+
+            # Every shard everywhere is durable before anyone swaps; only
+            # the lead renames, and peers wait for the commit.
+            multihost_utils.sync_global_devices(
+                f"gol_tpu.ts_store.commit:{path}")
+            if jax.process_index() == 0:
+                _swap_in(path, staged)
+            multihost_utils.sync_global_devices(
+                f"gol_tpu.ts_store.committed:{path}")
+        else:
+            _swap_in(path, staged)
 
 
 def read_words(
-    path: str, width: int, height: int, mesh: Mesh | None = None
+    path: str,
+    width: int,
+    height: int,
+    mesh: Mesh | None = None,
+    *,
+    retry: RetryPolicy = DEFAULT_IO_RETRY,
 ) -> jax.Array:
     """Sharded zarr store -> bitpacked (height, width/32) device array."""
     _require()
@@ -124,20 +278,36 @@ def read_words(
     nwords = width // BITS
     if nwords * BITS != width:
         raise ValueError(f"width {width} must be a multiple of {BITS}")
-    store = ts.open(_spec(path)).result()
+    if "://" not in path and not os.path.exists(path):
+        # A crash inside _swap_in's two-rename window leaves the prior state
+        # as path.replaced: recover it rather than failing the resume.
+        displaced = path + _REPLACED
+        if os.path.exists(displaced):
+            logger.warning(
+                "%s missing but %s exists (crash mid-overwrite); recovering "
+                "the displaced prior state", path, displaced)
+            try:
+                os.rename(displaced, path)
+            except OSError:
+                # A peer process recovering the same shared-FS store won the
+                # rename; losing the race is fine as long as someone did.
+                if not os.path.exists(path):
+                    raise
+    store = _open(path, retry)
     if tuple(store.shape) != (height, nwords):
         raise ValueError(
             f"{path}: stored shape {tuple(store.shape)} != ({height}, {nwords})"
         )
     if mesh is None:
-        return jax.numpy.asarray(store.read().result())
+        return jax.numpy.asarray(retry.call(lambda: store.read().result()))
     sharding = words_sharding(mesh)
     index_map = sharding.addressable_devices_indices_map((height, nwords))
     unique = {
         tuple((s.start, s.stop) for s in idx): idx for idx in index_map.values()
     }
     blocks = {
-        key: store[idx[0], idx[1]].read().result() for key, idx in unique.items()
+        key: retry.call(lambda idx=idx: store[idx[0], idx[1]].read().result())
+        for key, idx in unique.items()
     }
     return jax.make_array_from_callback(
         (height, nwords),

@@ -34,6 +34,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Older jax names the params class TPUCompilerParams; same fields.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from gol_tpu.ops import packed_math
 from gol_tpu.parallel import collectives, halo
 from gol_tpu.parallel.mesh import ROW_AXIS, SINGLE_DEVICE as SINGLE_DEVICE_TOPOLOGY, Topology
@@ -219,7 +222,7 @@ def _step(words: jnp.ndarray, interpret: bool = False):
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -597,7 +600,7 @@ def _step_t_fast(words: jnp.ndarray, interpret: bool = False):
             jax.ShapeDtypeStruct((height, nwords), jnp.uint32),
             jax.ShapeDtypeStruct((1, 4), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -636,7 +639,7 @@ def _step_trow_fast(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
             jax.ShapeDtypeStruct((h, nwords), jnp.uint32),
             jax.ShapeDtypeStruct((1, 4), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -725,7 +728,7 @@ def _step_trow(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
             jax.ShapeDtypeStruct((1, T), jnp.int32),
             jax.ShapeDtypeStruct((1, T), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -773,7 +776,7 @@ def _step_t(words: jnp.ndarray, interpret: bool = False, interior=None):
             jax.ShapeDtypeStruct((1, T), jnp.int32),
             jax.ShapeDtypeStruct((1, T), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -833,7 +836,7 @@ def _step_tgb(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
             jax.ShapeDtypeStruct((1, T), jnp.int32),
             jax.ShapeDtypeStruct((1, T), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -920,7 +923,7 @@ def _step_trow_stitch(words: jnp.ndarray, gtop: jnp.ndarray, gbot: jnp.ndarray,
             jax.ShapeDtypeStruct((1, T), jnp.int32),
             jax.ShapeDtypeStruct((1, T), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -1003,7 +1006,7 @@ def _step_strip(folded: jnp.ndarray, interpret: bool = False):
             jax.ShapeDtypeStruct((1, T), jnp.int32),
             jax.ShapeDtypeStruct((1, T), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -1051,7 +1054,7 @@ def _step_strip_fast(folded: jnp.ndarray, interpret: bool = False):
             jax.ShapeDtypeStruct((rows, nlanes), jnp.uint32),
             jax.ShapeDtypeStruct((1, 4), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -1117,7 +1120,7 @@ def _step_trow_stitch_fast(words: jnp.ndarray, gtop: jnp.ndarray,
             jax.ShapeDtypeStruct((h, nwords), jnp.uint32),
             jax.ShapeDtypeStruct((1, 4), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -1628,7 +1631,7 @@ def _dist_step_pallas(words, gtop8, gbot8, gmid, gwrap, interpret=False):
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

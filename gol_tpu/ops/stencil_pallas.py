@@ -31,6 +31,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Older jax names the params class TPUCompilerParams; same fields.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from gol_tpu.parallel import halo
 from gol_tpu.parallel.mesh import ROW_AXIS, Topology
 
@@ -168,7 +171,7 @@ def _step(grid: jnp.ndarray, interpret: bool = False):
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),  # flags accumulate sequentially
         ),
         interpret=interpret,
@@ -290,7 +293,7 @@ def _dist_step(grid, gtop8, gbot8, gmid, gwrap, interpret=False):
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -30,3 +30,24 @@ def any_flag(local_flag: jnp.ndarray, topology: Topology) -> jnp.ndarray:
     if not topology.distributed:
         return local_flag
     return jax.lax.psum(local_flag.astype(jnp.int32), topology.axes) > 0
+
+
+def host_all_agree(flag: bool) -> bool:
+    """Host-side (Python-level) counterpart of ``all_agree``: True iff every
+    *process* votes True.
+
+    ``all_agree`` votes per-shard inside a compiled step; this votes
+    per-process between steps — the checkpoint/auto-resume protocol runs it
+    on "can I read and verify this manifest?" so a cluster never resumes from
+    a checkpoint only some hosts can see (resilience/checkpoint.py). On a
+    single process it is the same identity short-circuit as the in-step vote.
+    """
+    if jax.process_count() == 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    votes = np.asarray(
+        multihost_utils.process_allgather(np.asarray(bool(flag), np.int32))
+    )
+    return int(votes.sum()) == jax.process_count()

@@ -28,6 +28,32 @@ ROW_AXIS = "row"
 COL_AXIS = "col"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it top-level with ``check_vma``; older releases only
+    have ``jax.experimental.shard_map.shard_map`` with the same semantics
+    under ``check_rep``. Every shard_map in the tree goes through here so a
+    jax downgrade degrades to the experimental entry point instead of an
+    AttributeError at trace time.
+    """
+    import inspect
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    params = inspect.signature(sm).parameters
+    if "check_vma" in params:
+        kwargs["check_vma"] = check_vma
+    elif "check_rep" in params:
+        # The legacy replication checker predates rules for while_loop (the
+        # engine's whole loop) — it must stay off; correctness is pinned by
+        # the differential suite, not the static check.
+        kwargs["check_rep"] = False
+    return sm(f, **kwargs)
+
+
 @dataclasses.dataclass(frozen=True)
 class Topology:
     """Static description of how the grid is laid out over devices.

@@ -13,7 +13,46 @@ in the def-sandwiched-in-imports layout this module replaces).
 
 from __future__ import annotations
 
+import logging
 import os
+
+
+class _DynamicStderrHandler(logging.StreamHandler):
+    """StreamHandler that resolves ``sys.stderr`` at emit time, not at
+    construction: test harnesses (and some launchers) swap the stream per
+    run, and a handler pinned to a dead buffer would swallow every warning.
+    """
+
+    def __init__(self, level=logging.NOTSET):
+        logging.Handler.__init__(self, level)
+
+    @property
+    def stream(self):
+        import sys
+
+        return sys.stderr
+
+
+def configure_cli_logging(level: int = logging.INFO) -> None:
+    """Route the ``gol_tpu`` logger tree to stderr for application entry
+    points (the CLI, bench.py).
+
+    Library modules log through ``logging.getLogger(__name__)`` and never
+    attach handlers (the embedder owns routing); the entry points call this
+    so kernel-demotion warnings and checkpoint/retry notices keep reaching
+    stderr exactly as the pre-logging ``sys.stderr`` writes did. Idempotent;
+    a host application that already configured the logger wins.
+    """
+    lg = logging.getLogger("gol_tpu")
+    if any(isinstance(h, _DynamicStderrHandler) for h in lg.handlers):
+        return
+    handler = _DynamicStderrHandler()
+    handler.setFormatter(logging.Formatter("gol_tpu: %(message)s"))
+    lg.addHandler(handler)
+    if lg.level == logging.NOTSET or lg.level > level:
+        lg.setLevel(level)
+    # Propagation stays on: a root handler an embedder (or test harness)
+    # configured should keep seeing these records too.
 
 
 def honor_platform_env() -> None:

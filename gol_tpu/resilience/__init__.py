@@ -1,0 +1,28 @@
+"""Crash-safety subsystem: atomic checkpoints, auto-resume, fault injection,
+and the unified retry policy.
+
+Import layering: ``retry`` and ``faults`` are stdlib-only (the engine imports
+``retry`` at module load; fault-harness subprocesses import ``faults`` before
+jax warms up). ``checkpoint`` pulls jax/numpy and is imported lazily by its
+callers — do not re-export it here.
+"""
+
+from gol_tpu.resilience.faults import FaultPlan, InjectedCrash
+from gol_tpu.resilience.retry import DEFAULT_IO_RETRY, RetryPolicy, is_transient_io
+
+# Two-phase-commit staging suffixes, shared by every writer that stages an
+# overwrite (io/packed_io, io/ts_store) and by the checkpoint GC that sweeps
+# stale leftovers (resilience/checkpoint._gc) — one definition, or the sweep
+# silently stops matching the writers.
+STAGING_SUFFIX = ".inprogress"
+REPLACED_SUFFIX = ".replaced"
+
+__all__ = [
+    "DEFAULT_IO_RETRY",
+    "FaultPlan",
+    "InjectedCrash",
+    "REPLACED_SUFFIX",
+    "RetryPolicy",
+    "STAGING_SUFFIX",
+    "is_transient_io",
+]

@@ -1,0 +1,534 @@
+"""Crash-consistent checkpoints: fresh payload + atomically-committed manifest.
+
+The reference programs' only durability story is output-is-a-valid-input-file;
+a crash mid-run loses everything (SURVEY.md §5). The snapshot lanes improved
+on that but not on crash *consistency*: a die mid-write could leave a torn
+file as the newest state. This module closes that hole with the classic
+write-ahead discipline:
+
+1. the state is written to a **fresh payload path** (``ckpt-<gen>.<ext>``) —
+   never over the previous checkpoint, so no write ever endangers the only
+   durable copy;
+2. a small JSON **manifest** (generation, similarity counter, grid geometry,
+   per-shard CRC32 checksums, payload name) is written to a temp file,
+   fsynced, and committed with ``os.replace`` — the one atomic step. A
+   checkpoint exists iff its manifest does; torn payloads without a manifest
+   are invisible garbage;
+3. older checkpoints are garbage-collected only **after** the new manifest is
+   durable (manifest deleted before its payload, so GC can never produce a
+   manifest pointing at nothing).
+
+Recovery (``restore``) walks manifests newest-first and returns the first
+whose payload reads back and checksums clean. On multihost runs the processes
+vote — ``parallel/collectives.host_all_agree`` — so the run resumes from the
+newest manifest *every* process can read, never a mix.
+
+The payload encoding is pluggable (``PayloadCodec``): the packed lane stores
+the bitpacked words as a sharded TensorStore zarr (io/ts_store.py), the byte
+lane a text grid — both topology-independent, so a checkpoint taken on one
+mesh restores on another (the elastic-reconfiguration property pinned by
+tests/test_segments.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+import zlib
+from typing import Any, Callable
+
+import numpy as np
+
+from gol_tpu.resilience import REPLACED_SUFFIX, STAGING_SUFFIX, faults
+from gol_tpu.resilience.retry import DEFAULT_IO_RETRY, RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+_MANIFEST_SUFFIX = ".manifest.json"
+_PREFIX = "ckpt-"
+
+
+@dataclasses.dataclass(frozen=True)
+class PayloadCodec:
+    """How checkpoint state bytes get to/from disk; the manager owns naming,
+    manifests, and GC, the codec owns only the array encoding."""
+
+    format: str  # recorded in the manifest; must match on restore
+    suffix: str  # payload file/dir extension, e.g. ".zarr"
+    write: Callable[[str, Any], None]  # (path, state) -> None
+    read: Callable[[str], Any]  # path -> state (device array)
+    # True when write/read run their own RetryPolicy internally (the zarr
+    # codec): the manager then must not stack its outer retry on top.
+    self_retrying: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointInfo:
+    generation: int  # completed generations (the reported count convention)
+    counter: int  # similarity counter at that point
+    path: str  # manifest path
+
+
+def _block_key(r0: int, r1: int, c0: int, c1: int) -> str:
+    return f"{r0}:{r1},{c0}:{c1}"
+
+
+def _parse_key(key: str) -> tuple[int, int, int, int]:
+    rows, cols = key.split(",")
+    r0, r1 = (int(x) for x in rows.split(":"))
+    c0, c1 = (int(x) for x in cols.split(":"))
+    return r0, r1, c0, c1
+
+
+def run_fingerprint(state, tag: str = "") -> str:
+    """Cluster-stable fingerprint of a run's identity, computed from its
+    INITIAL state as a positional hash: each cell contributes
+    ``value * mix(global_row, global_col)`` and the contributions are summed
+    (mod 2^64) over every process's shards. The sum is commutative and
+    per-cell, so the SAME state yields the same fingerprint under ANY shard
+    decomposition — a rerun on a different mesh still recognizes its own
+    checkpoints (the topology-independent-restore property), while a
+    different input cannot collide by rearrangement. Recorded in each
+    manifest and checked on restore, so a checkpoint directory reused with a
+    different input never silently hands an old run's state to a new run.
+    ``tag`` folds in non-derivable config identity (convention)."""
+    import jax
+
+    h, w = state.shape
+    shards = getattr(state, "addressable_shards", None)
+    if shards is None:
+        blocks = [((0, h, 0, w), np.ascontiguousarray(np.asarray(state)))]
+    else:
+        blocks = []
+        for shard in shards:
+            rows, cols = shard.index[0], shard.index[1]
+            r0, r1, _ = rows.indices(h)
+            c0, c1, _ = cols.indices(w)
+            blocks.append(((r0, r1, c0, c1), np.asarray(shard.data)))
+    local = np.uint64(0)
+    for (r0, r1, c0, c1), block in blocks:
+        rr = np.arange(r0, r1, dtype=np.uint64)[:, None]
+        cc = np.arange(c0, c1, dtype=np.uint64)[None, :]
+        mix = (rr + np.uint64(1)) * np.uint64(0x9E3779B97F4A7C15) \
+            ^ (cc + np.uint64(1)) * np.uint64(0xC2B2AE3D27D4EB4F)
+        with np.errstate(over="ignore"):
+            local += (block.astype(np.uint64) * mix).sum(dtype=np.uint64)
+    total = int(local)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # Exchange as two 31-bit halves: jax may be running without x64, and
+        # an allgather payload silently downcast to int32 would corrupt the
+        # hash differently per process.
+        halves = np.asarray([total & 0x7FFFFFFF, (total >> 31) & 0x7FFFFFFF],
+                            np.int32)
+        everyone = np.asarray(multihost_utils.process_allgather(halves),
+                              np.int64).reshape(-1, 2)
+        total = int((everyone[:, 0].sum() + (everyone[:, 1].sum() << 31))
+                    & 0xFFFFFFFFFFFFFFFF)
+    return f"{total:016x}" + (f":{tag}" if tag else "")
+
+
+def _shard_checksums(state) -> dict[str, int]:
+    """CRC32 per addressable shard, keyed by the block's index ranges in the
+    stored array — geometry-keyed so restore can re-verify under ANY
+    topology (regions are recomputed by slicing, not by shard identity)."""
+    h, w = state.shape
+    shards = getattr(state, "addressable_shards", None)
+    if shards is None:  # plain ndarray
+        block = np.ascontiguousarray(np.asarray(state))
+        return {_block_key(0, h, 0, w): zlib.crc32(block.tobytes())}
+    sums = {}
+    for shard in shards:
+        rows, cols = shard.index[0], shard.index[1]
+        r0, r1, _ = rows.indices(h)
+        c0, c1, _ = cols.indices(w)
+        block = np.ascontiguousarray(np.asarray(shard.data))
+        sums[_block_key(r0, r1, c0, c1)] = zlib.crc32(block.tobytes())
+    return sums
+
+
+def _allgather_checksums(sums: dict[str, int]) -> dict[str, int]:
+    """Union of every process's shard checksums. The manifest is committed
+    by the lead alone; without this merge it would record only the lead's
+    addressable blocks and peer-owned shards would restore UNVERIFIED."""
+    import jax
+
+    if jax.process_count() == 1:
+        return sums
+    from jax.experimental import multihost_utils
+
+    blob = np.frombuffer(
+        json.dumps(sums, sort_keys=True).encode(), np.uint8)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray(len(blob), np.int32))).ravel()
+    padded = np.zeros((int(lens.max()),), np.uint8)
+    padded[: len(blob)] = blob
+    everyone = np.asarray(multihost_utils.process_allgather(padded))
+    merged: dict[str, int] = {}
+    for i, n in enumerate(lens):
+        merged.update(json.loads(bytes(everyone[i, : int(n)]).decode()))
+    return merged
+
+
+def _verify_checksums(state, checksums: dict[str, int]) -> bool:
+    """Re-verify every recorded block this process can address. Blocks owned
+    entirely by peers are skipped (they verify their own); a block that
+    straddles shards is re-sliced from the host copy on single-process runs.
+    """
+    import jax
+
+    h, w = state.shape
+    if jax.process_count() == 1:
+        host = np.asarray(state)
+        for key, want in checksums.items():
+            r0, r1, c0, c1 = _parse_key(key)
+            got = zlib.crc32(np.ascontiguousarray(host[r0:r1, c0:c1]).tobytes())
+            if got != int(want):
+                return False
+        return True
+    # Multihost: check keys contained in an addressable shard.
+    for shard in state.addressable_shards:
+        rows, cols = shard.index[0], shard.index[1]
+        sr0, sr1, _ = rows.indices(h)
+        sc0, sc1, _ = cols.indices(w)
+        block = None
+        for key, want in checksums.items():
+            r0, r1, c0, c1 = _parse_key(key)
+            if r0 >= sr0 and r1 <= sr1 and c0 >= sc0 and c1 <= sc1:
+                if block is None:
+                    block = np.asarray(shard.data)
+                window = block[r0 - sr0 : r1 - sr0, c0 - sc0 : c1 - sc0]
+                if zlib.crc32(np.ascontiguousarray(window).tobytes()) != int(want):
+                    return False
+    return True
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _commit_file(path: str, data: bytes) -> None:
+    """Write ``data`` durably at ``path`` via tmp + fsync + atomic rename."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _rmtree_or_file(path: str) -> None:
+    if os.path.isdir(path):
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
+    elif os.path.exists(path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+class CheckpointManager:
+    """Atomic checkpoints for one run's geometry in one directory.
+
+    ``keep`` retains that many newest checkpoints (>=1): the window a slow
+    shared filesystem gets to make a manifest readable on every host before
+    the vote falls back to the previous one.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        height: int,
+        width: int,
+        codec: PayloadCodec,
+        keep: int = 2,
+        retry: RetryPolicy = DEFAULT_IO_RETRY,
+        run_fingerprint: str | None = None,
+    ):
+        if keep < 1:
+            raise ValueError(f"checkpoint keep must be >= 1, got {keep}")
+        self.directory = directory
+        self.height = height
+        self.width = width
+        self.codec = codec
+        self.keep = keep
+        self.retry = retry
+        self.run_fingerprint = run_fingerprint
+        os.makedirs(directory, exist_ok=True)
+
+    # -- naming --------------------------------------------------------------
+
+    def _manifest_path(self, generation: int) -> str:
+        return os.path.join(self.directory,
+                            f"{_PREFIX}{generation:08d}{_MANIFEST_SUFFIX}")
+
+    def _payload_name(self, generation: int) -> str:
+        return f"{_PREFIX}{generation:08d}{self.codec.suffix}"
+
+    def _list_generations(self) -> list[int]:
+        """Generations with a committed manifest, newest first."""
+        gens = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if name.startswith(_PREFIX) and name.endswith(_MANIFEST_SUFFIX):
+                digits = name[len(_PREFIX) : -len(_MANIFEST_SUFFIX)]
+                if digits.isdigit():
+                    gens.append(int(digits))
+        return sorted(gens, reverse=True)
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, state, generation: int, counter: int) -> str:
+        """Checkpoint ``state`` after ``generation`` completed generations.
+
+        Returns the manifest path. Ordering is the crash-safety argument:
+        payload first (fresh path), manifest committed atomically second, GC
+        of older checkpoints last — a crash at ANY point leaves the previous
+        checkpoint intact and discoverable.
+        """
+        faults.on_checkpoint_boundary(generation)
+        import jax
+
+        multihost = jax.process_count() > 1
+        manifest_path = self._manifest_path(generation)
+        already = (
+            os.path.exists(manifest_path) and self._load(generation) is not None
+        )
+        if multihost:
+            # The skip must be a COLLECTIVE decision: a lone process skipping
+            # (or sweeping the shared manifest) while peers rewrite would
+            # desynchronize the barrier sequence below and deadlock the
+            # cluster. Unanimous yes -> all skip; otherwise all rewrite.
+            from gol_tpu.parallel.collectives import host_all_agree
+
+            already = host_all_agree(already)
+        if already:
+            # A resumed run re-reached a boundary it had already committed;
+            # the engine is bit-exact, so the existing checkpoint IS this
+            # state — rewriting it would put a valid manifest over a payload
+            # mid-rewrite, the one window the ordering otherwise closes.
+            return manifest_path
+        payload_name = self._payload_name(generation)
+        payload_path = os.path.join(self.directory, payload_name)
+        if not multihost or jax.process_index() == 0:
+            _rmtree_or_file(manifest_path)  # invalid leftover, if any
+            _rmtree_or_file(payload_path)  # torn orphan from a crashed save
+        if multihost:
+            # The lead's sweep of shared-FS leftovers must finish before any
+            # peer starts writing shards into the payload path.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"gol_tpu.ckpt.clean:{self.directory}:{generation}")
+        if multihost or self.codec.self_retrying:
+            # No outer retry. Multihost: the zarr codec's write contains
+            # collective barriers, and ONE process re-entering them while
+            # peers have moved on joins the wrong barrier. Self-retrying
+            # codecs: stacking this policy on the codec's own would cube the
+            # time-to-failure of a persistent outage.
+            self.codec.write(payload_path, state)
+        else:
+            self.retry.call(lambda: self.codec.write(payload_path, state))
+        faults.on_payload_write(payload_path)
+        # Merged across processes AFTER the write (a fixed point in the
+        # collective order): the lead-committed manifest must carry EVERY
+        # process's block CRCs or peer shards would restore unverified.
+        checksums = _allgather_checksums(_shard_checksums(state))
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "generation": int(generation),
+            "counter": int(counter),
+            "height": int(self.height),
+            "width": int(self.width),
+            "state_shape": [int(d) for d in state.shape],
+            "payload": payload_name,
+            "payload_format": self.codec.format,
+            "run_fingerprint": self.run_fingerprint,
+            "checksums": checksums,
+            "created_unix": time.time(),
+        }
+        data = json.dumps(manifest, indent=1).encode()
+        if multihost:
+            # Peers' payload shards must be durable before ANY process
+            # commits a manifest claiming them; only the lead commits. The
+            # barriers are never retried: a process unilaterally re-entering
+            # a barrier its peers already passed can only join the WRONG
+            # barrier — a transient collective failure is fatal by design.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"gol_tpu.ckpt.commit:{self.directory}:{generation}")
+            if jax.process_index() == 0:
+                _commit_file(manifest_path, data)
+            multihost_utils.sync_global_devices(
+                f"gol_tpu.ckpt.committed:{self.directory}:{generation}")
+        else:
+            _commit_file(manifest_path, data)
+        self._gc()
+        return manifest_path
+
+    def _manifest_is_foreign(self, generation: int) -> bool:
+        """True when the manifest readably belongs to a DIFFERENT run (its
+        fingerprint exists and mismatches ours): garbage to this run, and it
+        must not shadow (or out-sort) this run's own checkpoints."""
+        if self.run_fingerprint is None:
+            return False
+        try:
+            with open(self._manifest_path(generation)) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False  # unreadable != foreign; restore() handles invalid
+        return manifest.get("run_fingerprint") != self.run_fingerprint
+
+    def _gc(self) -> None:
+        """Drop all but the ``keep`` newest of THIS run's checkpoints,
+        manifest first (so a crash mid-GC can only orphan a payload, never
+        dangle a manifest); foreign-run leftovers in a reused directory are
+        garbage outright. Then sweep tmp/staging files and manifest-less
+        payloads older than the newest."""
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        gens, doomed = [], []
+        for gen in self._list_generations():
+            (doomed if self._manifest_is_foreign(gen) else gens).append(gen)
+        doomed.extend(gens[self.keep :])
+        for gen in doomed:
+            _rmtree_or_file(self._manifest_path(gen))
+            _rmtree_or_file(os.path.join(self.directory, self._payload_name(gen)))
+        newest = gens[0] if gens else None
+        live = {self._payload_name(g) for g in gens[: self.keep]}
+        for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
+            if name.endswith(".tmp") or (
+                name.startswith(_PREFIX)
+                and name.endswith((STAGING_SUFFIX, REPLACED_SUFFIX))
+            ):
+                # .tmp: torn manifest commits; .inprogress/.replaced: staging
+                # leftovers from a codec writer (packed_io/ts_store) crashed
+                # mid-payload. Saves are serialized within a run and GC runs
+                # after the commit barrier, so anything still staged is stale.
+                _rmtree_or_file(path)
+            elif (
+                name.startswith(_PREFIX)
+                and name.endswith(self.codec.suffix)
+                and name not in live
+            ):
+                digits = name[len(_PREFIX) : -len(self.codec.suffix)]
+                if digits.isdigit() and newest is not None and int(digits) <= newest:
+                    _rmtree_or_file(path)
+
+    # -- restore -------------------------------------------------------------
+
+    def _load(self, generation: int):
+        """(state, info) for one checkpoint, or None if anything about it —
+        manifest JSON, geometry, payload read, checksums — fails to verify."""
+        try:
+            with open(self._manifest_path(generation)) as f:
+                manifest = json.load(f)
+            if manifest.get("format_version") != FORMAT_VERSION:
+                raise ValueError(
+                    f"unknown format_version {manifest.get('format_version')}")
+            if (manifest["height"], manifest["width"]) != (self.height, self.width):
+                raise ValueError(
+                    f"geometry {manifest['height']}x{manifest['width']} != "
+                    f"run geometry {self.height}x{self.width}")
+            if manifest["payload_format"] != self.codec.format:
+                raise ValueError(
+                    f"payload format {manifest['payload_format']!r} != "
+                    f"this lane's {self.codec.format!r}")
+            if (
+                self.run_fingerprint is not None
+                and manifest.get("run_fingerprint") != self.run_fingerprint
+            ):
+                raise ValueError(
+                    f"checkpoint belongs to a different run (fingerprint "
+                    f"{manifest.get('run_fingerprint')!r} != this run's "
+                    f"{self.run_fingerprint!r}) — stale checkpoint dir?")
+            payload = os.path.join(self.directory, manifest["payload"])
+            if self.codec.self_retrying:
+                state = self.codec.read(payload)
+            else:
+                state = self.retry.call(lambda: self.codec.read(payload))
+            if tuple(state.shape) != tuple(manifest["state_shape"]):
+                raise ValueError(
+                    f"payload shape {tuple(state.shape)} != manifest "
+                    f"{tuple(manifest['state_shape'])}")
+            if not _verify_checksums(state, manifest["checksums"]):
+                raise ValueError("shard checksum mismatch")
+            info = CheckpointInfo(
+                generation=int(manifest["generation"]),
+                counter=int(manifest["counter"]),
+                path=self._manifest_path(generation),
+            )
+            return state, info
+        except Exception as e:  # noqa: BLE001 - any defect means "not valid"
+            logger.warning(
+                "checkpoint %s/%s%08d invalid, trying older: %s: %s",
+                self.directory, _PREFIX, generation, type(e).__name__, e)
+            return None
+
+    def _global_candidates(self) -> list[int]:
+        """Union of every process's manifest generations, newest first: a
+        manifest only one host can list must still get voted on (and down)."""
+        import jax
+
+        local = self._list_generations()
+        if jax.process_count() == 1:
+            return local
+        from jax.experimental import multihost_utils
+
+        # Fixed-size exchange: newest 2*keep generations, -1 padded.
+        width = max(2 * self.keep, 4)
+        mine = np.full((width,), -1, np.int64)
+        mine[: min(len(local), width)] = local[:width]
+        everyone = np.asarray(multihost_utils.process_allgather(mine))
+        gens = {int(g) for g in everyone.ravel() if int(g) >= 0}
+        return sorted(gens, reverse=True)
+
+    def restore(self, max_generation: int | None = None):
+        """Newest checkpoint every process can read, or None.
+
+        Walks candidates newest-first; each process validates locally and the
+        cluster votes (``host_all_agree``) — a manifest any process cannot
+        read and verify is skipped by ALL of them, so no two processes ever
+        resume from different generations. Returns ``(state, info)``.
+
+        ``max_generation`` skips checkpoints past it (deterministically, so
+        no vote is needed): a rerun with a REDUCED --gen-limit resumes from
+        the newest checkpoint at or below the limit — any such checkpoint is
+        an exact prefix of the shorter run — or starts fresh.
+        """
+        from gol_tpu.parallel.collectives import host_all_agree
+
+        for gen in self._global_candidates():
+            if max_generation is not None and gen > max_generation:
+                continue
+            loaded = self._load(gen)
+            if host_all_agree(loaded is not None):
+                state, info = loaded
+                logger.info("auto-resume: restored checkpoint at generation "
+                            "%d from %s", info.generation, info.path)
+                return state, info
+            if loaded is not None:
+                logger.warning(
+                    "checkpoint generation %d readable here but not on every "
+                    "process; falling back to an older one", gen)
+        return None

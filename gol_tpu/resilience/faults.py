@@ -1,0 +1,209 @@
+"""Fault-injection plan: deterministic failures for the recovery harness.
+
+The crash-safety claims in ``resilience/checkpoint.py`` (a crash never leaves
+the checkpoint dir without a readable prior state; auto-resume reproduces the
+uninterrupted run byte-for-byte) are only claims until a harness kills real
+runs at every boundary and fails writes mid-checkpoint. This module is that
+harness's lever: a ``FaultPlan`` installed process-wide (by flag or env var)
+that the IO and checkpoint layers probe at their injection points.
+
+Production runs never install a plan, and every probe is a no-op ``None``
+check — the hooks cost nothing when disarmed.
+
+Knobs (``--fault-plan`` spec / ``GOL_FAULTS`` env var, ``k=v`` comma list):
+
+- ``ts_write_fail=N``      fail the Nth tensorstore shard write (1-based,
+                           counted process-wide)
+- ``ts_write_error=hard|transient``  how that write fails (default hard)
+- ``ts_open_transient=N``  first N tensorstore opens raise a transient error
+- ``payload_write_fail=N`` fail the Nth checkpoint payload write mid-file
+- ``kill_at_gen=K``        crash at the first checkpoint boundary whose
+                           generation count is >= K
+- ``kill_mode=exception|sigkill``  simulated crash (``InjectedCrash``, a
+                           BaseException no library layer catches) or a real
+                           ``SIGKILL`` (subprocess harness only)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+class InjectedCrash(BaseException):
+    """A simulated hard kill. Derives from BaseException so no library-level
+    ``except Exception`` can absorb it — like SIGKILL, nothing between the
+    injection point and the process boundary gets to clean up."""
+
+
+class TransientInjectedError(OSError):
+    """An injected transient IO error; the message carries the marker
+    ``retry.is_transient_io`` classifies on."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected transient fault at {site}")
+
+
+class InjectedWriteError(OSError):
+    """An injected hard IO failure (non-transient: retries must NOT heal it)."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected hard write fault at {site}")
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Declarative failure schedule; counters live in the instance so one
+    plan drives exactly one run's worth of faults."""
+
+    ts_write_fail: int | None = None
+    ts_write_error: str = "hard"  # "hard" | "transient"
+    ts_open_transient: int = 0
+    payload_write_fail: int | None = None
+    kill_at_gen: int | None = None
+    kill_mode: str = "exception"  # "exception" | "sigkill"
+
+    _ts_writes: int = dataclasses.field(default=0, repr=False)
+    _ts_opens: int = dataclasses.field(default=0, repr=False)
+    _payload_writes: int = dataclasses.field(default=0, repr=False)
+    _killed: bool = dataclasses.field(default=False, repr=False)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``k=v,k=v`` spec -> plan; unknown keys are loud errors so a typo'd
+        injection never silently tests nothing."""
+        plan = cls()
+        ints = {"ts_write_fail", "ts_open_transient", "payload_write_fail",
+                "kill_at_gen"}
+        strs = {"ts_write_error": ("hard", "transient"),
+                "kill_mode": ("exception", "sigkill")}
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            key, sep, value = part.partition("=")
+            if not sep:
+                raise ValueError(f"fault plan entry {part!r} is not k=v")
+            if key in ints:
+                setattr(plan, key, int(value))
+            elif key in strs:
+                if value not in strs[key]:
+                    raise ValueError(
+                        f"fault plan {key} must be one of {strs[key]}, "
+                        f"got {value!r}")
+                setattr(plan, key, value)
+            else:
+                raise ValueError(f"unknown fault plan key {key!r}")
+        return plan
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        spec = os.environ.get("GOL_FAULTS")
+        return cls.parse(spec) if spec else None
+
+
+_active: FaultPlan | None = None
+
+
+def install(plan: FaultPlan | None) -> None:
+    """Arm ``plan`` process-wide (None disarms)."""
+    global _active
+    _active = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> FaultPlan | None:
+    return _active
+
+
+def install_from_env() -> FaultPlan | None:
+    """Arm a plan from ``GOL_FAULTS`` if set (the subprocess harness's path:
+    the env var crosses the exec boundary, flags don't). Returns it."""
+    plan = FaultPlan.from_env()
+    if plan is not None:
+        install(plan)
+    return plan
+
+
+# --- injection points -------------------------------------------------------
+# Each probe is called by exactly one library site; the site string rides the
+# raised error so a harness assertion can name where the fault landed.
+
+
+def on_ts_open() -> None:
+    plan = _active
+    if plan is None:
+        return
+    if plan._ts_opens < plan.ts_open_transient:
+        plan._ts_opens += 1
+        raise TransientInjectedError("tensorstore open")
+    plan._ts_opens += 1
+
+
+def on_ts_shard_write(shard_index: int) -> None:
+    plan = _active
+    if plan is None:
+        return
+    plan._ts_writes += 1
+    if plan.ts_write_fail is not None and plan._ts_writes == plan.ts_write_fail:
+        site = f"tensorstore shard write #{plan._ts_writes} (shard {shard_index})"
+        if plan.ts_write_error == "transient":
+            raise TransientInjectedError(site)
+        raise InjectedWriteError(site)
+
+
+def _tear(path: str) -> None:
+    """Corrupt ``path`` the way a crash mid-write would: truncate the file
+    to half its bytes (directory payloads: tear the largest file inside)."""
+    target = path
+    if os.path.isdir(path):
+        candidates = []
+        for root, _, names in os.walk(path):
+            for name in names:
+                p = os.path.join(root, name)
+                try:
+                    candidates.append((os.path.getsize(p), p))
+                except OSError:
+                    pass
+        if not candidates:
+            return
+        target = max(candidates)[1]
+    try:
+        with open(target, "r+b") as f:
+            f.truncate(os.path.getsize(target) // 2)
+    except OSError:
+        pass
+
+
+def on_payload_write(path: str) -> None:
+    """Probed right after a checkpoint payload write completes; a firing
+    fault TEARS the written payload (mid-file truncation) before raising, so
+    the harness proves restore() treats torn payloads as invisible garbage —
+    not merely that an error aborts the manifest commit."""
+    plan = _active
+    if plan is None:
+        return
+    plan._payload_writes += 1
+    if (
+        plan.payload_write_fail is not None
+        and plan._payload_writes == plan.payload_write_fail
+    ):
+        _tear(path)
+        raise InjectedWriteError(f"checkpoint payload write {path}")
+
+
+def on_checkpoint_boundary(generation: int) -> None:
+    """Probed at every checkpoint boundary BEFORE the checkpoint is written:
+    a kill here models dying between checkpoints, so the newest durable state
+    is the previous boundary's."""
+    plan = _active
+    if plan is None or plan._killed or plan.kill_at_gen is None:
+        return
+    if generation >= plan.kill_at_gen:
+        plan._killed = True
+        if plan.kill_mode == "sigkill":
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise InjectedCrash(f"injected crash at checkpoint boundary, "
+                            f"generation {generation}")

@@ -1,0 +1,119 @@
+"""Unified bounded-retry policy for transient infrastructure failures.
+
+One policy object replaces the ad-hoc retry shapes that had started to
+accumulate per call site (the engine ladder's one-shot tunnel retry was the
+first; advisor r4): bounded attempts, exponential backoff with a cap, and an
+optional overall deadline. Callers keep their own *classification* of what is
+retryable — a retry policy that guesses at semantics turns hard failures into
+silent slow loops — and pass it as the ``retryable`` predicate.
+
+The module is stdlib-only on purpose: ``gol_tpu.engine`` imports it at module
+load, before jax-heavy modules, and the fault-injection harness imports it in
+subprocesses that must start fast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+# Substrings that mark an IO failure as plausibly transient: tensorstore /
+# kvstore surfaces absl status prose ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+# "ABORTED"), POSIX gives EAGAIN/EINTR shapes, and the fault harness tags its
+# injected transients explicitly (resilience/faults.py). Matched lowercase
+# against ``TypeName: message``.
+_TRANSIENT_IO_MARKS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "aborted",
+    "connection reset",
+    "broken pipe",
+    "temporarily",
+    "timed out",
+    "try again",
+    "injected transient",
+)
+
+
+def is_transient_io(err: BaseException) -> bool:
+    """True when an IO error is worth retrying: infrastructure hiccups, not
+    corrupt data or caller bugs. ``ValueError`` never classifies — a shape or
+    format mismatch will not heal on retry no matter what its text says."""
+    if isinstance(err, ValueError):
+        return False
+    text = f"{type(err).__name__}: {err}".lower()
+    return any(mark in text for mark in _TRANSIENT_IO_MARKS)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded attempts + exponential backoff + optional deadline.
+
+    ``attempts`` counts total tries (attempts=1 means no retry); ``deadline``
+    bounds the whole call in seconds — a retry that would *start* past the
+    deadline is not taken and the last error propagates. ``base_delay=0``
+    disables sleeping entirely (the engine's compile-ladder retry wants
+    immediate re-dispatch: the tunnel helper either restarted or it didn't).
+    """
+
+    attempts: int = 3
+    base_delay: float = 0.05
+    multiplier: float = 2.0
+    max_delay: float = 2.0
+    deadline: float | None = None
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if self.base_delay < 0 or self.max_delay < 0:
+            raise ValueError("delays must be >= 0")
+
+    def next_delay(self, delay: float) -> float:
+        """The backoff step: the single copy of the growth rule, shared by
+        ``call`` and batch retry loops that manage their own attempt state
+        (io/ts_store._write_shards retries per-shard subsets)."""
+        return min(max(delay, self.base_delay) * self.multiplier,
+                   self.max_delay)
+
+    def call(
+        self,
+        fn: Callable,
+        *,
+        retryable: Callable[[BaseException], bool] = is_transient_io,
+        on_retry: Callable[[int, BaseException, float], None] | None = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        """Run ``fn`` under the policy; returns its value or raises its last
+        error. ``on_retry(attempt, err, delay)`` fires before each backoff
+        (attempt is 1-based), so callers can log without wrapping ``fn``."""
+        start = clock()
+        delay = self.base_delay
+        err: BaseException | None = None
+        for attempt in range(1, self.attempts + 1):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 - classification is the caller's
+                err = e
+                if attempt >= self.attempts or not retryable(e):
+                    raise
+                if (
+                    self.deadline is not None
+                    and clock() - start + delay > self.deadline
+                ):
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e, delay)
+                if delay > 0:
+                    sleep(delay)
+                delay = self.next_delay(delay)
+        raise err  # pragma: no cover - loop always returns or raises
+
+
+# Shared default for durable-storage operations (tensorstore open/write, the
+# multihost create barrier, checkpoint payload IO): three tries, sub-second
+# total backoff — a real outage should surface in seconds, not minutes.
+DEFAULT_IO_RETRY = RetryPolicy(attempts=3, base_delay=0.05, multiplier=4.0,
+                               max_delay=1.0)

@@ -1,0 +1,376 @@
+"""Crash-recovery harness (ISSUE 1 acceptance): fault injection kills a
+checkpointed CLI run at every checkpoint boundary — and fails a write
+mid-checkpoint — and ``--auto-resume`` must restore to a final output file
+byte-identical to the uninterrupted run's, reporting the same generation
+count. A crash must never leave the checkpoint dir without a readable prior
+state.
+
+Runs drive ``cli.main`` in-process with ``kill_mode=exception`` faults:
+``InjectedCrash`` derives from BaseException, so — like SIGKILL — nothing
+between the injection point and this harness gets to clean up.
+"""
+
+import json
+import os
+
+import pytest
+
+from gol_tpu import cli
+from gol_tpu.io import text_grid, ts_store
+from gol_tpu.resilience import faults
+from gol_tpu.resilience.faults import InjectedCrash
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+GEN_LIMIT = 12
+EVERY = 3
+BOUNDARIES = (3, 6, 9)  # generation 12 is the (uncheckpointed) final state
+
+
+def _run(capsys, args):
+    capsys.readouterr()  # drain anything a previous run printed
+    rc = cli.main(args)
+    return rc, capsys.readouterr()
+
+
+def _gens_line(out):
+    return [l for l in out.splitlines() if l.startswith("Generations")]
+
+
+def _args(infile, out, ckdir, *extra):
+    return [
+        "16", "16", infile,
+        "--variant", "game",
+        "--gen-limit", str(GEN_LIMIT),
+        "--checkpoint-every", str(EVERY),
+        "--checkpoint-dir", str(ckdir),
+        "--output", str(out),
+        *extra,
+    ]
+
+
+@pytest.fixture
+def grid16(tmp_path):
+    p = tmp_path / "in.txt"
+    text_grid.write_grid(str(p), text_grid.generate(16, 16, seed=77))
+    return str(p)
+
+
+@pytest.fixture
+def reference(tmp_path, grid16, capsys):
+    """Uninterrupted, checkpoint-free run: the byte-for-byte target."""
+    out = tmp_path / "ref.out"
+    rc, cap = _run(capsys, [
+        "16", "16", grid16, "--variant", "game",
+        "--gen-limit", str(GEN_LIMIT), "--output", str(out),
+    ])
+    assert rc == 0
+    return out.read_bytes(), _gens_line(cap.out)
+
+
+def _assert_prior_state_readable(ckdir):
+    """Every committed manifest must point at an existing payload — a crash
+    window may orphan payloads (invisible) but never dangle a manifest."""
+    if not os.path.isdir(ckdir):
+        return
+    for name in os.listdir(ckdir):
+        if name.endswith(".manifest.json"):
+            with open(os.path.join(ckdir, name)) as f:
+                manifest = json.load(f)
+            payload = os.path.join(ckdir, manifest["payload"])
+            assert os.path.exists(payload), (
+                f"manifest {name} dangles: {manifest['payload']} missing"
+            )
+
+
+@pytest.mark.parametrize("kill_at", BOUNDARIES)
+def test_kill_at_every_boundary_then_auto_resume(
+    tmp_path, grid16, reference, capsys, kill_at
+):
+    ref_bytes, ref_gens = reference
+    ckdir = tmp_path / f"ck{kill_at}"
+    out = tmp_path / f"out{kill_at}.out"
+
+    with pytest.raises(InjectedCrash):
+        cli.main(_args(grid16, out, ckdir,
+                       "--fault-plan", f"kill_at_gen={kill_at}"))
+    _assert_prior_state_readable(str(ckdir))
+    if kill_at > EVERY:
+        # Boundaries before the kill committed checkpoints; the newest must
+        # be the boundary just before the crash.
+        manifests = sorted(
+            n for n in os.listdir(ckdir) if n.endswith(".manifest.json")
+        )
+        assert manifests[-1] == f"ckpt-{kill_at - EVERY:08d}.manifest.json"
+    assert not out.exists()  # the crash preceded the final write
+
+    rc, cap = _run(capsys, _args(grid16, out, ckdir, "--auto-resume"))
+    assert rc == 0
+    assert out.read_bytes() == ref_bytes
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_env_var_fault_plan_crosses_into_run(
+    tmp_path, grid16, reference, capsys, monkeypatch
+):
+    """GOL_FAULTS drives the same injection without argv (the subprocess
+    harness's channel), and the next env-clean run is fault-free."""
+    ref_bytes, _ = reference
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    monkeypatch.setenv("GOL_FAULTS", "kill_at_gen=6")
+    with pytest.raises(InjectedCrash):
+        cli.main(_args(grid16, out, ckdir))
+    monkeypatch.delenv("GOL_FAULTS")
+    rc, _ = _run(capsys, _args(grid16, out, ckdir, "--auto-resume"))
+    assert rc == 0
+    assert out.read_bytes() == ref_bytes
+
+
+def test_midwrite_failure_keeps_prior_and_resumes(
+    tmp_path, grid16, reference, capsys
+):
+    """Failing the 2nd checkpoint write (generation 6) mid-checkpoint: the
+    run aborts, generation 3 stays restorable, auto-resume completes to the
+    identical output."""
+    ref_bytes, ref_gens = reference
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    rc, cap = _run(capsys, _args(grid16, out, ckdir,
+                                 "--fault-plan", "payload_write_fail=2"))
+    assert rc == 1  # the injected OSError aborts the run loudly
+    assert "injected" in cap.err
+    _assert_prior_state_readable(str(ckdir))
+    names = os.listdir(ckdir)
+    assert "ckpt-00000003.manifest.json" in names  # prior state intact
+    assert "ckpt-00000006.manifest.json" not in names  # torn one invisible
+
+    rc, cap = _run(capsys, _args(grid16, out, ckdir, "--auto-resume"))
+    assert rc == 0
+    assert out.read_bytes() == ref_bytes
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_auto_resume_with_empty_dir_runs_from_scratch(
+    tmp_path, grid16, reference, capsys
+):
+    ref_bytes, ref_gens = reference
+    out = tmp_path / "out.out"
+    rc, cap = _run(capsys, _args(grid16, out, tmp_path / "ck", "--auto-resume"))
+    assert rc == 0
+    assert out.read_bytes() == ref_bytes
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_checkpointed_run_is_bit_exact_without_crashes(
+    tmp_path, grid16, reference, capsys
+):
+    """Checkpointing must not perturb the run it protects."""
+    ref_bytes, ref_gens = reference
+    out = tmp_path / "out.out"
+    rc, cap = _run(capsys, _args(grid16, out, tmp_path / "ck"))
+    assert rc == 0
+    assert out.read_bytes() == ref_bytes
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_auto_resume_respects_reduced_gen_limit(tmp_path, grid16, capsys):
+    """Rerunning with a smaller --gen-limit must not resurface a checkpoint
+    past the limit (the --resume-gen validator's guarantee): the run resumes
+    from the newest checkpoint at or below it — an exact prefix — or starts
+    fresh, and either way matches the uninterrupted shorter run."""
+    ckdir = tmp_path / "ck"
+    out = tmp_path / "out.out"
+    rc, _ = _run(capsys, _args(grid16, out, ckdir))  # checkpoints 6 and 9 kept
+    assert rc == 0
+    for limit, expect_resume in ((8, True), (5, False)):
+        ref = tmp_path / f"ref{limit}.out"
+        rc, cap = _run(capsys, [
+            "16", "16", grid16, "--variant", "game",
+            "--gen-limit", str(limit), "--output", str(ref),
+        ])
+        assert rc == 0
+        ref_gens = _gens_line(cap.out)
+        short_out = tmp_path / f"short{limit}.out"
+        rc, cap = _run(capsys, [
+            "16", "16", grid16, "--variant", "game",
+            "--gen-limit", str(limit),
+            "--checkpoint-every", str(EVERY), "--checkpoint-dir", str(ckdir),
+            "--auto-resume", "--output", str(short_out),
+        ])
+        assert rc == 0
+        assert short_out.read_bytes() == ref.read_bytes()
+        assert _gens_line(cap.out) == ref_gens
+        assert ("restored checkpoint" in cap.err) == expect_resume
+
+
+def test_stale_dir_from_different_input_never_restored(tmp_path, reference,
+                                                       capsys):
+    """A checkpoint dir reused across inputs: run B must never resume from
+    run A's state (manifest fingerprints mismatch), and must still produce
+    its own correct output."""
+    ref_bytes, ref_gens = reference
+    a_in = tmp_path / "a.txt"
+    text_grid.write_grid(str(a_in), text_grid.generate(16, 16, seed=99))
+    ckdir = tmp_path / "ck"
+    rc, _ = _run(capsys, _args(str(a_in), tmp_path / "a.out", ckdir))
+    assert rc == 0  # run A fills the dir with its checkpoints
+
+    b_in = tmp_path / "in.txt"  # the `reference` fixture's input (seed 77)
+    out = tmp_path / "b.out"
+    rc, cap = _run(capsys, _args(str(b_in), out, ckdir, "--auto-resume"))
+    assert rc == 0
+    assert "restored checkpoint" not in cap.err  # A's state was refused
+    assert out.read_bytes() == ref_bytes
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_similarity_exit_resumes_to_same_generation(tmp_path, capsys):
+    """Crash-resume across a similarity early-exit: the resumed run must
+    report the same early-exit generation (23), not re-count."""
+    infile = tmp_path / "sim.txt"
+    text_grid.write_grid(str(infile), text_grid.generate(16, 16, seed=26,
+                                                         density=0.25))
+    base = ["16", "16", str(infile), "--variant", "game", "--gen-limit", "40"]
+    out_ref = tmp_path / "ref.out"
+    rc, cap = _run(capsys, [*base, "--output", str(out_ref)])
+    assert rc == 0
+    ref_gens = _gens_line(cap.out)
+    assert ref_gens and ref_gens[0].split("\t")[1] == "23"  # scenario sanity
+
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    ck = ["--checkpoint-every", "5", "--checkpoint-dir", str(ckdir),
+          "--output", str(out)]
+    with pytest.raises(InjectedCrash):
+        cli.main([*base, *ck, "--fault-plan", "kill_at_gen=20"])
+    rc, cap = _run(capsys, [*base, *ck, "--auto-resume"])
+    assert rc == 0
+    assert out.read_bytes() == out_ref.read_bytes()
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_packed_io_lane_kill_and_resume(tmp_path, capsys):
+    """The packed lane's checkpoint codec (zarr when tensorstore is present,
+    packed text otherwise) through the same kill-and-resume cycle."""
+    infile = tmp_path / "in.txt"
+    text_grid.write_grid(str(infile), text_grid.generate(64, 64, seed=21,
+                                                         density=0.35))
+    base = ["64", "64", str(infile), "--variant", "tpu", "--packed-io",
+            "--gen-limit", str(GEN_LIMIT)]
+    out_ref = tmp_path / "ref.out"
+    rc, cap = _run(capsys, [*base, "--output", str(out_ref)])
+    assert rc == 0
+    ref_gens = _gens_line(cap.out)
+
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    ck = ["--checkpoint-every", str(EVERY), "--checkpoint-dir", str(ckdir),
+          "--output", str(out)]
+    with pytest.raises(InjectedCrash):
+        cli.main([*base, *ck, "--fault-plan", "kill_at_gen=6"])
+    _assert_prior_state_readable(str(ckdir))
+    rc, cap = _run(capsys, [*base, *ck, "--auto-resume"])
+    assert rc == 0
+    assert out.read_bytes() == out_ref.read_bytes()
+    assert _gens_line(cap.out) == ref_gens
+
+
+@pytest.mark.skipif(not ts_store.HAVE_TENSORSTORE,
+                    reason="tensorstore not installed")
+def test_packed_io_hard_shard_write_failure_mid_checkpoint(tmp_path, capsys):
+    """A hard tensorstore shard-write failure inside the 2nd checkpoint's
+    payload: the run aborts naming the shard, the 1st checkpoint survives,
+    auto-resume restores byte-identically."""
+    infile = tmp_path / "in.txt"
+    text_grid.write_grid(str(infile), text_grid.generate(64, 64, seed=21,
+                                                         density=0.35))
+    base = ["64", "64", str(infile), "--variant", "tpu", "--packed-io",
+            "--gen-limit", str(GEN_LIMIT)]
+    out_ref = tmp_path / "ref.out"
+    rc, _ = _run(capsys, [*base, "--output", str(out_ref)])
+    assert rc == 0
+
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    ck = ["--checkpoint-every", str(EVERY), "--checkpoint-dir", str(ckdir),
+          "--output", str(out)]
+    # The first checkpoint writes one shard per device; failing write
+    # devices+1 lands inside the SECOND checkpoint's payload.
+    import jax
+
+    nth = jax.local_device_count() + 1
+    rc, cap = _run(capsys, [*base, *ck, "--fault-plan",
+                            f"ts_write_fail={nth}"])
+    assert rc == 1
+    assert "shard indices" in cap.err
+    _assert_prior_state_readable(str(ckdir))
+    assert any(n.endswith(".manifest.json") for n in os.listdir(ckdir))
+
+    rc, _ = _run(capsys, [*base, *ck, "--auto-resume"])
+    assert rc == 0
+    assert out.read_bytes() == out_ref.read_bytes()
+
+
+def test_transient_faults_heal_without_aborting(tmp_path, grid16, reference,
+                                                capsys):
+    """Transient injected IO failures are retried under the unified policy:
+    the run completes with no crash and the identical output."""
+    if not ts_store.HAVE_TENSORSTORE:
+        pytest.skip("tensorstore not installed")
+    infile = tmp_path / "in.txt"
+    text_grid.write_grid(str(infile), text_grid.generate(64, 64, seed=21,
+                                                         density=0.35))
+    base = ["64", "64", str(infile), "--variant", "tpu", "--packed-io",
+            "--gen-limit", str(GEN_LIMIT)]
+    out_ref = tmp_path / "ref.out"
+    rc, _ = _run(capsys, [*base, "--output", str(out_ref)])
+    assert rc == 0
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    rc, _ = _run(capsys, [*base, "--checkpoint-every", str(EVERY),
+                          "--checkpoint-dir", str(ckdir),
+                          "--output", str(out), "--fault-plan",
+                          "ts_write_fail=2,ts_write_error=transient,"
+                          "ts_open_transient=1"])
+    assert rc == 0
+    assert out.read_bytes() == out_ref.read_bytes()
+
+
+class TestFlagValidation:
+    def _rc_err(self, capsys, args):
+        capsys.readouterr()
+        rc = cli.main(args)
+        return rc, capsys.readouterr().err
+
+    def test_dir_without_mode(self, tmp_path, grid16, capsys):
+        rc, err = self._rc_err(capsys, [
+            "16", "16", grid16, "--checkpoint-dir", str(tmp_path / "ck")])
+        assert rc == 1 and "--checkpoint-every" in err
+
+    def test_nonpositive_interval(self, tmp_path, grid16, capsys):
+        rc, err = self._rc_err(capsys, [
+            "16", "16", grid16, "--checkpoint-every", "0"])
+        assert rc == 1 and "positive" in err
+
+    def test_snapshot_every_conflicts(self, tmp_path, grid16, capsys):
+        rc, err = self._rc_err(capsys, [
+            "16", "16", grid16, "--checkpoint-every", "3",
+            "--snapshot-every", "3"])
+        assert rc == 1 and "snapshot" in err
+
+    def test_auto_resume_conflicts_with_resume_gen(self, tmp_path, grid16,
+                                                   capsys):
+        rc, err = self._rc_err(capsys, [
+            "16", "16", grid16, "--auto-resume", "--resume-gen", "5"])
+        assert rc == 1 and "--resume-gen" in err
+
+    def test_host_has_no_checkpoint_lane(self, tmp_path, grid16, capsys):
+        rc, err = self._rc_err(capsys, [
+            "16", "16", grid16, "--host", "--checkpoint-every", "3"])
+        assert rc == 1 and "--host" in err
+
+    def test_bad_fault_plan_is_loud(self, tmp_path, grid16, capsys):
+        rc, err = self._rc_err(capsys, [
+            "16", "16", grid16, "--fault-plan", "ts_write_fial=1"])
+        assert rc == 1 and "unknown fault plan key" in err

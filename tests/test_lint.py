@@ -1,0 +1,32 @@
+"""Tier-1 lint gates: source-level rules the advisor rounds keep re-fixing.
+
+Advisor r4 flagged raw ``sys.stderr.write`` calls in library code (the kernel
+ladder's demotion messages); the resilience pass routed them through the
+``logging`` module (``gol_tpu.engine`` logger, stderr handler attached by the
+entry points — platform_env.configure_cli_logging). This test keeps that
+regression class from coming back: library modules must log, never write the
+stream directly — an embedder owns routing, and a handler owns truncation.
+"""
+
+import pathlib
+
+import gol_tpu
+
+_LIBRARY_ROOT = pathlib.Path(gol_tpu.__file__).parent
+_FORBIDDEN = "sys.stderr.write"
+
+
+def test_no_raw_stderr_write_in_library_code():
+    offenders = []
+    for path in sorted(_LIBRARY_ROOT.rglob("*.py")):
+        for lineno, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), start=1
+        ):
+            code = line.split("#", 1)[0]  # prose may name the rule; code may not
+            if _FORBIDDEN in code:
+                offenders.append(f"{path.relative_to(_LIBRARY_ROOT)}:{lineno}")
+    assert not offenders, (
+        f"raw {_FORBIDDEN} in gol_tpu/ library code (route through "
+        f"logging.getLogger(__name__) instead; see platform_env."
+        f"configure_cli_logging): {offenders}"
+    )

@@ -5,6 +5,9 @@ The reference's de-facto methodology — agreement on generate.sh random inputs
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis", reason="hypothesis not installed")
 from hypothesis import given, settings, strategies as st
 
 from gol_tpu import engine, oracle

@@ -1,0 +1,512 @@
+"""Resilience subsystem: unified retry policy, fault plans, atomic
+checkpoints, and resume-equivalence (interrupt anywhere, replay exactly).
+
+The checkpoint tests drive CheckpointManager with a plain numpy codec so the
+crash-ordering argument (payload first, manifest committed atomically last,
+GC after) is pinned independently of any tensorstore/jax IO stack; the CLI
+end-to-end harness lives in tests/test_crash_recovery.py.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.parallel.collectives import host_all_agree
+from gol_tpu.resilience import faults
+from gol_tpu.resilience.checkpoint import CheckpointManager, PayloadCodec
+from gol_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedCrash,
+    InjectedWriteError,
+    TransientInjectedError,
+)
+from gol_tpu.resilience.retry import RetryPolicy, is_transient_io
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with no fault plan armed."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+
+
+class TestRetryPolicy:
+    def test_first_try_success_no_sleep(self):
+        sleeps = []
+        out = RetryPolicy(attempts=3).call(lambda: 42, sleep=sleeps.append)
+        assert out == 42
+        assert sleeps == []
+
+    def test_transient_failures_heal(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("connection reset by peer")
+            return "ok"
+
+        sleeps = []
+        out = RetryPolicy(attempts=3, base_delay=0.05, multiplier=2.0).call(
+            flaky, sleep=sleeps.append
+        )
+        assert out == "ok"
+        assert calls["n"] == 3
+        assert sleeps == [0.05, 0.1]
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise ValueError("shape mismatch UNAVAILABLE")  # text is a decoy
+
+        with pytest.raises(ValueError):
+            RetryPolicy(attempts=5, base_delay=0).call(bad)
+        assert calls["n"] == 1
+
+    def test_attempts_exhausted_raises_last_error(self):
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise OSError(f"timed out #{calls['n']}")
+
+        with pytest.raises(OSError, match="#3"):
+            RetryPolicy(attempts=3, base_delay=0).call(always)
+        assert calls["n"] == 3
+
+    def test_backoff_caps_at_max_delay(self):
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise OSError("try again")
+
+        sleeps = []
+        with pytest.raises(OSError):
+            RetryPolicy(
+                attempts=5, base_delay=0.1, multiplier=4.0, max_delay=0.5
+            ).call(always, sleep=sleeps.append)
+        assert sleeps == [0.1, 0.4, 0.5, 0.5]
+
+    def test_deadline_stops_retrying(self):
+        now = {"t": 0.0}
+
+        def clock():
+            return now["t"]
+
+        def sleep(d):
+            now["t"] += d
+
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            now["t"] += 1.0  # each attempt costs a second
+            raise OSError("timed out")
+
+        with pytest.raises(OSError):
+            RetryPolicy(attempts=10, base_delay=0.1, deadline=2.5).call(
+                always, sleep=sleep, clock=clock
+            )
+        assert calls["n"] < 10  # the deadline cut the attempts short
+
+    def test_on_retry_observes_each_backoff(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("temporarily unavailable")
+            return 1
+
+        seen = []
+        RetryPolicy(attempts=3, base_delay=0).call(
+            flaky, on_retry=lambda a, e, d: seen.append(a)
+        )
+        assert seen == [1, 2]
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(base_delay=-1)
+
+    def test_is_transient_io_classification(self):
+        assert is_transient_io(OSError("Connection reset by peer"))
+        assert is_transient_io(OSError("DEADLINE_EXCEEDED while writing"))
+        assert is_transient_io(TransientInjectedError("somewhere"))
+        assert not is_transient_io(InjectedWriteError("somewhere"))
+        assert not is_transient_io(OSError("no space left on device"))
+        # ValueError never heals on retry, whatever its text claims.
+        assert not is_transient_io(ValueError("UNAVAILABLE"))
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+
+
+class TestFaultPlan:
+    def test_parse_spec(self):
+        plan = FaultPlan.parse(
+            "ts_write_fail=2,ts_write_error=transient,kill_at_gen=5"
+        )
+        assert plan.ts_write_fail == 2
+        assert plan.ts_write_error == "transient"
+        assert plan.kill_at_gen == 5
+        assert plan.kill_mode == "exception"
+
+    def test_parse_rejects_unknown_key(self):
+        with pytest.raises(ValueError, match="unknown fault plan key"):
+            FaultPlan.parse("ts_write_fial=2")
+
+    def test_parse_rejects_bad_enum_and_shape(self):
+        with pytest.raises(ValueError, match="kill_mode"):
+            FaultPlan.parse("kill_mode=nuke")
+        with pytest.raises(ValueError, match="not k=v"):
+            FaultPlan.parse("kill_at_gen")
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("GOL_FAULTS", "payload_write_fail=1")
+        plan = FaultPlan.from_env()
+        assert plan is not None and plan.payload_write_fail == 1
+        monkeypatch.delenv("GOL_FAULTS")
+        assert FaultPlan.from_env() is None
+
+    def test_disarmed_probes_are_noops(self):
+        faults.on_ts_open()
+        faults.on_ts_shard_write(0)
+        faults.on_payload_write("/x")
+        faults.on_checkpoint_boundary(10**9)
+
+    def test_nth_shard_write_fails(self):
+        faults.install(FaultPlan(ts_write_fail=2))
+        faults.on_ts_shard_write(0)
+        with pytest.raises(InjectedWriteError, match="shard 7"):
+            faults.on_ts_shard_write(7)
+        faults.on_ts_shard_write(8)  # only the Nth fails
+
+    def test_transient_shard_write_mode(self):
+        faults.install(FaultPlan(ts_write_fail=1, ts_write_error="transient"))
+        with pytest.raises(TransientInjectedError):
+            faults.on_ts_shard_write(0)
+
+    def test_open_transient_burst(self):
+        faults.install(FaultPlan(ts_open_transient=2))
+        for _ in range(2):
+            with pytest.raises(TransientInjectedError):
+                faults.on_ts_open()
+        faults.on_ts_open()  # the burst is over
+
+    def test_kill_at_boundary_fires_once(self):
+        faults.install(FaultPlan(kill_at_gen=6))
+        faults.on_checkpoint_boundary(3)
+        with pytest.raises(InjectedCrash):
+            faults.on_checkpoint_boundary(6)
+        # A resumed run re-reaching boundaries must not be re-killed.
+        faults.on_checkpoint_boundary(9)
+
+    def test_injected_crash_evades_except_exception(self):
+        # The whole point: library-level `except Exception` must not absorb
+        # a simulated SIGKILL.
+        assert not issubclass(InjectedCrash, Exception)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager (numpy codec: jax/tensorstore-independent ordering tests)
+
+
+def _np_codec() -> PayloadCodec:
+    return PayloadCodec(
+        format="npy",
+        suffix=".npy",
+        write=lambda path, state: np.save(path, np.asarray(state)),
+        read=lambda path: np.load(path),
+    )
+
+
+def _mgr(directory, keep=2, h=8, w=8, fingerprint=None) -> CheckpointManager:
+    return CheckpointManager(
+        str(directory), height=h, width=w, codec=_np_codec(), keep=keep,
+        run_fingerprint=fingerprint,
+    )
+
+
+def _grid(seed, h=8, w=8):
+    return np.random.default_rng(seed).integers(0, 2, size=(h, w)).astype(np.uint8)
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        g = _grid(1)
+        mgr.save(g, 5, 2)
+        state, info = mgr.restore()
+        np.testing.assert_array_equal(np.asarray(state), g)
+        assert (info.generation, info.counter) == (5, 2)
+
+    def test_empty_dir_restores_none(self, tmp_path):
+        assert _mgr(tmp_path).restore() is None
+
+    def test_payload_without_manifest_is_invisible(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        np.save(os.path.join(str(tmp_path), "ckpt-00000007.npy"), _grid(2))
+        assert mgr.restore() is None
+
+    def test_gc_keeps_newest_k(self, tmp_path):
+        mgr = _mgr(tmp_path, keep=2)
+        for gen in (3, 6, 9):
+            mgr.save(_grid(gen), gen, 0)
+        names = sorted(os.listdir(tmp_path))
+        assert names == [
+            "ckpt-00000006.manifest.json",
+            "ckpt-00000006.npy",
+            "ckpt-00000009.manifest.json",
+            "ckpt-00000009.npy",
+        ]
+
+    def test_gc_sweeps_stale_staging_leftovers(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(_grid(3), 3, 0)
+        # A codec writer crashed mid-payload on a previous run: ckpt-prefixed
+        # staging leftovers must be swept by the next save's GC, not leak one
+        # grid-sized file per crash.
+        stale = ("ckpt-00000006.npy.inprogress", "ckpt-00000003.npy.replaced",
+                 "ckpt-00000006.manifest.json.tmp")
+        for name in stale:
+            with open(os.path.join(str(tmp_path), name), "wb") as f:
+                f.write(b"torn")
+        mgr.save(_grid(6), 6, 0)
+        names = sorted(os.listdir(tmp_path))
+        assert names == [
+            "ckpt-00000003.manifest.json",
+            "ckpt-00000003.npy",
+            "ckpt-00000006.manifest.json",
+            "ckpt-00000006.npy",
+        ]
+
+    def test_corrupt_newest_falls_back_to_older(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        g3, g6 = _grid(3), _grid(6)
+        mgr.save(g3, 3, 0)
+        mgr.save(g6, 6, 0)
+        # Silent payload corruption: a valid .npy holding the WRONG bytes —
+        # only the manifest checksums can catch it.
+        np.save(os.path.join(str(tmp_path), "ckpt-00000006.npy"), _grid(999))
+        state, info = mgr.restore()
+        assert info.generation == 3
+        np.testing.assert_array_equal(np.asarray(state), g3)
+
+    def test_torn_manifest_falls_back(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(_grid(3), 3, 0)
+        mgr.save(_grid(6), 6, 0)
+        manifest = os.path.join(str(tmp_path), "ckpt-00000006.manifest.json")
+        with open(manifest, "w") as f:
+            f.write('{"format_version": 1, "generation"')  # torn mid-write
+        state, info = mgr.restore()
+        assert info.generation == 3
+
+    def test_geometry_mismatch_rejected(self, tmp_path):
+        _mgr(tmp_path, h=8, w=8).save(_grid(4), 4, 0)
+        assert _mgr(tmp_path, h=16, w=16).restore() is None
+
+    def test_resave_of_committed_generation_is_noop(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(_grid(5), 5, 1)
+        manifest = os.path.join(str(tmp_path), "ckpt-00000005.manifest.json")
+        before = open(manifest, "rb").read()
+        mgr.save(_grid(5), 5, 1)  # a resumed run re-reaching the boundary
+        assert open(manifest, "rb").read() == before
+
+    def test_manifest_records_checksums_and_geometry(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(_grid(5), 5, 1)
+        with open(os.path.join(str(tmp_path), "ckpt-00000005.manifest.json")) as f:
+            m = json.load(f)
+        assert m["height"] == 8 and m["width"] == 8
+        assert m["payload"] == "ckpt-00000005.npy"
+        assert m["checksums"]  # at least one block CRC
+
+    def test_midwrite_failure_keeps_prior_restorable(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        g3 = _grid(3)
+        mgr.save(g3, 3, 0)
+        faults.install(FaultPlan(payload_write_fail=1))
+        with pytest.raises(InjectedWriteError):
+            mgr.save(_grid(6), 6, 0)
+        faults.clear()
+        # The fault TORE the gen-6 payload mid-file and aborted before the
+        # manifest commit: the torn payload is invisible garbage, gen 3
+        # intact.
+        torn = os.path.join(str(tmp_path), "ckpt-00000006.npy")
+        intact = os.path.join(str(tmp_path), "ckpt-00000003.npy")
+        assert os.path.exists(torn)
+        # Genuinely truncated: half the bytes of the intact sibling payload.
+        assert os.path.getsize(torn) < os.path.getsize(intact)
+        state, info = mgr.restore()
+        assert info.generation == 3
+        np.testing.assert_array_equal(np.asarray(state), g3)
+        # And a healthy retry of the same boundary goes through.
+        g6 = _grid(6)
+        mgr.save(g6, 6, 0)
+        state, info = mgr.restore()
+        assert info.generation == 6
+        np.testing.assert_array_equal(np.asarray(state), g6)
+
+    def test_kill_at_boundary_preserves_prior(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(_grid(3), 3, 0)
+        faults.install(FaultPlan(kill_at_gen=6))
+        with pytest.raises(InjectedCrash):
+            mgr.save(_grid(6), 6, 0)
+        faults.clear()
+        state, info = mgr.restore()
+        assert info.generation == 3
+
+    def test_keep_must_be_positive(self, tmp_path):
+        with pytest.raises(ValueError, match="keep"):
+            _mgr(tmp_path, keep=0)
+
+    def test_foreign_run_checkpoints_invisible_and_collected(self, tmp_path):
+        # Run A leaves checkpoints in the dir; run B (different input, same
+        # geometry) must never restore A's state, and A's numerically-newer
+        # generations must neither shadow nor out-sort B's fresh ones.
+        a = _mgr(tmp_path, fingerprint="run-a")
+        a.save(_grid(1), 6, 0)
+        a.save(_grid(2), 9, 0)
+        b = _mgr(tmp_path, fingerprint="run-b")
+        assert b.restore() is None
+        g3 = _grid(3)
+        b.save(g3, 3, 0)  # GC sweeps A's leftovers, keeps B's gen 3
+        state, info = b.restore()
+        assert info.generation == 3
+        np.testing.assert_array_equal(np.asarray(state), g3)
+        assert sorted(os.listdir(tmp_path)) == [
+            "ckpt-00000003.manifest.json",
+            "ckpt-00000003.npy",
+        ]
+
+    def test_restore_max_generation_skips_newer(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        g6 = _grid(6)
+        mgr.save(g6, 6, 0)
+        mgr.save(_grid(9), 9, 0)
+        state, info = mgr.restore(max_generation=8)
+        assert info.generation == 6
+        np.testing.assert_array_equal(np.asarray(state), g6)
+        assert mgr.restore(max_generation=5) is None
+
+    def test_run_fingerprint_is_input_sensitive(self):
+        from gol_tpu.resilience.checkpoint import run_fingerprint
+
+        g = _grid(1)
+        assert run_fingerprint(g) == run_fingerprint(g.copy())
+        assert run_fingerprint(g) != run_fingerprint(_grid(2))
+        assert run_fingerprint(g, tag="c") != run_fingerprint(g, tag="cuda")
+        # Positional, not just multiset: a transposed grid must not collide.
+        gt = np.ascontiguousarray(g.T)
+        assert (g != gt).any() and run_fingerprint(g) != run_fingerprint(gt)
+
+    def test_run_fingerprint_decomposition_independent(self):
+        # The same state under ANY shard decomposition must fingerprint
+        # identically — a rerun on a different mesh still recognizes its own
+        # checkpoints instead of GC-ing them as foreign.
+        from gol_tpu.resilience.checkpoint import run_fingerprint
+
+        g = _grid(7)
+
+        def sharded(cuts):
+            shards = [
+                type("S", (), {"data": g[rs, cs], "index": (rs, cs)})()
+                for rs, cs in cuts
+            ]
+            return type("A", (), {"shape": g.shape,
+                                  "addressable_shards": shards})()
+
+        rows = sharded([(slice(0, 4), slice(0, 8)), (slice(4, 8), slice(0, 8))])
+        cols = sharded([(slice(0, 8), slice(0, 4)), (slice(0, 8), slice(4, 8))])
+        quads = sharded([
+            (slice(0, 4), slice(0, 4)), (slice(0, 4), slice(4, 8)),
+            (slice(4, 8), slice(0, 4)), (slice(4, 8), slice(4, 8)),
+        ])
+        whole = run_fingerprint(g)
+        assert run_fingerprint(rows) == whole
+        assert run_fingerprint(cols) == whole
+        assert run_fingerprint(quads) == whole
+
+
+def test_host_all_agree_single_process():
+    assert host_all_agree(True) is True
+    assert host_all_agree(False) is False
+
+
+# ---------------------------------------------------------------------------
+# Resume equivalence: interrupting at EVERY generation k and resuming via
+# resume_scalars reproduces the uninterrupted run — output grid, generation
+# count, and exit reason — on both the similarity-exit and limit-exit paths.
+
+
+def _run_to_end(state, config, completed):
+    last = None
+    for out in engine.simulate_segments(
+        state, config, None, "lax", segment=5, completed=completed
+    ):
+        last = out
+    return last
+
+
+def _check_resume_at_every_generation(g, config):
+    ref = oracle.run(g, config)
+    interior = []  # (completed_generations, state) at every interrupt point
+    last = None
+    for gens, state, stopped in engine.simulate_segments(g, config, None, "lax", 1):
+        if not stopped:
+            interior.append((gens, np.asarray(state, np.uint8)))
+        last = (gens, np.asarray(state, np.uint8), stopped)
+    gens, final, stopped = last
+    assert stopped and gens == ref.generations
+    np.testing.assert_array_equal(final, ref.grid)
+
+    for completed, state_k in interior:
+        rgens, rfinal, rstopped = _run_to_end(state_k, config, completed)
+        assert rstopped
+        assert rgens == ref.generations, (
+            f"resume at k={completed} reported {rgens}, "
+            f"uninterrupted reported {ref.generations}"
+        )
+        np.testing.assert_array_equal(np.asarray(rfinal, np.uint8), ref.grid)
+    return ref
+
+
+@pytest.mark.parametrize("convention", [Convention.C, Convention.CUDA])
+def test_resume_equivalence_limit_exit(convention):
+    g = _grid(13, 16, 16)
+    config = GameConfig(gen_limit=18, convention=convention)
+    ref = _check_resume_at_every_generation(g, config)
+    # Scenario sanity: this grid actually runs to the limit.
+    assert ref.generations == config.gen_limit
+
+
+@pytest.mark.parametrize("convention", [Convention.C, Convention.CUDA])
+def test_resume_equivalence_similarity_exit(convention):
+    # This grid settles into still lifes and similarity-exits at generation
+    # 23 under both conventions — every interrupt point k < 23 must replay
+    # through the exit machinery to the same early-exit generation.
+    from gol_tpu.io import text_grid
+
+    g = text_grid.generate(16, 16, seed=26, density=0.25)
+    config = GameConfig(gen_limit=40, convention=convention)
+    ref = _check_resume_at_every_generation(g, config)
+    assert ref.generations < config.gen_limit  # scenario sanity: early exit
+    assert ref.grid.any()  # similarity exit, not the empty-grid exit

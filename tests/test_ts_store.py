@@ -2,6 +2,8 @@
 "sharded TensorStore I/O") — round trips, mesh sharding, and the CLI
 zarr-snapshot/resume lane."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -91,3 +93,79 @@ def test_zarr_flags_rejected_off_packed_lane(tmp_path, monkeypatch, capsys):
                    "--snapshot-every", "5", "--snapshot-format", "zarr"])
     assert rc == 1
     assert "--packed-io" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Failure semantics (resilience pass): the writer never deletes the only
+# durable copy, awaits every shard, and retries transients.
+
+from gol_tpu.resilience import faults as _faults
+from gol_tpu.resilience.faults import FaultPlan
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    _faults.clear()
+    yield
+    _faults.clear()
+
+
+def _words(seed):
+    return packed_math.encode(text_grid.generate(64, 32, seed=seed))
+
+
+def test_overwrite_crash_preserves_prior_store(tmp_path):
+    path = str(tmp_path / "state.zarr")
+    w1, w2 = _words(40), _words(41)
+    ts_store.write_words(path, w1, 64)
+    _faults.install(FaultPlan(ts_write_fail=1))
+    with pytest.raises(OSError, match=r"shard indices \[0\]"):
+        ts_store.write_words(path, w2, 64)
+    _faults.clear()
+    # The failed overwrite went to a staging sibling; the prior store is
+    # byte-for-byte intact.
+    back = ts_store.read_words(path, 64, 32)
+    assert np.array_equal(np.asarray(back), np.asarray(w1))
+    # A healthy rewrite then commits and sweeps the staging path.
+    ts_store.write_words(path, w2, 64)
+    back = ts_store.read_words(path, 64, 32)
+    assert np.array_equal(np.asarray(back), np.asarray(w2))
+    leftovers = [n for n in os.listdir(tmp_path)
+                 if n.endswith((".inprogress", ".replaced"))]
+    assert leftovers == []
+
+
+def test_overwrite_transient_faults_heal(tmp_path):
+    path = str(tmp_path / "state.zarr")
+    w1, w2 = _words(42), _words(43)
+    ts_store.write_words(path, w1, 64)
+    _faults.install(FaultPlan(ts_write_fail=1, ts_write_error="transient",
+                              ts_open_transient=1))
+    ts_store.write_words(path, w2, 64)  # open + write both hiccup, both heal
+    back = ts_store.read_words(path, 64, 32)
+    assert np.array_equal(np.asarray(back), np.asarray(w2))
+
+
+def test_mesh_write_awaits_all_shards_and_names_failures(tmp_path):
+    import jax
+    from gol_tpu.io.packed_io import words_sharding
+
+    mesh = make_mesh(2, 2)
+    g = text_grid.generate(128, 32, seed=44)
+    words = jax.device_put(np.asarray(packed_math.encode(g)),
+                           words_sharding(mesh))
+    _faults.install(FaultPlan(ts_write_fail=3))
+    with pytest.raises(OSError, match=r"shard indices \[2\]"):
+        ts_store.write_words(str(tmp_path / "s.zarr"), words, 128)
+
+
+def test_read_words_recovers_displaced_store(tmp_path):
+    """A crash between _swap_in's two renames leaves only path.replaced;
+    read_words must recover it instead of failing the resume."""
+    path = str(tmp_path / "state.zarr")
+    w1 = _words(45)
+    ts_store.write_words(path, w1, 64)
+    os.rename(path, path + ".replaced")
+    back = ts_store.read_words(path, 64, 32)
+    assert np.array_equal(np.asarray(back), np.asarray(w1))
+    assert os.path.isdir(path) and not os.path.exists(path + ".replaced")
