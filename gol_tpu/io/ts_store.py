@@ -233,24 +233,63 @@ def write_words(
         # retries cover the tensorstore open/write calls around it).
         from jax.experimental import multihost_utils
 
+        create_err: Exception | None = None
         if jax.process_index() == 0:
-            if staged is not None:
-                shutil.rmtree(staged, ignore_errors=True)
-            _open(target, retry, (height, nwords), chunks,
-                  create=True, delete_existing=True)
+            try:
+                if staged is not None:
+                    shutil.rmtree(staged, ignore_errors=True)
+                _open(target, retry, (height, nwords), chunks,
+                      create=True, delete_existing=True)
+            except Exception as e:
+                create_err = e
+        # The lead's create failure must reach every process BEFORE peers
+        # park at the create barrier (they would wait there until the
+        # distributed-runtime timeout while the lead raises alone).
+        from gol_tpu.parallel.collectives import host_all_agree
+
+        if not host_all_agree(create_err is None):
+            if create_err is not None:
+                raise create_err
+            raise OSError(
+                f"write_words: lead process failed to create {target}")
         multihost_utils.sync_global_devices(
             f"gol_tpu.ts_store.create:{target}")
-        store = _open(target, retry)
+        store = None  # opened inside the guarded region below
     else:
         if staged is not None:
             shutil.rmtree(staged, ignore_errors=True)
         store = _open(target, retry, (height, nwords), chunks,
                       create=True, delete_existing=True)
-    _write_shards(store, list(words.addressable_shards), retry)
+    write_err: Exception | None = None
+    try:
+        if store is None:
+            # The post-barrier open is guarded too: an open failure on one
+            # process must reach the vote below, not bypass it and leave
+            # peers waiting there.
+            store = _open(target, retry)
+        _write_shards(store, list(words.addressable_shards), retry)
+    except Exception as e:
+        if not (multihost and staged is not None):
+            raise
+        write_err = e
     if staged is not None:
         if multihost:
             from jax.experimental import multihost_utils
 
+            from gol_tpu.parallel.collectives import host_all_agree
+
+            # A process whose shard writes failed must not exit while its
+            # peers park at the commit barrier below until the
+            # distributed-runtime timeout: vote on success first, the
+            # failing process voting False before re-raising, so everyone
+            # abandons the staged store together (the live store at ``path``
+            # stays untouched).
+            if not host_all_agree(write_err is None):
+                if write_err is not None:
+                    raise write_err
+                raise OSError(
+                    f"write_words: a peer process failed its shard writes; "
+                    f"abandoning staged store {staged}")
             # Every shard everywhere is durable before anyone swaps; only
             # the lead renames, and peers wait for the commit.
             multihost_utils.sync_global_devices(

@@ -1,0 +1,10 @@
+"""jax-version compat shims for the pallas TPU kernels — one home, like
+``parallel/mesh.py``'s ``shard_map`` shim, so a future jax rename is fixed
+once instead of per-kernel-file."""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+# Older jax names the params class TPUCompilerParams; same fields.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams

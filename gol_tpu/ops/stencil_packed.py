@@ -34,10 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Older jax names the params class TPUCompilerParams; same fields.
-_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
-
 from gol_tpu.ops import packed_math
+from gol_tpu.ops.pallas_compat import CompilerParams as _CompilerParams
 from gol_tpu.parallel import collectives, halo
 from gol_tpu.parallel.mesh import ROW_AXIS, SINGLE_DEVICE as SINGLE_DEVICE_TOPOLOGY, Topology
 

@@ -32,6 +32,14 @@ class _DynamicStderrHandler(logging.StreamHandler):
 
         return sys.stderr
 
+    @stream.setter
+    def stream(self, value):
+        # Keep the StreamHandler contract: ``setStream()`` / direct
+        # ``handler.stream = ...`` assignment must not raise. The assignment
+        # is accepted but has no effect — this handler is dynamic by design,
+        # so redirecting ``sys.stderr`` itself is how output gets rerouted.
+        del value
+
 
 def configure_cli_logging(level: int = logging.INFO) -> None:
     """Route the ``gol_tpu`` logger tree to stderr for application entry

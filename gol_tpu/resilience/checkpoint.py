@@ -19,8 +19,9 @@ write-ahead discipline:
    manifest pointing at nothing).
 
 Recovery (``restore``) walks manifests newest-first and returns the first
-whose payload reads back and checksums clean. On multihost runs the processes
-vote — ``parallel/collectives.host_all_agree`` — so the run resumes from the
+whose payload reads back and checksums clean. On multihost runs the
+processes vote — one collective per candidate (``_collective_is_valid``),
+pooling both readability and CRC coverage — so the run resumes from the
 newest manifest *every* process can read, never a mix.
 
 The payload encoding is pluggable (``PayloadCodec``): the packed lane stores
@@ -73,6 +74,18 @@ class CheckpointInfo:
     path: str  # manifest path
 
 
+@dataclasses.dataclass(frozen=True)
+class _LoadedCheckpoint:
+    """One process's collective-free view of a candidate checkpoint; the
+    cluster-wide verdict comes from ``_collective_is_valid``."""
+
+    state: Any
+    info: CheckpointInfo
+    local_ok: bool  # every locally-checked block CRC-matched
+    verified: frozenset  # manifest keys this process actually checked
+    recorded: frozenset  # every key the manifest records
+
+
 def _block_key(r0: int, r1: int, c0: int, c1: int) -> str:
     return f"{r0}:{r1},{c0}:{c1}"
 
@@ -82,6 +95,29 @@ def _parse_key(key: str) -> tuple[int, int, int, int]:
     r0, r1 = (int(x) for x in rows.split(":"))
     c0, c1 = (int(x) for x in cols.split(":"))
     return r0, r1, c0, c1
+
+
+_LIMB_BITS = 16
+_LIMB_COUNT = 4
+_MASK64 = (1 << 64) - 1
+
+
+def _fingerprint_limbs(partial: int) -> np.ndarray:
+    """Split a 64-bit fingerprint partial into four 16-bit limbs in int32:
+    jax may be running without x64, and an allgather payload silently
+    downcast to int32 must stay lossless. (Two 31-bit halves would drop bits
+    62-63 and make the merged fingerprint decomposition-dependent.)"""
+    return np.asarray(
+        [(partial >> (_LIMB_BITS * i)) & 0xFFFF for i in range(_LIMB_COUNT)],
+        np.int32)
+
+
+def _merge_fingerprint_limbs(everyone) -> int:
+    """Sum per-limb, then fold the carries in Python ints so the result is
+    EXACTLY ``sum(partials) mod 2**64`` — the property that makes the same
+    state fingerprint identically under ANY process decomposition."""
+    sums = np.asarray(everyone, np.int64).reshape(-1, _LIMB_COUNT).sum(axis=0)
+    return sum(int(s) << (_LIMB_BITS * i) for i, s in enumerate(sums)) & _MASK64
 
 
 def run_fingerprint(state, tag: str = "") -> str:
@@ -121,15 +157,8 @@ def run_fingerprint(state, tag: str = "") -> str:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        # Exchange as two 31-bit halves: jax may be running without x64, and
-        # an allgather payload silently downcast to int32 would corrupt the
-        # hash differently per process.
-        halves = np.asarray([total & 0x7FFFFFFF, (total >> 31) & 0x7FFFFFFF],
-                            np.int32)
-        everyone = np.asarray(multihost_utils.process_allgather(halves),
-                              np.int64).reshape(-1, 2)
-        total = int((everyone[:, 0].sum() + (everyone[:, 1].sum() << 31))
-                    & 0xFFFFFFFFFFFFFFFF)
+        everyone = multihost_utils.process_allgather(_fingerprint_limbs(total))
+        total = _merge_fingerprint_limbs(everyone)
     return f"{total:016x}" + (f":{tag}" if tag else "")
 
 
@@ -152,6 +181,23 @@ def _shard_checksums(state) -> dict[str, int]:
     return sums
 
 
+def _allgather_json(obj) -> list:
+    """Allgather one JSON-serializable value per process, returned in process
+    order. The payload rides as a length-prefixed uint8 blob: jax may be
+    running without x64, and int32 lengths + uint8 bytes survive any
+    downcast policy losslessly."""
+    from jax.experimental import multihost_utils
+
+    blob = np.frombuffer(json.dumps(obj, sort_keys=True).encode(), np.uint8)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray(len(blob), np.int32))).ravel()
+    padded = np.zeros((max(int(lens.max()), 1),), np.uint8)
+    padded[: len(blob)] = blob
+    everyone = np.asarray(multihost_utils.process_allgather(padded))
+    return [json.loads(bytes(everyone[i, : int(n)]).decode())
+            for i, n in enumerate(lens)]
+
+
 def _allgather_checksums(sums: dict[str, int]) -> dict[str, int]:
     """Union of every process's shard checksums. The manifest is committed
     by the lead alone; without this merge it would record only the lead's
@@ -160,52 +206,74 @@ def _allgather_checksums(sums: dict[str, int]) -> dict[str, int]:
 
     if jax.process_count() == 1:
         return sums
-    from jax.experimental import multihost_utils
-
-    blob = np.frombuffer(
-        json.dumps(sums, sort_keys=True).encode(), np.uint8)
-    lens = np.asarray(multihost_utils.process_allgather(
-        np.asarray(len(blob), np.int32))).ravel()
-    padded = np.zeros((int(lens.max()),), np.uint8)
-    padded[: len(blob)] = blob
-    everyone = np.asarray(multihost_utils.process_allgather(padded))
     merged: dict[str, int] = {}
-    for i, n in enumerate(lens):
-        merged.update(json.loads(bytes(everyone[i, : int(n)]).decode()))
+    for peer in _allgather_json(sums):
+        merged.update(peer)
     return merged
 
 
-def _verify_checksums(state, checksums: dict[str, int]) -> bool:
-    """Re-verify every recorded block this process can address. Blocks owned
-    entirely by peers are skipped (they verify their own); a block that
-    straddles shards is re-sliced from the host copy on single-process runs.
+def _verify_checksums(state, checksums: dict[str, int]) -> tuple[bool, set[str]]:
+    """LOCAL re-verification: ``(every checked block matched, keys checked)``.
+
+    Single-process: every block is re-sliced from the host copy, so any
+    writer decomposition verifies and the returned key set covers the whole
+    manifest. Multihost: a recorded block is checked when this process's
+    shards tile its region — assembled across shards if it straddles them
+    (elastic restores onto a finer local mesh still verify) — and skipped
+    when part of it lives on a peer. Pooling which keys ANY process
+    verified happens in ``CheckpointManager._collective_is_valid``, NOT
+    here: this function must stay collective-free so a process that fails
+    anywhere in ``_load`` can skip it without desynchronizing its peers'
+    collectives.
     """
     import jax
 
     h, w = state.shape
+    ok = True
+    verified: set[str] = set()
     if jax.process_count() == 1:
         host = np.asarray(state)
         for key, want in checksums.items():
             r0, r1, c0, c1 = _parse_key(key)
             got = zlib.crc32(np.ascontiguousarray(host[r0:r1, c0:c1]).tobytes())
             if got != int(want):
-                return False
-        return True
-    # Multihost: check keys contained in an addressable shard.
+                ok = False
+            else:
+                verified.add(key)
+        return ok, verified
+    blocks = []
+    seen_bounds = set()  # replicated shardings repeat bounds; count each once
     for shard in state.addressable_shards:
         rows, cols = shard.index[0], shard.index[1]
         sr0, sr1, _ = rows.indices(h)
         sc0, sc1, _ = cols.indices(w)
-        block = None
-        for key, want in checksums.items():
-            r0, r1, c0, c1 = _parse_key(key)
-            if r0 >= sr0 and r1 <= sr1 and c0 >= sc0 and c1 <= sc1:
-                if block is None:
-                    block = np.asarray(shard.data)
-                window = block[r0 - sr0 : r1 - sr0, c0 - sc0 : c1 - sc0]
-                if zlib.crc32(np.ascontiguousarray(window).tobytes()) != int(want):
-                    return False
-    return True
+        if (sr0, sr1, sc0, sc1) not in seen_bounds:
+            seen_bounds.add((sr0, sr1, sc0, sc1))
+            blocks.append(((sr0, sr1, sc0, sc1), shard))
+    hosted: dict[int, np.ndarray] = {}  # lazy per-shard device->host copies
+    for key, want in checksums.items():
+        r0, r1, c0, c1 = _parse_key(key)
+        pieces, covered = [], 0
+        for i, ((sr0, sr1, sc0, sc1), _) in enumerate(blocks):
+            ir0, ir1 = max(r0, sr0), min(r1, sr1)
+            ic0, ic1 = max(c0, sc0), min(c1, sc1)
+            if ir0 < ir1 and ic0 < ic1:
+                pieces.append((i, (ir0, ir1, ic0, ic1), (sr0, sc0)))
+                covered += (ir1 - ir0) * (ic1 - ic0)
+        if covered != (r1 - r0) * (c1 - c0):
+            continue  # part of the block lives on a peer; the vote pools this
+        for i, _, _ in pieces:
+            if i not in hosted:
+                hosted[i] = np.asarray(blocks[i][1].data)
+        region = np.empty((r1 - r0, c1 - c0), hosted[pieces[0][0]].dtype)
+        for i, (ir0, ir1, ic0, ic1), (sr0, sc0) in pieces:
+            region[ir0 - r0 : ir1 - r0, ic0 - c0 : ic1 - c0] = \
+                hosted[i][ir0 - sr0 : ir1 - sr0, ic0 - sc0 : ic1 - sc0]
+        if zlib.crc32(np.ascontiguousarray(region).tobytes()) != int(want):
+            ok = False
+        else:
+            verified.add(key)
+    return ok, verified
 
 
 def _fsync_dir(path: str) -> None:
@@ -307,17 +375,24 @@ class CheckpointManager:
 
         multihost = jax.process_count() > 1
         manifest_path = self._manifest_path(generation)
-        already = (
-            os.path.exists(manifest_path) and self._load(generation) is not None
-        )
         if multihost:
             # The skip must be a COLLECTIVE decision: a lone process skipping
             # (or sweeping the shared manifest) while peers rewrite would
             # desynchronize the barrier sequence below and deadlock the
-            # cluster. Unanimous yes -> all skip; otherwise all rewrite.
-            from gol_tpu.parallel.collectives import host_all_agree
-
-            already = host_all_agree(already)
+            # cluster. The exists check only decides whether to ATTEMPT the
+            # local load (a first save's manifest is expected to be missing
+            # — _load would log a spurious "invalid, trying older" warning);
+            # every process reaches _collective_is_valid's one collective
+            # regardless of what its local view of the shared FS says.
+            # Unanimous yes -> all skip; otherwise all rewrite.
+            already = self._collective_is_valid(
+                self._load(generation)
+                if os.path.exists(manifest_path) else None)
+        else:
+            already = (
+                os.path.exists(manifest_path)
+                and self._load(generation) is not None
+            )
         if already:
             # A resumed run re-reached a boundary it had already committed;
             # the engine is bit-exact, so the existing checkpoint IS this
@@ -336,20 +411,43 @@ class CheckpointManager:
 
             multihost_utils.sync_global_devices(
                 f"gol_tpu.ckpt.clean:{self.directory}:{generation}")
-        if multihost or self.codec.self_retrying:
-            # No outer retry. Multihost: the zarr codec's write contains
-            # collective barriers, and ONE process re-entering them while
-            # peers have moved on joins the wrong barrier. Self-retrying
-            # codecs: stacking this policy on the codec's own would cube the
-            # time-to-failure of a persistent outage.
-            self.codec.write(payload_path, state)
-        else:
-            self.retry.call(lambda: self.codec.write(payload_path, state))
-        faults.on_payload_write(payload_path)
+        write_err: Exception | None = None
+        local_sums: dict[str, int] = {}
+        try:
+            if multihost or self.codec.self_retrying:
+                # No outer retry. Multihost: the zarr codec's write contains
+                # collective barriers, and ONE process re-entering them while
+                # peers have moved on joins the wrong barrier. Self-retrying
+                # codecs: stacking this policy on the codec's own would cube
+                # the time-to-failure of a persistent outage.
+                self.codec.write(payload_path, state)
+            else:
+                self.retry.call(lambda: self.codec.write(payload_path, state))
+            faults.on_payload_write(payload_path)
+            local_sums = _shard_checksums(state)
+        except Exception as e:
+            if not multihost:
+                raise
+            write_err = e
+        if multihost:
+            # A process whose shard write (or checksum pass) failed must not
+            # leave its peers parked in the allgather/commit barriers below
+            # until the distributed-runtime timeout: vote on success first,
+            # the failing process voting False before re-raising, so the
+            # whole cluster abandons this checkpoint together (previous one
+            # stays intact and discoverable).
+            from gol_tpu.parallel.collectives import host_all_agree
+
+            if not host_all_agree(write_err is None):
+                if write_err is not None:
+                    raise write_err
+                raise RuntimeError(
+                    "checkpoint abandoned: a peer process failed to write "
+                    f"its payload shards for generation {generation}")
         # Merged across processes AFTER the write (a fixed point in the
         # collective order): the lead-committed manifest must carry EVERY
         # process's block CRCs or peer shards would restore unverified.
-        checksums = _allgather_checksums(_shard_checksums(state))
+        checksums = _allgather_checksums(local_sums)
         manifest = {
             "format_version": FORMAT_VERSION,
             "generation": int(generation),
@@ -411,8 +509,21 @@ class CheckpointManager:
             (doomed if self._manifest_is_foreign(gen) else gens).append(gen)
         doomed.extend(gens[self.keep :])
         for gen in doomed:
-            _rmtree_or_file(self._manifest_path(gen))
-            _rmtree_or_file(os.path.join(self.directory, self._payload_name(gen)))
+            manifest_path = self._manifest_path(gen)
+            # A foreign manifest may name a payload from a DIFFERENT lane
+            # (other codec suffix); deleting by this run's naming would leak
+            # it as an invisible orphan once its manifest is gone. Trust the
+            # manifest's own record first, basename-d so a crafted payload
+            # field can never reach outside the checkpoint dir.
+            payload_name = self._payload_name(gen)
+            try:
+                with open(manifest_path) as f:
+                    payload_name = os.path.basename(
+                        json.load(f).get("payload", payload_name))
+            except (OSError, ValueError):
+                pass  # unreadable manifest: fall back to this lane's name
+            _rmtree_or_file(manifest_path)
+            _rmtree_or_file(os.path.join(self.directory, payload_name))
         newest = gens[0] if gens else None
         live = {self._payload_name(g) for g in gens[: self.keep]}
         for name in os.listdir(self.directory):
@@ -437,9 +548,18 @@ class CheckpointManager:
 
     # -- restore -------------------------------------------------------------
 
-    def _load(self, generation: int):
-        """(state, info) for one checkpoint, or None if anything about it —
-        manifest JSON, geometry, payload read, checksums — fails to verify."""
+    def _load(self, generation: int) -> _LoadedCheckpoint | None:
+        """One checkpoint's LOCAL view, or None if anything about it —
+        manifest JSON, geometry, payload read, (single-process) checksums —
+        fails to verify.
+
+        Collective-free by contract: processes fail here at different points
+        (or skip the call entirely), so any collective inside would pair
+        with a DIFFERENT collective on a peer and hang or corrupt the
+        exchange. The cluster-wide verdict is one unconditional collective
+        in ``_collective_is_valid``; on multihost a local CRC mismatch is
+        therefore carried in ``local_ok`` rather than raised.
+        """
         try:
             with open(self._manifest_path(generation)) as f:
                 manifest = json.load(f)
@@ -471,19 +591,67 @@ class CheckpointManager:
                 raise ValueError(
                     f"payload shape {tuple(state.shape)} != manifest "
                     f"{tuple(manifest['state_shape'])}")
-            if not _verify_checksums(state, manifest["checksums"]):
+            import jax
+
+            ok, verified = _verify_checksums(state, manifest["checksums"])
+            if jax.process_count() == 1 and not ok:
                 raise ValueError("shard checksum mismatch")
             info = CheckpointInfo(
                 generation=int(manifest["generation"]),
                 counter=int(manifest["counter"]),
                 path=self._manifest_path(generation),
             )
-            return state, info
+            return _LoadedCheckpoint(
+                state=state,
+                info=info,
+                local_ok=ok,
+                verified=frozenset(verified),
+                recorded=frozenset(manifest["checksums"]),
+            )
         except Exception as e:  # noqa: BLE001 - any defect means "not valid"
             logger.warning(
                 "checkpoint %s/%s%08d invalid, trying older: %s: %s",
                 self.directory, _PREFIX, generation, type(e).__name__, e)
             return None
+
+    def _collective_is_valid(self, loaded: _LoadedCheckpoint | None) -> bool:
+        """Cluster-wide verdict on one candidate checkpoint, via ONE
+        collective that every process reaches exactly once — including
+        processes whose ``_load`` returned None, which vote False here
+        instead of skipping the exchange (the skip would pair a peer's
+        allgather with whatever collective this process runs next).
+
+        The verdict requires every process to have loaded and locally
+        CRC-matched the checkpoint. Coverage is then pooled: a recorded
+        block NO process could tile from its shards (it straddles a process
+        boundary on this topology — e.g. a single-host checkpoint restored
+        on a multi-host mesh) is loudly logged rather than silently passing
+        as verified; it does NOT fail the restore, because refusing valid
+        on-disk state (and restarting from scratch while GC churns) is
+        strictly worse than restoring payload bytes every process read
+        successfully."""
+        import jax
+
+        if jax.process_count() == 1:
+            return loaded is not None  # _load already enforced checksums
+        ok = loaded is not None and loaded.local_ok
+        verified = sorted(loaded.verified) if loaded is not None else []
+        votes = _allgather_json([bool(ok), verified])
+        if not all(bool(v[0]) for v in votes):
+            return False
+        covered: set[str] = set()
+        for _, keys in votes:
+            covered.update(keys)
+        # All processes loaded OK, so every manifest copy (hence `recorded`)
+        # is identical and this log fires identically everywhere.
+        unverified = loaded.recorded - covered
+        if unverified:
+            logger.warning(
+                "restoring with %d/%d recorded block(s) CRC-UNVERIFIED: "
+                "they straddle process boundaries on this topology (written "
+                "on a different mesh); every process read its payload "
+                "shards successfully", len(unverified), len(loaded.recorded))
+        return True
 
     def _global_candidates(self) -> list[int]:
         """Union of every process's manifest generations, newest first: a
@@ -506,29 +674,29 @@ class CheckpointManager:
     def restore(self, max_generation: int | None = None):
         """Newest checkpoint every process can read, or None.
 
-        Walks candidates newest-first; each process validates locally and the
-        cluster votes (``host_all_agree``) — a manifest any process cannot
-        read and verify is skipped by ALL of them, so no two processes ever
-        resume from different generations. Returns ``(state, info)``.
+        Walks candidates newest-first; each process validates locally
+        (collective-free ``_load``) and the cluster votes with one
+        collective per candidate (``_collective_is_valid``) — a manifest any
+        process cannot read and fully verify is skipped by ALL of them, so
+        no two processes ever resume from different generations. Returns
+        ``(state, info)``.
 
         ``max_generation`` skips checkpoints past it (deterministically, so
         no vote is needed): a rerun with a REDUCED --gen-limit resumes from
         the newest checkpoint at or below the limit — any such checkpoint is
         an exact prefix of the shorter run — or starts fresh.
         """
-        from gol_tpu.parallel.collectives import host_all_agree
-
         for gen in self._global_candidates():
             if max_generation is not None and gen > max_generation:
                 continue
             loaded = self._load(gen)
-            if host_all_agree(loaded is not None):
-                state, info = loaded
+            if self._collective_is_valid(loaded):
                 logger.info("auto-resume: restored checkpoint at generation "
-                            "%d from %s", info.generation, info.path)
-                return state, info
+                            "%d from %s", loaded.info.generation,
+                            loaded.info.path)
+                return loaded.state, loaded.info
             if loaded is not None:
                 logger.warning(
-                    "checkpoint generation %d readable here but not on every "
-                    "process; falling back to an older one", gen)
+                    "checkpoint generation %d readable here but not verified "
+                    "on every process; falling back to an older one", gen)
         return None

@@ -249,3 +249,20 @@ def test_huge_byte_lane_warning(capsys):
     assert capsys.readouterr().err == ""
     assert _warn_if_huge_byte_lane(65536, 262144, mesh)
     assert "2.0 GB" in capsys.readouterr().err
+
+
+def test_dynamic_stderr_handler_honors_stream_contract():
+    # Review regression: the getter-only ``stream`` property broke the
+    # StreamHandler contract — ``setStream()`` (and direct assignment, which
+    # some test harnesses and logging utilities do) raised AttributeError.
+    # Assignment must be accepted; the handler stays dynamic regardless,
+    # always emitting to the CURRENT sys.stderr.
+    import io
+    import sys
+
+    from gol_tpu.platform_env import _DynamicStderrHandler
+
+    h = _DynamicStderrHandler()
+    assert h.setStream(io.StringIO()) is sys.stderr
+    h.stream = io.StringIO()
+    assert h.stream is sys.stderr  # still dynamic

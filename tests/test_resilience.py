@@ -9,6 +9,7 @@ end-to-end harness lives in tests/test_crash_recovery.py.
 
 import json
 import os
+import zlib
 
 import numpy as np
 import pytest
@@ -444,6 +445,156 @@ class TestCheckpointManager:
         assert run_fingerprint(rows) == whole
         assert run_fingerprint(cols) == whole
         assert run_fingerprint(quads) == whole
+
+    def test_run_fingerprint_limb_merge_is_exact_mod_2_64(self):
+        # Review regression: the old two-31-bit-halves exchange dropped bits
+        # 62-63 of every process's partial, so the merged fingerprint
+        # depended on the shard decomposition and a rerun on a different
+        # mesh GC'd its own checkpoints as foreign. The limb exchange must
+        # reconstruct sum(partials) mod 2**64 EXACTLY, high bits included.
+        from gol_tpu.resilience.checkpoint import (
+            _fingerprint_limbs,
+            _merge_fingerprint_limbs,
+        )
+
+        rng = np.random.default_rng(7)
+        for n_proc in (1, 2, 3, 8):
+            partials = [
+                int(rng.integers(0, 1 << 64, dtype=np.uint64)) | (0b11 << 62)
+                for _ in range(n_proc)
+            ]
+            everyone = np.stack([_fingerprint_limbs(p) for p in partials])
+            want = sum(partials) & ((1 << 64) - 1)
+            assert _merge_fingerprint_limbs(everyone) == want
+
+    def test_verify_checksums_multihost_is_local_and_reports_coverage(
+        self, monkeypatch
+    ):
+        # Review regression: on a topology where the writer's recorded
+        # blocks straddle every local shard, zero blocks were checked and
+        # verification passed vacuously. _verify_checksums now reports
+        # which keys it actually checked (collective-free) so the vote can
+        # refuse blocks nobody covers.
+        import jax
+
+        from gol_tpu.resilience import checkpoint as cp
+
+        g = _grid(3)
+
+        def sharded(cuts):
+            shards = [
+                type("S", (), {"data": g[rs, cs], "index": (rs, cs)})()
+                for rs, cs in cuts
+            ]
+            return type("A", (), {"shape": g.shape,
+                                  "addressable_shards": shards})()
+
+        state = sharded([(slice(0, 4), slice(0, 8)),
+                         (slice(4, 8), slice(0, 8))])
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        # Writer's whole-grid block straddles both local shards, but the two
+        # shards TILE its region: it must be assembled and verified (elastic
+        # restore onto a finer local mesh).
+        whole_key = cp._block_key(0, 8, 0, 8)
+        whole = {whole_key: zlib.crc32(np.ascontiguousarray(g).tobytes())}
+        assert cp._verify_checksums(state, whole) == (True, {whole_key})
+        # Writer blocks nested in local shards verify and report coverage.
+        k_top, k_bot = cp._block_key(0, 4, 0, 8), cp._block_key(4, 8, 0, 8)
+        nested = {
+            k_top: zlib.crc32(np.ascontiguousarray(g[0:4]).tobytes()),
+            k_bot: zlib.crc32(np.ascontiguousarray(g[4:8]).tobytes()),
+        }
+        assert cp._verify_checksums(state, nested) == (True, {k_top, k_bot})
+        bad = dict(nested)
+        bad[k_top] ^= 1
+        assert cp._verify_checksums(state, bad) == (False, {k_bot})
+        # A block partly owned by a peer process is skipped, not failed —
+        # visible as an uncovered key for the vote to pool.
+        half = sharded([(slice(0, 4), slice(0, 8))])
+        assert cp._verify_checksums(half, whole) == (True, set())
+        assert cp._verify_checksums(half, nested) == (True, {k_top})
+
+    def test_collective_is_valid_votes_once_per_process(
+        self, tmp_path, monkeypatch, caplog
+    ):
+        # Review regression: the cluster verdict must be ONE collective
+        # every process reaches — including one whose _load returned None —
+        # and recorded blocks no process verified must be loudly logged,
+        # never silently counted as verified (nor refused outright, which
+        # would break cross-mesh restore and restart valid runs from 0).
+        import logging
+
+        import jax
+
+        from gol_tpu.resilience import checkpoint as cp
+
+        mgr = _mgr(tmp_path)
+        info = cp.CheckpointInfo(generation=1, counter=0, path="m")
+
+        def loaded(local_ok, verified, recorded):
+            return cp._LoadedCheckpoint(
+                state=None, info=info, local_ok=local_ok,
+                verified=frozenset(verified), recorded=frozenset(recorded))
+
+        gathered = []
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(cp, "_allgather_json",
+                            lambda obj: gathered.append(obj) or [obj])
+        # Full coverage, all OK -> valid, no unverified warning.
+        with caplog.at_level(logging.WARNING, logger="gol_tpu"):
+            assert mgr._collective_is_valid(
+                loaded(True, {"a", "b"}, {"a", "b"}))
+        assert "UNVERIFIED" not in caplog.text
+        # A recorded block nobody verified -> restored anyway, loudly.
+        with caplog.at_level(logging.WARNING, logger="gol_tpu"):
+            assert mgr._collective_is_valid(loaded(True, {"a"}, {"a", "b"}))
+        assert "1/2 recorded block(s) CRC-UNVERIFIED" in caplog.text
+        # A local CRC mismatch -> refused (but the collective still ran).
+        assert not mgr._collective_is_valid(loaded(False, {"b"}, {"a", "b"}))
+        # A failed _load STILL votes (None must not skip the collective —
+        # peers' allgathers would pair with whatever runs next and hang).
+        assert not mgr._collective_is_valid(None)
+        assert len(gathered) == 4
+        assert gathered[-1] == [False, []]
+
+    def test_multihost_write_failure_aborts_before_collectives(
+        self, tmp_path, monkeypatch
+    ):
+        # Review regression: one process's failed shard write must vote the
+        # whole cluster out of the checkpoint BEFORE the checksum allgather
+        # and commit barriers — not exit save() alone and leave its peers
+        # hung there until the distributed-runtime timeout.
+        import jax
+        from jax.experimental import multihost_utils
+
+        from gol_tpu.parallel import collectives
+        from gol_tpu.resilience import checkpoint as cp
+
+        mgr = _mgr(tmp_path)
+        g5 = _grid(5)
+        mgr.save(g5, 5, 0)  # prior durable checkpoint
+
+        votes = []
+        with monkeypatch.context() as m:
+            m.setattr(jax, "process_count", lambda: 2)
+            m.setattr(jax, "process_index", lambda: 0)
+            m.setattr(multihost_utils, "sync_global_devices",
+                      lambda name: None)
+            m.setattr(collectives, "host_all_agree",
+                      lambda flag: votes.append(flag) or flag)
+            m.setattr(cp, "_allgather_json", lambda obj: [obj])
+            m.setattr(cp, "_allgather_checksums",
+                      lambda sums: pytest.fail(
+                          "entered the checksum collective after a write "
+                          "failure — peers would hang"))
+            faults.install(FaultPlan(payload_write_fail=1))
+            with pytest.raises(InjectedWriteError):
+                mgr.save(_grid(9), 9, 0)
+        assert votes[-1] is False  # the failing process voted, then raised
+        # The abandoned checkpoint never shadowed the durable one.
+        state, info = mgr.restore()
+        assert info.generation == 5
+        np.testing.assert_array_equal(np.asarray(state), g5)
 
 
 def test_host_all_agree_single_process():

@@ -169,3 +169,40 @@ def test_read_words_recovers_displaced_store(tmp_path):
     back = ts_store.read_words(path, 64, 32)
     assert np.array_equal(np.asarray(back), np.asarray(w1))
     assert os.path.isdir(path) and not os.path.exists(path + ".replaced")
+
+
+def test_multihost_staged_write_failure_votes_before_commit_barrier(
+    tmp_path, monkeypatch
+):
+    """Review regression: one process's failed shard writes must vote the
+    cluster out of the staged overwrite BEFORE the commit barrier — not exit
+    write_words alone and leave peers parked there until the
+    distributed-runtime timeout."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from gol_tpu.parallel import collectives
+
+    path = str(tmp_path / "state.zarr")
+    w1, w2 = _words(46), _words(47)
+    ts_store.write_words(path, w1, 64)
+
+    barriers, votes = [], []
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices",
+                        lambda name: barriers.append(name))
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda x: np.asarray([x]))
+    monkeypatch.setattr(collectives, "host_all_agree",
+                        lambda flag: votes.append(flag) or flag)
+    _faults.install(FaultPlan(ts_write_fail=1))
+    with pytest.raises(OSError, match=r"shard indices \[0\]"):
+        ts_store.write_words(path, w2, 64)
+    # create vote passed, then the failing process voted False and raised
+    assert votes == [True, False]
+    assert not any("commit" in b for b in barriers)  # never reached it
+    _faults.clear()
+    # The live store was never touched by the abandoned overwrite.
+    back = ts_store.read_words(path, 64, 32)
+    assert np.array_equal(np.asarray(back), np.asarray(w1))
