@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench serve-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -20,6 +20,13 @@ test:
 
 bench:
 	python3 bench.py
+
+# Serving restart-safety smoke (tools/serve_smoke.py): boots `gol serve` on a
+# free port, submits 50 jobs across 2 bucket shapes, SIGKILLs it mid-batch,
+# restarts on the same journal, and verifies every accepted job ends DONE
+# exactly once with oracle-identical results.
+serve-smoke:
+	python3 tools/serve_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

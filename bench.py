@@ -169,6 +169,97 @@ def _bench_halo(args) -> int:
     return 0
 
 
+def _bench_batch(args) -> int:
+    """Boards/sec through the serve batcher at B in {1, 8, 64} (--suite batch).
+
+    The serving question: how much does stacking independent boards into one
+    compiled program buy over dispatching them one at a time? 64 random 256^2
+    boards run through gol_tpu/serve/batcher.run_batch — the exact path
+    ``gol batch`` and the server dispatch — as 64/B dispatches of B boards.
+    The headline value is the B=64 rate; vs_baseline is its speedup over the
+    B=1 sequential rate (same kernel, same boards, batch-size scaling only —
+    the amortized-dispatch win, not a kernel change).
+
+    The suite's workload is deliberately serving-shaped: SHORT requests
+    (gen_limit 4 unless --gen-limit is passed). Per-generation compute is
+    identical per board at any batch size — batching amortizes the
+    per-dispatch fixed cost (host staging, transfer, program dispatch), so
+    the win concentrates where requests are dispatch-dominated, exactly the
+    many-small-users regime the serve/ subsystem targets; at GEN_LIMIT=1000
+    a 256^2 job is compute-bound and the ratio approaches 1 (measurable by
+    passing --gen-limit 1000). The JSON records the gen_limit measured.
+    """
+    import jax
+
+    from gol_tpu.serve import batcher
+    from gol_tpu.serve.jobs import new_job
+
+    if args.gen_limit is None:
+        args.gen_limit = 4
+    size = 256
+    nboards = 64
+    batch_sizes = (1, 8, 64)
+    rng = np.random.default_rng(42)
+    boards = [
+        rng.integers(0, 2, size=(size, size), dtype=np.uint8)
+        for _ in range(nboards)
+    ]
+    jobs = [
+        new_job(size, size, b, gen_limit=args.gen_limit) for b in boards
+    ]
+    key = batcher.bucket_for(jobs[0])
+    print(
+        f"bench batch: {nboards} boards of {size}x{size}, "
+        f"gen_limit={args.gen_limit}, bucket={key.label()}, "
+        f"platform={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+
+    rates = {}
+    occupancy = {}
+    for b in batch_sizes:
+        # Warm: compile this batch shape outside the timer (the server pays
+        # it once per bucket, on the first dispatch).
+        batcher.run_batch(key, jobs[:b])
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            for i in range(0, nboards, b):
+                chunk = jobs[i : i + b]
+                results = batcher.run_batch(key, chunk)
+                assert len(results) == len(chunk)
+            best = min(best, time.perf_counter() - t0)
+        rates[b] = nboards / best
+        occupancy[b] = b / batcher.pad_batch(b)
+        print(
+            f"  B={b:3d}: {best * 1000:8.1f} ms for {nboards} boards "
+            f"-> {rates[b]:8.1f} boards/s",
+            file=sys.stderr,
+        )
+
+    headline = rates[batch_sizes[-1]]
+    sequential = rates[1]
+    print(
+        json.dumps(
+            {
+                "metric": "batch_boards_per_sec",
+                "value": headline,
+                "unit": "boards/s",
+                # Baseline here is the B=1 sequential rate of the same
+                # batcher: the amortization factor the subsystem exists for.
+                "vs_baseline": headline / sequential,
+                "detail": {f"b{b}": rates[b] for b in batch_sizes},
+                "occupancy": occupancy,
+                "grid": f"{size}x{size}",
+                "boards": nboards,
+                "gen_limit": args.gen_limit,
+                "bucket": key.label(),
+            }
+        )
+    )
+    return 0
+
+
 def _bench_compare(args) -> int:
     """Kernel-only throughput table: every single-chip evolve path.
 
@@ -358,7 +449,11 @@ def build_parser() -> argparse.ArgumentParser:
         "lane's 32768 HBM ceiling; --compare/--halo/--verify and explicit "
         "--kernel default to 16384 on the byte lane instead)",
     )
-    parser.add_argument("--gen-limit", type=int, default=1000)
+    parser.add_argument(
+        "--gen-limit", type=int, default=None,
+        help="generations per run (default: 1000, the reference GEN_LIMIT; "
+        "--suite batch defaults to 4 — short serving-shaped requests)",
+    )
     parser.add_argument(
         "--kernel", default=None, help="auto | lax | pallas | packed (default: best)"
     )
@@ -386,6 +481,14 @@ def build_parser() -> argparse.ArgumentParser:
         "4=16384^2 similarity path, 5=65536^2 4x4 mesh 10000 gens",
     )
     parser.add_argument(
+        "--suite",
+        choices=("batch",),
+        default=None,
+        help="named measurement suite: 'batch' measures boards/sec and "
+        "occupancy through the serve batcher at B in {1, 8, 64} on 256^2 "
+        "boards (the amortized-dispatch serving win)",
+    )
+    parser.add_argument(
         "--halo",
         action="store_true",
         help="measure halo-exchange p50 latency (BASELINE.md secondary metric) "
@@ -411,6 +514,12 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     _honor_platform_env()
+    if args.suite == "batch":
+        # The suite pins its own workload (64 boards of 256^2); the
+        # size/config resolution below is for the solo-engine lanes.
+        return _bench_batch(args)
+    if args.gen_limit is None:
+        args.gen_limit = 1000
     resolve_workload(args)
 
     if (args.compare or args.packed_state) and args.size % 32 != 0:

@@ -29,6 +29,7 @@ the loop timer (src/game_mpi_collective.c:278-328).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import re
 import sys
@@ -662,6 +663,261 @@ def _show(args) -> int:
     return 0
 
 
+def _serve(args) -> int:
+    """``gol serve``: the batched multi-tenant simulation service.
+
+    Boots the HTTP API (gol_tpu/serve/server.py) over the journaled
+    scheduler. SIGTERM/SIGINT drain gracefully: admission stops, queued
+    buckets flush, in-flight batches finish, then the process exits — no
+    accepted job is lost (the journal replays any that were cut off)."""
+    import signal
+
+    from gol_tpu.serve.server import GolServer
+
+    if args.flush_age < 0:
+        raise ValueError(f"--flush-age must be >= 0, got {args.flush_age}")
+    server = GolServer(
+        host=args.host,
+        port=args.port,
+        journal_dir=args.journal_dir,
+        max_queue_depth=args.max_queue_depth,
+        max_batch=args.max_batch,
+        flush_age=args.flush_age,
+        max_inflight=args.max_inflight,
+    )
+    stop = {"signaled": False}
+
+    def _on_signal(signum, frame):
+        # Second signal: exit hard (the journal still replays on restart).
+        if stop["signaled"]:
+            raise SystemExit(1)
+        stop["signaled"] = True
+        import threading
+
+        threading.Thread(
+            target=lambda: (server.shutdown(drain=True)), daemon=True
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(f"serving on {server.url}", flush=True)
+    if server.replayed:
+        print(f"replayed {server.replayed} unfinished job(s) from the journal",
+              flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    # A second signal raises SystemExit(1) in the main thread (the hard-exit
+    # path) — it must PROPAGATE so supervisors see a non-zero status for an
+    # aborted drain, not a clean 0.
+    return 0
+
+
+def _http_json(method: str, url: str, body: dict | None = None, timeout=30):
+    """Tiny stdlib JSON client shared by ``gol submit`` (urllib)."""
+    import urllib.error
+    import urllib.request
+
+    data = None
+    headers = {"Accept": "application/json"}
+    if body is not None:
+        data = json.dumps(body).encode("utf-8")
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read().decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            payload = {"error": str(e)}
+        return e.code, payload
+
+
+def _submit(args) -> int:
+    """``gol submit``: client for a running ``gol serve`` instance.
+
+    Submits each input file as one job, then (by default) polls until every
+    job is terminal and writes each result next to its input
+    (``<input>.out`` or into --output-dir), printing the per-board
+    ``Generations:`` accounting the solo CLI prints."""
+    import time as _time
+
+    from gol_tpu.variants import get_variant
+
+    variant = get_variant(args.variant)
+    width, height = atoi(args.width), atoi(args.height)
+    if width <= 0:
+        width = DEFAULT_WIDTH
+    if height <= 0:
+        height = DEFAULT_HEIGHT
+    base = args.server.rstrip("/")
+    ids = {}
+    for path in args.input_files:
+        grid = text_grid.read_grid(path, width, height)
+        body = {
+            "width": width,
+            "height": height,
+            "cells": text_grid.encode(grid).decode("ascii"),
+            "convention": variant.convention,
+            "gen_limit": args.gen_limit,
+            "priority": args.priority,
+        }
+        if args.deadline is not None:
+            body["deadline_s"] = args.deadline
+        status, payload = _http_json("POST", f"{base}/jobs", body)
+        if status != 202:
+            print(f"gol submit: {path}: HTTP {status}: "
+                  f"{payload.get('error', payload)}", file=sys.stderr)
+            return 1
+        ids[payload["id"]] = path
+        print(f"{path}\t{payload['id']}")
+    if not args.wait:
+        return 0
+
+    import urllib.error
+
+    outdir = args.output_dir
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+    pending = dict(ids)
+    rc = 0
+    last_contact = time.perf_counter()
+    while pending:
+        _time.sleep(args.poll_interval)
+        for job_id in list(pending):
+            try:
+                status, payload = _http_json("GET", f"{base}/jobs/{job_id}")
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                # Transient connection loss — notably the server-restart
+                # window the journal-replay story is built for (kill,
+                # restart, replay). Keep polling; only a sustained outage
+                # aborts the client.
+                if time.perf_counter() - last_contact > args.server_timeout:
+                    print(
+                        f"gol submit: no contact with {base} for "
+                        f"{args.server_timeout:.0f}s ({e}); giving up with "
+                        f"{len(pending)} job(s) unfetched",
+                        file=sys.stderr,
+                    )
+                    return 1
+                break  # retry the sweep after the poll interval
+            last_contact = time.perf_counter()
+            if status != 200:
+                print(f"gol submit: lost job {job_id}: HTTP {status}",
+                      file=sys.stderr)
+                del pending[job_id]
+                rc = 1
+                continue
+            state = payload["state"]
+            if state in ("queued", "scheduled", "running"):
+                continue
+            path = pending.pop(job_id)
+            if state != "done":
+                print(f"gol submit: {path}: job {state}: "
+                      f"{payload.get('error', '')}", file=sys.stderr)
+                rc = 1
+                continue
+            try:
+                status, result = _http_json("GET", f"{base}/result/{job_id}")
+            except (urllib.error.URLError, ConnectionError, OSError):
+                pending[job_id] = path  # refetch on the next sweep
+                break
+            if status != 200:
+                print(f"gol submit: {path}: result fetch HTTP {status}",
+                      file=sys.stderr)
+                rc = 1
+                continue
+            out_path = (
+                os.path.join(outdir, os.path.basename(path) + ".out")
+                if outdir
+                else path + ".out"
+            )
+            grid = text_grid.decode(
+                result["grid"].encode("ascii"), result["width"], result["height"]
+            )
+            text_grid.write_grid(out_path, grid)
+            print(f"{path}\tGenerations:\t{result['generations']}\t"
+                  f"{result['exit_reason']}\t-> {out_path}")
+    return rc
+
+
+def _batch(args) -> int:
+    """``gol batch``: the offline batched lane — N input files, one process.
+
+    The headline throughput path even without the HTTP layer: jobs are
+    bucketed exactly as the server would (gol_tpu/serve/batcher.py), each
+    bucket dispatches as few compiled programs as the batch-size ladder
+    allows, and per-board results are bit-identical to solo ``gol`` runs."""
+    from gol_tpu.serve import batcher
+    from gol_tpu.serve.jobs import new_job
+    from gol_tpu.variants import get_variant
+
+    variant = get_variant(args.variant)
+    width, height = atoi(args.width), atoi(args.height)
+    if width <= 0:
+        width = DEFAULT_WIDTH
+    if height <= 0:
+        height = DEFAULT_HEIGHT
+    if not 1 <= args.max_batch <= batcher.MAX_BATCH:
+        raise ValueError(
+            f"--max-batch must be in [1, {batcher.MAX_BATCH}], "
+            f"got {args.max_batch}"
+        )
+    outdir = args.output_dir
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+
+    jobs = []
+    for path in args.input_files:
+        grid = text_grid.read_grid(path, width, height)
+        job = new_job(
+            width, height, grid,
+            convention=variant.convention,
+            gen_limit=args.gen_limit,
+        )
+        jobs.append((path, job))
+
+    buckets: dict = {}
+    for path, job in jobs:
+        buckets.setdefault(batcher.bucket_for(job), []).append((path, job))
+
+    t0 = time.perf_counter()
+    batches = 0
+    occupancy = []
+    outputs = []
+    for key, members in buckets.items():
+        for i in range(0, len(members), args.max_batch):
+            chunk = members[i : i + args.max_batch]
+            results = batcher.run_batch(key, [job for _, job in chunk])
+            batches += 1
+            occupancy.append(len(chunk) / batcher.pad_batch(len(chunk)))
+            for (path, _job), result in zip(chunk, results):
+                out_path = (
+                    os.path.join(outdir, os.path.basename(path) + ".out")
+                    if outdir
+                    else path + ".out"
+                )
+                text_grid.write_grid(out_path, result.grid)
+                outputs.append(
+                    (path, result.generations, result.exit_reason, out_path)
+                )
+    exec_s = time.perf_counter() - t0
+    for path, gens, reason, out_path in outputs:
+        print(f"{path}\tGenerations:\t{gens}\t{reason}\t-> {out_path}")
+    mean_occ = sum(occupancy) / len(occupancy) if occupancy else 0.0
+    print(
+        f"Batch:\t{len(jobs)} boards, {len(buckets)} bucket(s), "
+        f"{batches} dispatch(es), occupancy {mean_occ:.2f}, "
+        f"{len(jobs) / max(exec_s, 1e-9):.1f} boards/sec, "
+        f"{exec_s * 1000:.2f} msecs",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def _generate(args) -> int:
     if args.output:
         # Streamed: north-star-sized grids (65536^2 = 4 GB of text) generate
@@ -823,6 +1079,78 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--seed", type=int, default=None)
     gen.add_argument("--density", type=float, default=0.5)
     gen.set_defaults(func=_generate)
+
+    srv = sub.add_parser(
+        "serve",
+        help="run the batched multi-tenant simulation service (HTTP JSON API)",
+    )
+    srv.add_argument("--host", default="127.0.0.1")
+    srv.add_argument("--port", type=int, default=8000,
+                     help="listen port (0 = pick a free one; printed on boot)")
+    srv.add_argument(
+        "--journal-dir", default=None, metavar="D",
+        help="crash-safe job journal directory; a restarted server replays "
+        "unfinished jobs from it and keeps serving finished results "
+        "(default: no journal — jobs do not survive restarts)",
+    )
+    srv.add_argument("--max-queue-depth", type=int, default=1024,
+                     help="admission cap: past this, POST /jobs returns 429")
+    srv.add_argument("--max-batch", type=int, default=64,
+                     help="boards per dispatched batch (<= 64)")
+    srv.add_argument(
+        "--flush-age", type=float, default=0.05, metavar="S",
+        help="dispatch a partial bucket once its oldest job has waited S "
+        "seconds (the latency/occupancy trade)",
+    )
+    srv.add_argument("--max-inflight", type=int, default=1,
+                     help="concurrently running batches (worker threads)")
+    srv.set_defaults(func=_serve)
+
+    sbm = sub.add_parser(
+        "submit", help="submit jobs to a running gol serve and fetch results"
+    )
+    sbm.add_argument("width")
+    sbm.add_argument("height")
+    sbm.add_argument("input_files", nargs="+")
+    sbm.add_argument("--server", default="http://127.0.0.1:8000")
+    sbm.add_argument(
+        "--variant", default="tpu", choices=sorted(VARIANTS),
+        help="reference program whose loop accounting the jobs use",
+    )
+    sbm.add_argument("--gen-limit", type=int, default=GameConfig().gen_limit)
+    sbm.add_argument("--priority", type=int, default=0)
+    sbm.add_argument("--deadline", type=float, default=None, metavar="S",
+                     help="dispatch-ordering deadline, seconds from acceptance")
+    sbm.add_argument("--no-wait", dest="wait", action="store_false",
+                     help="submit and print job ids without polling")
+    sbm.add_argument("--poll-interval", type=float, default=0.2)
+    sbm.add_argument(
+        "--server-timeout", type=float, default=60.0, metavar="S",
+        help="give up after S seconds without server contact while polling "
+        "(transient connection errors — e.g. a server restart mid-replay — "
+        "are retried until then)",
+    )
+    sbm.add_argument("--output-dir", default=None,
+                     help="write results here (default: next to each input)")
+    sbm.set_defaults(func=_submit)
+
+    bat = sub.add_parser(
+        "batch",
+        help="offline batched lane: run N input files through the padding-"
+        "bucket batcher in one process",
+    )
+    bat.add_argument("width")
+    bat.add_argument("height")
+    bat.add_argument("input_files", nargs="+")
+    bat.add_argument(
+        "--variant", default="tpu", choices=sorted(VARIANTS),
+        help="reference program whose loop accounting the jobs use",
+    )
+    bat.add_argument("--gen-limit", type=int, default=GameConfig().gen_limit)
+    bat.add_argument("--max-batch", type=int, default=64)
+    bat.add_argument("--output-dir", default=None,
+                     help="write results here (default: next to each input)")
+    bat.set_defaults(func=_batch)
     return parser
 
 
@@ -831,7 +1159,9 @@ def main(argv: list[str] | None = None) -> int:
     configure_cli_logging()
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
-    if not argv or argv[0] not in ("run", "generate", "show", "-h", "--help"):
+    if not argv or argv[0] not in (
+        "run", "generate", "show", "serve", "submit", "batch", "-h", "--help"
+    ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)
     try:

@@ -53,6 +53,23 @@ class EngineResult:
     generations: int  # the count the matching reference variant would print
 
 
+# Per-board exit classification of the batched engine (index = wire code).
+# Solo runs never needed one — the caller IS the run — but a serving batch
+# returns many fates per dispatch, so the reason travels with each board.
+EXIT_GEN_LIMIT, EXIT_EMPTY, EXIT_SIMILAR = 0, 1, 2
+EXIT_REASONS = ("gen_limit", "empty", "similar")
+
+
+@dataclasses.dataclass
+class BatchBoardResult:
+    """One board's slice of a finished batch — an ``EngineResult`` plus the
+    exit reason (bit-identical grid/count to a solo run of the same board)."""
+
+    grid: np.ndarray  # uint8 {0,1}, (height, width) — cropped, not padded
+    generations: int
+    exit_reason: str  # one of EXIT_REASONS
+
+
 def _generation(cur, kernel: Kernel, topology: Topology):
     """One generation plus its local termination flags.
 
@@ -896,3 +913,366 @@ def simulate(
     runner = make_runner(shape, config, mesh, kernel)
     final, gen = runner(device_grid)
     return EngineResult(np.asarray(jax.device_get(final), dtype=np.uint8), int(gen))
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-board engine (the gol_tpu/serve/ subsystem's compute entry).
+#
+# Every lane above runs ONE board per compiled call — the reference's
+# main()-per-run shape. A serving workload is many independent small boards,
+# where per-call dispatch and per-op thunk overhead dominate the arithmetic;
+# stacking B boards into one program amortizes both (the persistent-setup
+# argument of the stencil-communication papers, applied to dispatch). The
+# loop carries per-board scalar vectors (gen, counter, alive, similar) and a
+# per-board active mask, so boards that exit early freeze — grid, counters,
+# exit reason all land exactly where the solo loop would leave them — while
+# the batch keeps stepping until the last live board stops. Early-exit
+# freezing is cheap for the same reason the blocked solo loops are exact:
+# both early exits are fixed points of the evolve.
+#
+# Three compiled step flavors, chosen statically per bucket:
+#   "packed" — boards exactly fill the canvas and the width packs: vmapped
+#              bit-sliced word evolve (32 cells/word, the fast path);
+#   "byte"   — boards exactly fill the canvas: vmapped byte roll stencil;
+#   "masked" — boards smaller than the canvas: a gather-based torus step
+#              that wraps at each board's own (h, w) inside the shared
+#              padded canvas, so one program serves mixed shapes.
+# ---------------------------------------------------------------------------
+
+BATCH_MODES = ("packed", "byte", "masked")
+
+
+def _evolve_batch_masked(cur, heights, widths):
+    """One generation of B independent tori living in one (B, PH, PW) canvas.
+
+    Board b occupies ``cur[b, :h, :w]``; padding cells are zero and must stay
+    zero (the masked rule re-zeroes them every step). The wrap is realized by
+    per-board index gathers ``(i +/- 1) mod h`` — rows/cols at or past the
+    board edge gather garbage, but every consumed index is taken mod the true
+    extent, so interior counts are exactly the h x w torus counts.
+    """
+    ph, pw = cur.shape[1], cur.shape[2]
+    r = jnp.arange(ph)
+    c = jnp.arange(pw)
+
+    def one(g, h, w):
+        up = jnp.take(g, jnp.mod(r - 1, h), axis=0)
+        down = jnp.take(g, jnp.mod(r + 1, h), axis=0)
+        rows3 = up + g + down  # vertical triple sum, <= 3 fits uint8
+        west = jnp.take(rows3, jnp.mod(c - 1, w), axis=1)
+        east = jnp.take(rows3, jnp.mod(c + 1, w), axis=1)
+        n = west + rows3 + east - g  # 3x3 block sum minus center
+        new = (n == 3) | ((n == 2) & (g == 1))
+        mask = (r[:, None] < h) & (c[None, :] < w)
+        return (new & mask).astype(jnp.uint8)
+
+    return jax.vmap(one)(cur, heights, widths)
+
+
+def _batch_simulate_c(state0, limits, freq, check_sim, evolve, alive_of, equal):
+    """Batched C-convention loop: per-board replica of ``_simulate_c``'s
+    per-generation form, masked so stopped boards freeze (oracle._run_c is
+    the semantics contract; exactness vs solo runs is test-pinned)."""
+    b = limits.shape[0]
+    expand = (b,) + (1,) * (state0.ndim - 1)
+
+    def run_mask(state):
+        _, gen, _, alive, similar = state
+        return alive & jnp.logical_not(similar) & (gen <= limits)
+
+    def cond(state):
+        return jnp.any(run_mask(state))
+
+    def body(state):
+        cur, gen, counter, alive, similar = state
+        run = run_mask(state)
+        new = evolve(cur)
+        alive_n = alive_of(new)
+        if check_sim:
+            # The O(canvas) compare only runs on generations where some
+            # active board's counter fires (every freq-th; single-device, so
+            # a data-dependent cond is safe — no collectives to desync).
+            fire = (counter + 1) == freq
+            eq = jax.lax.cond(
+                jnp.any(run & fire),
+                lambda: equal(cur, new),
+                lambda: jnp.zeros_like(similar),
+            )
+            sim_n = fire & eq
+            counter_n = jnp.where(fire, 0, counter + 1)
+        else:
+            sim_n = jnp.zeros_like(similar)
+            counter_n = counter
+        gen_n = jnp.where(sim_n, gen, gen + 1)
+        # Full-canvas freeze masking only once some board has stopped; while
+        # every board is live (the common phase) the swap is free.
+        cur = jax.lax.cond(
+            jnp.all(run),
+            lambda: new,
+            lambda: jnp.where(run.reshape(expand), new, cur),
+        )
+        gen = jnp.where(run, gen_n, gen)
+        counter = jnp.where(run, counter_n, counter)
+        alive = jnp.where(run, alive_n, alive)
+        similar = jnp.where(run, sim_n, similar)
+        return (cur, gen, counter, alive, similar)
+
+    zeros = jnp.zeros((b,), jnp.int32)
+    state = (state0, zeros + 1, zeros, alive_of(state0), jnp.zeros((b,), bool))
+    final, gen, _counter, alive, similar = jax.lax.while_loop(cond, body, state)
+    reason = jnp.where(
+        similar,
+        EXIT_SIMILAR,
+        jnp.where(jnp.logical_not(alive), EXIT_EMPTY, EXIT_GEN_LIMIT),
+    ).astype(jnp.int32)
+    return final, gen - 1, reason  # reported count is gen-1 (src/game.c:202)
+
+
+def _batch_simulate_cuda(state0, limits, freq, check_sim, evolve, alive_of, equal):
+    """Batched CUDA-convention loop (per-board ``_simulate_cuda`` semantics:
+    0-based exclusive bound, emptiness tested on the NEW grid, break before
+    the swap so an empty exit keeps the last non-empty generation)."""
+    b = limits.shape[0]
+    expand = (b,) + (1,) * (state0.ndim - 1)
+
+    def run_mask(state):
+        _, gen, _, stop, _ = state
+        return jnp.logical_not(stop) & (gen < limits)
+
+    def cond(state):
+        return jnp.any(run_mask(state))
+
+    def body(state):
+        cur, gen, counter, stop, reason = state
+        run = run_mask(state)
+        new = evolve(cur)
+        if check_sim:
+            fire = (counter + 1) == freq
+            eq = jax.lax.cond(
+                jnp.any(run & fire),
+                lambda: equal(cur, new),
+                lambda: jnp.zeros((b,), bool),
+            )
+            sim_n = fire & eq
+            counter_n = jnp.where(fire, 0, counter + 1)
+        else:
+            sim_n = jnp.zeros((b,), bool)
+            counter_n = counter
+        empty_n = jnp.logical_not(alive_of(new))
+        stop_i = sim_n | empty_n
+        # break precedes the swap (src/game_cuda.cu:250,:266)
+        advance = run & jnp.logical_not(stop_i)
+        cur = jax.lax.cond(
+            jnp.all(advance),
+            lambda: new,
+            lambda: jnp.where(advance.reshape(expand), new, cur),
+        )
+        gen = jnp.where(advance, gen + 1, gen)
+        counter = jnp.where(run, counter_n, counter)
+        newly = run & stop_i
+        # Similarity is checked before emptiness (src/game_cuda.cu:238-259).
+        reason = jnp.where(
+            newly, jnp.where(sim_n, EXIT_SIMILAR, EXIT_EMPTY), reason
+        )
+        stop = stop | newly
+        return (cur, gen, counter, stop, reason)
+
+    zeros = jnp.zeros((b,), jnp.int32)
+    state = (
+        state0, zeros, zeros, jnp.zeros((b,), bool),
+        jnp.full((b,), EXIT_GEN_LIMIT, jnp.int32),
+    )
+    final, gen, _counter, _stop, reason = jax.lax.while_loop(cond, body, state)
+    return final, gen, reason  # reported count is the raw counter
+
+
+_BATCH_SIMULATORS = {
+    Convention.C: _batch_simulate_c,
+    Convention.CUDA: _batch_simulate_cuda,
+}
+
+
+def resolve_batch_mode(
+    heights, widths, padded_shape: tuple[int, int]
+) -> str:
+    """Pick the step flavor for a set of boards sharing one padded canvas."""
+    import sys
+
+    ph, pw = padded_shape
+    if any(h > ph or w > pw for h, w in zip(heights, widths)):
+        raise ValueError(
+            f"board exceeds the {ph}x{pw} padded canvas: "
+            f"{list(zip(heights, widths))}"
+        )
+    if all(h == ph and w == pw for h, w in zip(heights, widths)):
+        # The packed lane's host-side bit packing assumes a little-endian
+        # host (bit j of a word = column 32w+j via np.packbits + uint32
+        # view); big-endian hosts take the byte lane instead of silently
+        # scrambling columns.
+        return (
+            "packed" if pw % 32 == 0 and sys.byteorder == "little" else "byte"
+        )
+    return "masked"
+
+
+def _pack_board_words(stacked: np.ndarray) -> np.ndarray:
+    """(B, H, W) uint8 cells -> (B, H, W/32) uint32 words on the host.
+
+    Same bit convention as ops/packed_math.encode (bit j of word w = column
+    32w+j): np.packbits little bit-order fills byte k with columns
+    8k..8k+7, and the little-endian uint32 view makes byte k bits 8k..8k+7
+    of its word. Packing on the host shrinks the device transfer 32x and
+    keeps encode/decode out of the compiled program entirely.
+    """
+    b, h, w = stacked.shape
+    packed = np.packbits(stacked, axis=-1, bitorder="little")
+    return np.ascontiguousarray(packed).view(np.uint32).reshape(b, h, w // 32)
+
+
+def _unpack_board_words(words: np.ndarray) -> np.ndarray:
+    """Inverse of ``_pack_board_words``: words -> (B, H, W) uint8 cells."""
+    b, h, nw = words.shape
+    as_bytes = np.ascontiguousarray(words).view(np.uint8).reshape(b, h, nw * 4)
+    return np.unpackbits(as_bytes, axis=-1, bitorder="little")
+
+
+@functools.lru_cache(maxsize=256)
+def make_batch_runner(
+    padded_shape: tuple[int, int],
+    batch: int,
+    convention: str = Convention.C,
+    check_similarity: bool = True,
+    similarity_frequency: int = DEFAULT_CONFIG.similarity_frequency,
+    mode: str = "masked",
+):
+    """Compile a B-board runner: ``(boards, heights, widths, limits) ->
+    (finals, generations, exit_reasons)``.
+
+    ``boards`` is (B, PH, PW) uint8 with dead padding — except in "packed"
+    mode, where the operand (and the returned state) is the host-packed
+    (B, PH, PW/32) uint32 word array (``_pack_board_words``), so the
+    transfer is 32x smaller and no encode/decode rides in the program.
+    ``heights``/``widths`` give each board's true extent ((B,) int32 —
+    consumed only by the masked mode, but always part of the signature so
+    every mode shares one calling convention); ``limits`` is each board's
+    OWN generation bound, a dynamic operand — jobs with different
+    --gen-limit share the compiled program (unlike the solo runners, where
+    the limit is baked into the trace).
+
+    Single-device by design: serving batches many small boards per chip;
+    sharding one small board over a mesh is the opposite trade.
+    """
+    ph, pw = padded_shape
+    if batch < 1:
+        raise ValueError(f"batch must be >= 1, got {batch}")
+    if mode not in BATCH_MODES:
+        raise ValueError(f"unknown batch mode {mode!r}; one of {BATCH_MODES}")
+    if mode == "packed" and pw % 32 != 0:
+        raise ValueError(f"packed batch mode needs width % 32 == 0, got {pw}")
+    if convention not in _BATCH_SIMULATORS:
+        raise ValueError(f"unknown convention: {convention!r}")
+    simulate_fn = _BATCH_SIMULATORS[convention]
+    freq = jnp.int32(similarity_frequency)
+
+    from gol_tpu.ops import packed_math, stencil_lax
+
+    def fn(boards, heights, widths, limits):
+        if mode == "packed":
+            evolve = jax.vmap(packed_math.evolve_torus_words)
+        elif mode == "byte":
+            evolve = jax.vmap(stencil_lax.evolve_torus)
+        else:
+            evolve = lambda cur: _evolve_batch_masked(cur, heights, widths)
+        alive_of = lambda s: jnp.any(s != 0, axis=tuple(range(1, s.ndim)))
+        equal = lambda a, b: jnp.all(a == b, axis=tuple(range(1, a.ndim)))
+        return simulate_fn(
+            boards, limits, freq, check_similarity, evolve, alive_of, equal
+        )
+
+    return jax.jit(fn)
+
+
+def simulate_batch(
+    boards,
+    configs,
+    padded_shape: tuple[int, int] | None = None,
+    pad_batch_to: int | None = None,
+) -> list[BatchBoardResult]:
+    """Run many independent boards in ONE compiled program.
+
+    ``boards`` is a sequence of (h, w) uint8 arrays; ``configs`` one
+    ``GameConfig`` shared by all boards or a sequence of per-board configs.
+    All configs must agree on convention/similarity settings (those are baked
+    into the compiled program); ``gen_limit`` may differ per board (it is a
+    dynamic operand). Boards are zero-padded into a shared ``padded_shape``
+    canvas (default: the max extent over the batch) and, when
+    ``pad_batch_to`` exceeds the board count, inert zero boards fill the
+    remaining batch slots so a handful of request sizes reuse one compiled
+    program.
+
+    Each returned (grid, generations, exit_reason) is bit-identical to a solo
+    ``simulate`` run of the same board (test-pinned for both conventions,
+    including boards that exit early inside a still-running batch).
+    """
+    boards = [np.ascontiguousarray(np.asarray(b, dtype=np.uint8)) for b in boards]
+    if not boards:
+        return []
+    if isinstance(configs, GameConfig):
+        configs = [configs] * len(boards)
+    configs = list(configs)
+    if len(configs) != len(boards):
+        raise ValueError(
+            f"{len(boards)} boards but {len(configs)} configs"
+        )
+    head = configs[0]
+    for c in configs[1:]:
+        if (
+            c.convention != head.convention
+            or c.check_similarity != head.check_similarity
+            or c.similarity_frequency != head.similarity_frequency
+        ):
+            raise ValueError(
+                "boards in one batch must share convention and similarity "
+                "settings (only gen_limit may vary); split into buckets"
+            )
+    heights = [b.shape[0] for b in boards]
+    widths = [b.shape[1] for b in boards]
+    if padded_shape is None:
+        padded_shape = (max(heights), max(widths))
+    mode = resolve_batch_mode(heights, widths, padded_shape)
+    b = len(boards)
+    total = max(b, pad_batch_to or b)
+    ph, pw = padded_shape
+    stacked = np.zeros((total, ph, pw), np.uint8)
+    for i, board in enumerate(boards):
+        stacked[i, : heights[i], : widths[i]] = board
+    h_arr = np.ones((total,), np.int32)
+    w_arr = np.ones((total,), np.int32)
+    h_arr[:b] = heights
+    w_arr[:b] = widths
+    # Padding slots: zero boards with limit 0 never run in either convention.
+    limits = np.zeros((total,), np.int32)
+    limits[:b] = [c.gen_limit for c in configs]
+    runner = make_batch_runner(
+        padded_shape, total, head.convention,
+        head.check_similarity, head.similarity_frequency, mode,
+    )
+    operand = _pack_board_words(stacked) if mode == "packed" else stacked
+    finals, gens, reasons = runner(
+        jnp.asarray(operand), jnp.asarray(h_arr), jnp.asarray(w_arr),
+        jnp.asarray(limits),
+    )
+    finals = np.asarray(jax.device_get(finals))
+    if mode == "packed":
+        finals = _unpack_board_words(finals)
+    finals = np.asarray(finals, dtype=np.uint8)
+    gens = np.asarray(jax.device_get(gens))
+    reasons = np.asarray(jax.device_get(reasons))
+    return [
+        BatchBoardResult(
+            grid=finals[i, : heights[i], : widths[i]].copy(),
+            generations=int(gens[i]),
+            exit_reason=EXIT_REASONS[int(reasons[i])],
+        )
+        for i in range(b)
+    ]

@@ -1,0 +1,25 @@
+"""Batched multi-tenant simulation service: runs as *requests*, not processes.
+
+Every other entry point in the tree keeps the reference's main()-per-run
+shape — one board, one process, exit. This package is the first subsystem
+that amortizes compilation and dispatch across many independent requests
+(SURVEY layers L3-L6):
+
+- ``jobs``      — the ``Job`` record, its QUEUED -> ... -> DONE state
+                  machine, and a crash-safe append-only journal so a
+                  restarted server replays unfinished work (composing with
+                  the ``gol_tpu/resilience`` auto-resume story);
+- ``batcher``   — groups compatible jobs into padding buckets and drives
+                  the batched engine entry (``engine.simulate_batch``'s
+                  runner): one compiled program per bucket, cached for the
+                  life of the server;
+- ``scheduler`` — admission control, priority/deadline-aware dispatch,
+                  flush-on-size-or-age batch forming, graceful drain, and
+                  RetryPolicy-wrapped dispatch for transient device errors;
+- ``server``    — a stdlib-only HTTP JSON API over the scheduler;
+- ``metrics``   — the counters/gauges/latency histograms behind
+                  ``GET /metrics`` (JSON and Prometheus text).
+
+Import layering: ``jobs`` and ``metrics`` are numpy/stdlib-only; the
+jax-heavy engine is pulled in by ``batcher`` at dispatch time.
+"""

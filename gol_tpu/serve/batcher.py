@@ -1,0 +1,142 @@
+"""Padding-bucket batcher: which requests share a compiled program.
+
+The placement question the process-to-node-mapping literature asks across a
+cluster is asked here intra-chip: two jobs may ride one compiled program iff
+they agree on everything the trace bakes in. That agreement is the
+``BucketKey`` — (padded height, padded width, convention, kernel flavor,
+similarity settings). Everything else (each board's true extent and its
+generation limit) is a dynamic operand of the batched runner, so one program
+per bucket serves every job the bucket ever sees, for the life of the server
+(``engine.make_batch_runner`` is lru-cached; the first dispatch of a bucket
+pays the compile, every later one only dispatch).
+
+Padding policy: board extents round up to ``PAD_QUANTUM`` so near-miss shapes
+(30x30, 31x32, ...) pool in one bucket instead of fragmenting the program
+cache; boards that exactly fill their canvas take the fast uniform kernels
+(bit-packed words when the width packs), padded boards the masked gather
+kernel. Batch sizes round up the ``BATCH_SIZES`` ladder, with inert zero
+boards in the padding slots, so a bucket compiles at most
+``len(BATCH_SIZES)`` programs ever, not one per request count.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import logging
+
+import numpy as np
+
+from gol_tpu import engine
+from gol_tpu.serve.jobs import Job, JobResult
+
+logger = logging.getLogger(__name__)
+
+# Board extents round up to multiples of this (also the packed-word width, so
+# every exact-fit bucket width packs).
+PAD_QUANTUM = 32
+
+# The batch-size ladder: request counts round up to the next rung so the
+# compiled-program space stays small. The last rung is the hard batch cap.
+BATCH_SIZES = (1, 2, 4, 8, 16, 32, 64)
+MAX_BATCH = BATCH_SIZES[-1]
+
+
+def pad_dim(n: int) -> int:
+    """Round a board extent up to the bucket quantum."""
+    return max(PAD_QUANTUM, -(-n // PAD_QUANTUM) * PAD_QUANTUM)
+
+
+def pad_batch(n: int) -> int:
+    """Round a job count (1..MAX_BATCH) up the BATCH_SIZES ladder.
+
+    Always returns a rung >= n — the padded size the compiled program
+    actually runs, which is also the denominator of the occupancy metric
+    (occupancy must never exceed 1).
+    """
+    if not 1 <= n <= MAX_BATCH:
+        raise ValueError(f"batch count must be in [1, {MAX_BATCH}], got {n}")
+    return BATCH_SIZES[bisect.bisect_left(BATCH_SIZES, n)]
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketKey:
+    """Everything two jobs must agree on to share a compiled program."""
+
+    height: int  # padded canvas height
+    width: int  # padded canvas width
+    convention: str
+    kernel: str  # engine batch mode: packed | byte | masked
+    check_similarity: bool = True
+    similarity_frequency: int = 3
+
+    def label(self) -> str:
+        return (
+            f"{self.height}x{self.width}/{self.convention}/{self.kernel}"
+            + ("" if self.check_similarity else "/nosim")
+        )
+
+
+def bucket_for(job: Job) -> BucketKey:
+    """Assign a job its padding bucket.
+
+    Exact-fit boards (extents already on the quantum) get the uniform fast
+    kernels; anything else is padded into the masked bucket of its rounded
+    shape. The quantum is 32, so every uniform bucket width packs — "byte"
+    only arises for hypothetical non-multiple-of-32 quanta, but the routing
+    stays honest via ``engine.resolve_batch_mode`` rather than assuming.
+    """
+    ph, pw = pad_dim(job.height), pad_dim(job.width)
+    mode = engine.resolve_batch_mode([job.height], [job.width], (ph, pw))
+    return BucketKey(
+        height=ph,
+        width=pw,
+        convention=job.convention,
+        kernel=mode,
+        check_similarity=job.check_similarity,
+        similarity_frequency=job.similarity_frequency,
+    )
+
+
+def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
+    """Dispatch one bucket's batch through the batched engine.
+
+    Stacks the boards into the bucket canvas (batch dimension rounded up the
+    ladder with inert zero boards), runs the cached compiled program, and
+    crops each board's slice back out. Per-board results are bit-identical
+    to solo runs (the engine contract); ordering matches ``jobs``.
+    """
+    if not jobs:
+        return []
+    if len(jobs) > MAX_BATCH:
+        raise ValueError(f"batch of {len(jobs)} exceeds MAX_BATCH={MAX_BATCH}")
+    for job in jobs:
+        jk = bucket_for(job)
+        if jk != key:
+            raise ValueError(
+                f"job {job.id} belongs to bucket {jk.label()}, "
+                f"not {key.label()}"
+            )
+    total = pad_batch(len(jobs))
+    results = engine.simulate_batch(
+        [job.board for job in jobs],
+        [job.config for job in jobs],
+        padded_shape=(key.height, key.width),
+        pad_batch_to=total,
+    )
+    return [
+        JobResult(grid=r.grid, generations=r.generations, exit_reason=r.exit_reason)
+        for r in results
+    ]
+
+
+def warm(key: BucketKey, batch: int = MAX_BATCH) -> None:
+    """Pre-compile a bucket's program (optional server warmup path)."""
+    engine.make_batch_runner(
+        (key.height, key.width),
+        pad_batch(min(batch, MAX_BATCH)),
+        key.convention,
+        key.check_similarity,
+        key.similarity_frequency,
+        key.kernel,
+    )

@@ -1,0 +1,345 @@
+"""The ``Job`` record and the crash-safe append-only job journal.
+
+A job is one board's simulation request plus its lifecycle state machine:
+
+    QUEUED -> SCHEDULED -> RUNNING -> DONE | FAILED
+    QUEUED -> CANCELLED
+
+The journal is the serving counterpart of ``gol_tpu/resilience/checkpoint``'s
+durability discipline, adapted to a queue: instead of write-fresh-then-commit
+(state that is *replaced*), a queue's history only ever *grows*, so the
+crash-safe shape is an append-only JSONL log where every record is a single
+``os.write`` to an ``O_APPEND`` descriptor followed by ``fsync``. A crash can
+tear at most the final line; replay tolerates (and drops) a torn tail, so the
+journal a restarted server reads is always a prefix of accepted truth —
+exactly the property the checkpoint manifest's atomic ``os.replace`` buys for
+snapshots.
+
+Replay returns (a) every accepted job with no terminal record — the work a
+restarted server must finish — and (b) the results of completed jobs, so
+``GET /result/<id>`` keeps answering across restarts. A job is DONE exactly
+once: the scheduler only dispatches jobs replay handed back as pending, and
+replay drops a pending job the moment a ``done`` record for its id appears.
+
+Timestamps: queue/run latencies use ``time.perf_counter()`` (monotonic; the
+wall clock is banned from this package's latency paths by tests/test_lint.py
+— wall clocks step under NTP and make p99s lie).
+Perf-counter values are process-local, so they are never journaled; replayed
+jobs get fresh arrival stamps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.io import text_grid
+
+logger = logging.getLogger(__name__)
+
+# Lifecycle states (the serving state machine).
+QUEUED = "queued"
+SCHEDULED = "scheduled"  # claimed by a forming batch, not yet on device
+RUNNING = "running"  # batch dispatched to the compiled program
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED})
+
+# Legal transitions; anything else is a server bug and raises loudly.
+# Batch retries happen while jobs are held in RUNNING (the RetryPolicy wraps
+# the dispatch; nothing ever re-queues a claimed job), so RUNNING's only
+# exits are terminal.
+_TRANSITIONS = {
+    QUEUED: {SCHEDULED, CANCELLED, FAILED},
+    SCHEDULED: {RUNNING, FAILED},
+    RUNNING: {DONE, FAILED},
+    DONE: set(),
+    FAILED: set(),
+    CANCELLED: set(),
+}
+
+
+@dataclasses.dataclass
+class JobResult:
+    """What a finished job hands back (mirrors engine.BatchBoardResult)."""
+
+    grid: np.ndarray  # uint8 {0,1}, (height, width)
+    generations: int
+    exit_reason: str  # engine.EXIT_REASONS member
+
+
+@dataclasses.dataclass
+class Job:
+    """One simulation request moving through the service."""
+
+    id: str
+    width: int
+    height: int
+    board: np.ndarray  # uint8 {0,1}, (height, width)
+    convention: str = Convention.C
+    gen_limit: int = GameConfig().gen_limit
+    check_similarity: bool = True
+    similarity_frequency: int = GameConfig().similarity_frequency
+    priority: int = 0  # higher dispatches first within a bucket
+    deadline_s: float | None = None  # seconds from acceptance; orders dispatch
+    state: str = QUEUED
+    # perf_counter stamps, process-local (never journaled).
+    accepted_at: float = 0.0
+    started_at: float | None = None
+    finished_at: float | None = None
+    result: JobResult | None = None
+    error: str | None = None
+
+    def __post_init__(self):
+        # Normalize numeric fields FIRST: jobs arrive from untrusted JSON,
+        # and a job admitted with e.g. priority=None would not fail until a
+        # worker computes its dispatch key — killing the worker thread, not
+        # the request. int()/float() raise TypeError/ValueError here, inside
+        # the admission path, where the server maps them to HTTP 400.
+        self.width, self.height = int(self.width), int(self.height)
+        self.gen_limit = int(self.gen_limit)
+        self.similarity_frequency = int(self.similarity_frequency)
+        # Strict bool: bool("false") is True, so coercion would silently
+        # ENABLE the check a string-typed client asked to disable.
+        if not isinstance(self.check_similarity, bool):
+            raise TypeError(
+                f"check_similarity must be a JSON boolean, got "
+                f"{type(self.check_similarity).__name__}"
+            )
+        self.priority = int(self.priority)
+        if self.deadline_s is not None:
+            self.deadline_s = float(self.deadline_s)
+        if self.width <= 0 or self.height <= 0:
+            raise ValueError(
+                f"job dimensions must be positive, got {self.height}x{self.width}"
+            )
+        if self.gen_limit < 0:
+            raise ValueError(f"gen_limit must be >= 0, got {self.gen_limit}")
+        if self.similarity_frequency <= 0:
+            raise ValueError(
+                f"similarity_frequency must be > 0, got {self.similarity_frequency}"
+            )
+        if self.convention not in (Convention.C, Convention.CUDA):
+            raise ValueError(f"unknown convention: {self.convention!r}")
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {self.deadline_s}")
+        self.board = np.ascontiguousarray(np.asarray(self.board, dtype=np.uint8))
+        if self.board.shape != (self.height, self.width):
+            raise ValueError(
+                f"board shape {self.board.shape} does not match declared "
+                f"{self.height}x{self.width}"
+            )
+
+    @property
+    def config(self) -> GameConfig:
+        return GameConfig(
+            gen_limit=self.gen_limit,
+            check_similarity=self.check_similarity,
+            similarity_frequency=self.similarity_frequency,
+            convention=self.convention,
+        )
+
+    def transition(self, new_state: str) -> None:
+        if new_state not in _TRANSITIONS[self.state]:
+            raise ValueError(
+                f"job {self.id}: illegal transition {self.state} -> {new_state}"
+            )
+        self.state = new_state
+
+    def dispatch_key(self):
+        """Sort key for dispatch order inside a bucket: higher priority
+        first, then nearest deadline, then arrival order."""
+        deadline = (
+            self.accepted_at + self.deadline_s
+            if self.deadline_s is not None
+            else float("inf")
+        )
+        return (-self.priority, deadline, self.accepted_at, self.id)
+
+    def to_record(self) -> dict:
+        """The journaled (durable) fields — everything needed to re-run."""
+        return {
+            "id": self.id,
+            "width": self.width,
+            "height": self.height,
+            "convention": self.convention,
+            "gen_limit": self.gen_limit,
+            "check_similarity": self.check_similarity,
+            "similarity_frequency": self.similarity_frequency,
+            "priority": self.priority,
+            "deadline_s": self.deadline_s,
+            "cells": text_grid.encode(self.board).decode("ascii"),
+        }
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "Job":
+        board = text_grid.decode(
+            rec["cells"].encode("ascii"), rec["width"], rec["height"]
+        )
+        return cls(
+            id=rec["id"],
+            width=rec["width"],
+            height=rec["height"],
+            board=board,
+            convention=rec.get("convention", Convention.C),
+            gen_limit=rec.get("gen_limit", GameConfig().gen_limit),
+            check_similarity=rec.get("check_similarity", True),
+            similarity_frequency=rec.get(
+                "similarity_frequency", GameConfig().similarity_frequency
+            ),
+            priority=rec.get("priority", 0),
+            deadline_s=rec.get("deadline_s"),
+            accepted_at=time.perf_counter(),
+        )
+
+
+def new_job(width: int, height: int, board, **kwargs) -> Job:
+    return Job(
+        id=uuid.uuid4().hex,
+        width=width,
+        height=height,
+        board=board,
+        accepted_at=time.perf_counter(),
+        **kwargs,
+    )
+
+
+@dataclasses.dataclass
+class ReplayState:
+    """What a journal replay recovers."""
+
+    pending: list  # Jobs accepted but not terminal — re-run these
+    results: dict  # id -> JobResult for DONE jobs — keep serving these
+    failed: dict  # id -> error string
+    cancelled: set  # ids
+    torn_lines: int  # dropped unparseable tail/garbage lines
+
+
+class JobJournal:
+    """Append-only JSONL journal; every append is one write + fsync."""
+
+    FILENAME = "journal.jsonl"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, self.FILENAME)
+        self._fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        # Appends come from both the accept path and worker threads. A
+        # process-level lock (not just O_APPEND) keeps records whole even
+        # when os.write returns short (large done records, ENOSPC mid-way):
+        # the write-all loop below may take several syscalls, and another
+        # thread's record landing between two chunks would weld both records
+        # into one unparseable line — losing TWO events, one of which could
+        # be a `done` (a replay would then re-run a completed job).
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def _append(self, record: dict) -> None:
+        data = (json.dumps(record, separators=(",", ":")) + "\n").encode("utf-8")
+        with self._lock:
+            view = memoryview(data)
+            while view:
+                view = view[os.write(self._fd, view):]
+            os.fsync(self._fd)
+
+    def record_submit(self, job: Job) -> None:
+        self._append({"event": "submit", "job": job.to_record()})
+
+    def record_done(self, job: Job) -> None:
+        r = job.result
+        self._append(
+            {
+                "event": "done",
+                "id": job.id,
+                "generations": r.generations,
+                "exit_reason": r.exit_reason,
+                # Self-contained: replay decodes the result without needing
+                # the submit record to have survived.
+                "width": int(r.grid.shape[1]),
+                "height": int(r.grid.shape[0]),
+                "grid": text_grid.encode(r.grid).decode("ascii"),
+            }
+        )
+
+    def record_failed(self, job: Job) -> None:
+        self._append({"event": "failed", "id": job.id, "error": job.error or ""})
+
+    def record_cancelled(self, job: Job) -> None:
+        self._append({"event": "cancelled", "id": job.id})
+
+    def replay(self) -> ReplayState:
+        """Rebuild queue state from the journal (crash-tolerant).
+
+        Unparseable lines are dropped, not fatal: the only way one arises is
+        a crash mid-append (a torn tail) — by the append discipline there can
+        be at most one, but replay is lenient to all of them and reports the
+        count so operators see unexpected corruption.
+        """
+        pending: dict[str, Job] = {}
+        results: dict[str, JobResult] = {}
+        failed: dict[str, str] = {}
+        cancelled: set[str] = set()
+        torn = 0
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                raw = f.read()
+            for line in raw.split(b"\n"):
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line.decode("utf-8"))
+                    event = rec["event"]
+                    if event == "submit":
+                        job = Job.from_record(rec["job"])
+                        pending[job.id] = job
+                    elif event == "done":
+                        grid = text_grid.decode(
+                            rec["grid"].encode("ascii"),
+                            rec["width"],
+                            rec["height"],
+                        )
+                        results[rec["id"]] = JobResult(
+                            grid=grid,
+                            generations=rec["generations"],
+                            exit_reason=rec["exit_reason"],
+                        )
+                        pending.pop(rec["id"], None)
+                    elif event == "failed":
+                        failed[rec["id"]] = rec.get("error", "")
+                        pending.pop(rec["id"], None)
+                    elif event == "cancelled":
+                        cancelled.add(rec["id"])
+                        pending.pop(rec["id"], None)
+                    else:
+                        raise ValueError(f"unknown event {event!r}")
+                except (ValueError, KeyError, UnicodeDecodeError):
+                    torn += 1
+        if torn:
+            logger.warning(
+                "job journal %s: dropped %d unparseable line(s) on replay "
+                "(a crash tears at most the final append; more suggests "
+                "external corruption)",
+                self.path, torn,
+            )
+        return ReplayState(
+            pending=list(pending.values()),
+            results=results,
+            failed=failed,
+            cancelled=cancelled,
+            torn_lines=torn,
+        )

@@ -1,0 +1,398 @@
+"""Admission control, batch forming, and dispatch.
+
+The queueing half of the serving story. Jobs arrive one at a time; the
+scheduler pools them per padding bucket and flushes a bucket to the device
+when it is *worth a dispatch*:
+
+- **size**: the bucket reached ``max_batch`` boards (a full program), or
+- **age**: its oldest job has waited ``flush_age`` seconds (bounded latency
+  for sparse traffic), or
+- **deadline**: some job's deadline is due, or
+- **drain**: the server is shutting down and flushes everything queued.
+
+Which ready bucket goes first — and which jobs within it when it holds more
+than a batch — follows ``Job.dispatch_key``: priority first, then nearest
+deadline, then arrival. Deadlines order dispatch; they do not abandon work
+(a job past its deadline runs at the front, not never — dropping accepted
+jobs would violate the journal's every-accepted-job-terminates contract).
+
+Admission control is a hard queue-depth cap: past it ``submit`` raises
+``QueueFull`` (the server maps it to HTTP 429) instead of letting the queue
+grow unboundedly while compile-warming buckets.
+
+Dispatch is wrapped in the tree's one ``RetryPolicy``: a transient device
+error retries the whole batch (GoL runs are pure functions of the input, so
+a re-run is idempotent); a persistent one fails the batch's jobs with the
+error recorded in journal and job state.
+
+Graceful drain: ``drain()`` stops admission, flushes every queued bucket,
+and returns when the last in-flight batch completes — the SIGTERM story for
+``gol serve``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from gol_tpu.resilience.retry import RetryPolicy, is_transient_io
+from gol_tpu.serve import batcher
+from gol_tpu.serve.batcher import BucketKey, bucket_for, pad_batch
+from gol_tpu.serve.jobs import (
+    CANCELLED, DONE, FAILED, QUEUED, RUNNING, SCHEDULED,
+    Job, JobJournal,
+)
+from gol_tpu.serve.metrics import Metrics
+
+logger = logging.getLogger(__name__)
+
+
+class QueueFull(Exception):
+    """Admission rejected: the queue is at max depth."""
+
+
+class Draining(Exception):
+    """Admission rejected: the server is draining."""
+
+
+# Dispatch retry: a transient device/runtime hiccup retries the batch twice
+# more with short backoff; anything else fails the jobs immediately.
+DEFAULT_DISPATCH_RETRY = RetryPolicy(attempts=3, base_delay=0.05,
+                                     multiplier=4.0, max_delay=1.0)
+
+
+class Scheduler:
+    """Owns the queue, the worker threads, and the job table."""
+
+    def __init__(
+        self,
+        journal: JobJournal | None = None,
+        metrics: Metrics | None = None,
+        max_queue_depth: int = 1024,
+        max_batch: int = batcher.MAX_BATCH,
+        flush_age: float = 0.05,
+        max_inflight: int = 1,
+        retry: RetryPolicy = DEFAULT_DISPATCH_RETRY,
+        retryable=is_transient_io,
+        run_batch=batcher.run_batch,
+        clock=time.perf_counter,
+    ):
+        if max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        if not 1 <= max_batch <= batcher.MAX_BATCH:
+            raise ValueError(
+                f"max_batch must be in [1, {batcher.MAX_BATCH}], got {max_batch}"
+            )
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.journal = journal
+        self.metrics = metrics or Metrics()
+        self.max_queue_depth = max_queue_depth
+        self.max_batch = max_batch
+        self.flush_age = flush_age
+        self.max_inflight = max_inflight
+        self.retry = retry
+        self.retryable = retryable
+        self._run_batch = run_batch
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._jobs: dict[str, Job] = {}
+        self._buckets: dict[BucketKey, list[Job]] = {}
+        self._queued = 0
+        self._inflight = 0
+        self._draining = False
+        self._stopped = False
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._cv:
+            if self._threads:
+                return
+            self._stopped = False
+            # One worker per allowed in-flight batch: the thread count IS
+            # the max-in-flight-batches admission knob.
+            for i in range(self.max_inflight):
+                t = threading.Thread(
+                    target=self._worker, name=f"gol-serve-worker-{i}", daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+    def stop(self, drain: bool = True, timeout: float | None = None) -> bool:
+        drained = self.drain(timeout=timeout) if drain else True
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join(timeout=5)
+        return drained
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Stop admission, flush everything queued, wait for quiescence.
+
+        Returns True when the queue and all in-flight batches emptied within
+        ``timeout`` (None = wait forever)."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+            while self._queued > 0 or self._inflight > 0:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        return False
+                self._cv.wait(timeout=remaining)
+            return True
+
+    @property
+    def draining(self) -> bool:
+        with self._cv:
+            return self._draining
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, job: Job, record: bool = True) -> Job:
+        """Accept a job into its bucket (raises QueueFull/Draining).
+
+        ``record=False`` resubmits a journal-replayed job: it is not
+        journaled again (its submit record already exists) and it bypasses
+        the draining/depth admission gates — a replayed job was ALREADY
+        accepted by a previous server, and bouncing it at restart would
+        turn a full-queue crash into an unrecoverable restart loop (replay
+        can legitimately exceed ``max_queue_depth`` by the jobs that were
+        in flight when the process died)."""
+        key = bucket_for(job)  # raises on un-runnable jobs before admission
+        with self._cv:
+            if record and self._draining:
+                self.metrics.inc("jobs_rejected_total")
+                raise Draining("server is draining; not accepting jobs")
+            if record and self._queued >= self.max_queue_depth:
+                self.metrics.inc("jobs_rejected_total")
+                raise QueueFull(
+                    f"queue at max depth {self.max_queue_depth}"
+                )
+            if job.id in self._jobs:
+                raise ValueError(f"duplicate job id {job.id}")
+            # Journal BEFORE the job becomes visible to workers (still under
+            # the lock): otherwise a fast worker could append this job's
+            # `done` record ahead of its `submit` record, and a replay would
+            # re-queue — i.e. double-run — an already-completed job. The
+            # fsync inside the critical section is the price of the
+            # exactly-once ledger ordering.
+            if record and self.journal is not None:
+                self.journal.record_submit(job)
+            job.accepted_at = self._clock()
+            self._jobs[job.id] = job
+            self._buckets.setdefault(key, []).append(job)
+            self._queued += 1
+            self.metrics.inc("jobs_accepted_total")
+            self.metrics.set_gauge("queue_depth", self._queued)
+            self._cv.notify_all()
+        return job
+
+    def resubmit_replayed(self, replayed: list[Job]) -> int:
+        """Queue journal-replayed jobs (already durable; not re-recorded)."""
+        n = 0
+        for job in replayed:
+            self.submit(job, record=False)
+            n += 1
+        if n:
+            logger.info("replayed %d unfinished job(s) from the journal", n)
+        return n
+
+    def job(self, job_id: str) -> Job | None:
+        with self._cv:
+            return self._jobs.get(job_id)
+
+    def cancel(self, job_id: str) -> bool:
+        """Cancel a job that has not been claimed by a batch yet."""
+        with self._cv:
+            job = self._jobs.get(job_id)
+            if job is None or job.state != QUEUED:
+                return False
+            key = bucket_for(job)
+            self._buckets[key].remove(job)
+            self._queued -= 1
+            job.transition(CANCELLED)
+            self.metrics.inc("jobs_cancelled_total")
+            self.metrics.set_gauge("queue_depth", self._queued)
+            self._cv.notify_all()
+        if self.journal is not None:
+            self.journal.record_cancelled(job)
+        return True
+
+    # -- batch forming -----------------------------------------------------
+
+    def _bucket_due_at(self, jobs: list[Job]) -> float:
+        """When this bucket becomes dispatch-ready on its own (age/deadline)."""
+        oldest = min(j.accepted_at for j in jobs)
+        due = oldest + self.flush_age
+        for j in jobs:
+            if j.deadline_s is not None:
+                due = min(due, j.accepted_at + j.deadline_s)
+        return due
+
+    def _claim_locked(self, now: float):
+        """Pick the most urgent ready bucket and take a batch from it."""
+        best = None
+        for key, pending in self._buckets.items():
+            if not pending:
+                continue
+            ready = (
+                self._draining
+                or len(pending) >= self.max_batch
+                or self._bucket_due_at(pending) <= now
+            )
+            if not ready:
+                continue
+            urgency = min(j.dispatch_key() for j in pending)
+            if best is None or urgency < best[0]:
+                best = (urgency, key)
+        if best is None:
+            return None
+        key = best[1]
+        pending = sorted(self._buckets[key], key=Job.dispatch_key)
+        take, rest = pending[: self.max_batch], pending[self.max_batch:]
+        self._buckets[key] = rest
+        self._queued -= len(take)
+        for job in take:
+            job.transition(SCHEDULED)
+        self._inflight += 1
+        self.metrics.set_gauge("queue_depth", self._queued)
+        self.metrics.set_gauge("inflight_batches", self._inflight)
+        return key, take
+
+    def _next_due(self) -> float | None:
+        due = None
+        for pending in self._buckets.values():
+            if pending:
+                d = self._bucket_due_at(pending)
+                due = d if due is None else min(due, d)
+        return due
+
+    # -- the worker --------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                claimed = None
+                while not self._stopped:
+                    claimed = self._claim_locked(self._clock())
+                    if claimed is not None:
+                        break
+                    due = self._next_due()
+                    wait = None if due is None else max(0.0, due - self._clock())
+                    self._cv.wait(timeout=wait)
+                if claimed is None:
+                    return  # stopped
+            key, batch = claimed
+            try:
+                self._execute(key, batch)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self.metrics.set_gauge("inflight_batches", self._inflight)
+                    self._cv.notify_all()
+
+    def _execute(self, key: BucketKey, batch: list[Job]) -> None:
+        started = self._clock()
+        for job in batch:
+            job.started_at = started
+            job.transition(RUNNING)
+            self.metrics.observe(
+                "queue_latency_seconds", started - job.accepted_at
+            )
+
+        def on_retry(attempt, err, delay):
+            self.metrics.inc("batch_retries_total")
+            logger.warning(
+                "batch %s (%d jobs) failed attempt %d, retrying in %.2fs "
+                "(%s: %s)",
+                key.label(), len(batch), attempt, delay,
+                type(err).__name__, err,
+            )
+
+        try:
+            results = self.retry.call(
+                lambda: self._run_batch(key, batch),
+                retryable=self.retryable,
+                on_retry=on_retry,
+            )
+        except Exception as err:  # noqa: BLE001 - every job must terminate
+            finished = self._clock()
+            logger.error(
+                "batch %s (%d jobs) failed: %s: %s",
+                key.label(), len(batch), type(err).__name__, err,
+            )
+            for job in batch:
+                job.finished_at = finished
+                job.error = f"{type(err).__name__}: {err}"
+                job.transition(FAILED)
+                self.metrics.inc("jobs_failed_total")
+                self._journal_terminal(JobJournal.record_failed, job)
+            return
+        finished = self._clock()
+        elapsed = max(finished - started, 1e-9)
+        # The same rung run_batch padded to: occupancy is boards over the
+        # slots the compiled program actually ran.
+        slots = pad_batch(len(batch))
+        self.metrics.inc("batches_total")
+        self.metrics.inc("boards_total", len(batch))
+        self.metrics.observe("batch_occupancy", len(batch) / slots)
+        self.metrics.observe("run_latency_seconds", elapsed)
+        self.metrics.set_gauge("boards_per_sec", len(batch) / elapsed)
+        for job, result in zip(batch, results):
+            job.finished_at = finished
+            job.result = result
+            job.transition(DONE)
+            self.metrics.inc("jobs_completed_total")
+            self._journal_terminal(JobJournal.record_done, job)
+
+    def _journal_terminal(self, record_fn, job: Job) -> None:
+        """Append a terminal record, surviving journal I/O failure.
+
+        A failing fsync/write (ENOSPC, EIO) here must never escape: it would
+        kill the worker thread, strand the rest of the batch in RUNNING, and
+        stop all dispatch. The in-memory state stays authoritative for this
+        process; the cost of a dropped terminal record is a re-run after a
+        restart (idempotent), logged loudly and counted so operators see the
+        journal degrading before that."""
+        if self.journal is None:
+            return
+        try:
+            record_fn(self.journal, job)
+        except OSError as err:
+            self.metrics.inc("journal_errors_total")
+            logger.error(
+                "journal append failed for job %s (%s) — state is held "
+                "in-memory only; a restart will re-run it: %s: %s",
+                job.id, job.state, type(err).__name__, err,
+            )
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "queued": self._queued,
+                "inflight_batches": self._inflight,
+                "buckets": {
+                    k.label(): len(v) for k, v in self._buckets.items() if v
+                },
+                "draining": self._draining,
+                "jobs": len(self._jobs),
+            }
+
+
+# Re-exported for callers that only import the scheduler module.
+__all__ = [
+    "DEFAULT_DISPATCH_RETRY",
+    "Draining",
+    "QueueFull",
+    "Scheduler",
+]

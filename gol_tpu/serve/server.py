@@ -1,0 +1,310 @@
+"""Stdlib-only HTTP JSON API over the scheduler.
+
+Endpoints (all JSON unless noted):
+
+- ``POST /jobs``      — submit a job; body ``{"width", "height", "cells",
+  "convention"?, "gen_limit"?, "check_similarity"?, "similarity_frequency"?,
+  "priority"?, "deadline_s"?}`` where ``cells`` is the text-grid encoding
+  (the same bytes the CLI reads/writes). 202 + ``{"id", "state"}`` on
+  acceptance, 429 when the queue is full or draining, 400 on a bad request.
+- ``GET /jobs/<id>``  — lifecycle state + timings.
+- ``GET /result/<id>``— final grid (text-grid string), generations, exit
+  reason; 409 while the job is not DONE, 410 for FAILED/CANCELLED.
+- ``DELETE /jobs/<id>`` — cancel a still-QUEUED job; 409 once it has been
+  claimed by a batch (dispatch is not interruptible), 404 if unknown.
+- ``GET /metrics``    — Prometheus text format; ``?format=json`` for the
+  JSON snapshot.
+- ``POST /drain``     — stop admission, flush the queue, wait for in-flight
+  batches; responds when quiescent. Idempotent.
+- ``GET /healthz``    — liveness + queue stats.
+
+The server composes replay-on-start with PR 1's auto-resume story: started
+on a journal directory that holds unfinished jobs, it re-queues exactly
+those (``JobJournal.replay``) and keeps serving results of finished ones —
+kill -9 at any point loses no accepted job and double-runs none.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+from gol_tpu.io import text_grid
+from gol_tpu.serve.jobs import DONE, FAILED, CANCELLED, JobJournal, new_job
+from gol_tpu.serve.metrics import Metrics
+from gol_tpu.serve.scheduler import Draining, QueueFull, Scheduler
+
+logger = logging.getLogger(__name__)
+
+_MAX_BODY = 64 << 20  # 64 MiB: a 4096^2 text board is ~17 MB
+
+
+class GolServer:
+    """The serving process: scheduler + journal + HTTP front end."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        journal_dir: str | None = None,
+        scheduler: Scheduler | None = None,
+        metrics: Metrics | None = None,
+        **scheduler_kwargs,
+    ):
+        self.metrics = metrics or Metrics()
+        journal = JobJournal(journal_dir) if journal_dir else None
+        self.scheduler = scheduler or Scheduler(
+            journal=journal, metrics=self.metrics, **scheduler_kwargs
+        )
+        self.replayed = 0
+        self._replay_results = {}
+        self._replay_failed = {}
+        self._replay_cancelled = set()
+        if journal is not None:
+            replay = journal.replay()
+            self._replay_results = replay.results
+            self._replay_failed = replay.failed
+            self._replay_cancelled = replay.cancelled
+            self.replayed = self.scheduler.resubmit_replayed(replay.pending)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self.scheduler.start()
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="gol-serve-http", daemon=True
+        )
+        self._thread.start()
+        logger.info("gol serve listening on %s", self.url)
+
+    def serve_forever(self) -> None:
+        self.scheduler.start()
+        logger.info("gol serve listening on %s", self.url)
+        self.httpd.serve_forever()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        return self.scheduler.drain(timeout=timeout)
+
+    def shutdown(self, drain: bool = True) -> None:
+        self.scheduler.stop(drain=drain)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.scheduler.journal is not None:
+            self.scheduler.journal.close()
+
+    # -- request-level operations (handler methods stay thin) -------------
+
+    def submit_json(self, body: dict) -> dict:
+        required = ("width", "height", "cells")
+        missing = [k for k in required if k not in body]
+        if missing:
+            raise ValueError(f"missing required field(s): {missing}")
+        width, height = int(body["width"]), int(body["height"])
+        if width <= 0 or height <= 0:
+            raise ValueError(f"dimensions must be positive, got {height}x{width}")
+        board = text_grid.decode(
+            str(body["cells"]).encode("ascii"), width, height
+        )
+        kwargs = {}
+        for field in (
+            "convention", "gen_limit", "check_similarity",
+            "similarity_frequency", "priority",
+        ):
+            if field in body:
+                kwargs[field] = body[field]
+        if body.get("deadline_s") is not None:
+            kwargs["deadline_s"] = float(body["deadline_s"])
+        job = new_job(width, height, board, **kwargs)
+        self.scheduler.submit(job)
+        return {"id": job.id, "state": job.state}
+
+    def job_json(self, job_id: str) -> dict | None:
+        job = self.scheduler.job(job_id)
+        if job is None:
+            if job_id in self._replay_results:
+                return {"id": job_id, "state": DONE, "restored": True}
+            if job_id in self._replay_failed:
+                return {
+                    "id": job_id, "state": FAILED, "restored": True,
+                    "error": self._replay_failed[job_id],
+                }
+            if job_id in self._replay_cancelled:
+                return {"id": job_id, "state": CANCELLED, "restored": True}
+            return None
+        out = {"id": job.id, "state": job.state}
+        if job.error:
+            out["error"] = job.error
+        if job.started_at is not None:
+            out["queue_seconds"] = job.started_at - job.accepted_at
+        if job.finished_at is not None and job.started_at is not None:
+            out["run_seconds"] = job.finished_at - job.started_at
+        return out
+
+    def result_json(self, job_id: str):
+        """(status_code, payload) for GET /result/<id>."""
+        job = self.scheduler.job(job_id)
+        result = job.result if job is not None and job.state == DONE else None
+        if result is None and job_id in self._replay_results:
+            result = self._replay_results[job_id]
+        if result is not None:
+            return 200, {
+                "id": job_id,
+                "generations": result.generations,
+                "exit_reason": result.exit_reason,
+                "width": int(result.grid.shape[1]),
+                "height": int(result.grid.shape[0]),
+                "grid": text_grid.encode(result.grid).decode("ascii"),
+            }
+        if job is None:
+            if job_id in self._replay_failed:
+                return 410, {"id": job_id, "state": FAILED,
+                             "error": self._replay_failed[job_id]}
+            if job_id in self._replay_cancelled:
+                return 410, {"id": job_id, "state": CANCELLED, "error": None}
+            return 404, {"error": f"unknown job {job_id}"}
+        if job.state in (FAILED, CANCELLED):
+            return 410, {"id": job_id, "state": job.state, "error": job.error}
+        return 409, {"id": job_id, "state": job.state,
+                     "error": "result not ready"}
+
+
+def _make_handler(server: GolServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # Socket timeout for the whole exchange: a client announcing more
+        # Content-Length than it sends must not pin a handler thread forever.
+        timeout = 60
+
+        # Route logs through logging, not the BaseHTTPRequestHandler default
+        # of raw stderr writes (the tree-wide lint rule).
+        def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+            logger.debug("%s - %s", self.address_string(), format % args)
+
+        def _reply(self, code: int, payload, content_type="application/json"):
+            body = (
+                json.dumps(payload).encode("utf-8")
+                if content_type == "application/json"
+                else payload.encode("utf-8")
+            )
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            if code >= 400:
+                # Error paths may not have consumed the request body (e.g.
+                # an over-MAX_BODY reject); closing is the safe way to keep
+                # a keep-alive client from desynchronizing.
+                self.send_header("Connection", "close")
+                self.close_connection = True
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > _MAX_BODY:
+                raise ValueError(f"body of {length} bytes exceeds {_MAX_BODY}")
+            raw = self.rfile.read(length) if length else b"{}"
+            body = json.loads(raw.decode("utf-8"))
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+            return body
+
+        def _discard_body(self) -> None:
+            """Drain an unparsed request body: on HTTP/1.1 keep-alive,
+            unread body bytes would be parsed as the NEXT request line and
+            desynchronize the connection."""
+            length = int(self.headers.get("Content-Length", 0))
+            while length > 0:
+                chunk = self.rfile.read(min(length, 1 << 16))
+                if not chunk:
+                    break
+                length -= len(chunk)
+
+        def do_POST(self):
+            path = urlparse(self.path).path
+            try:
+                if path == "/jobs":
+                    try:
+                        out = server.submit_json(self._read_body())
+                    except (QueueFull, Draining) as e:
+                        self._reply(429, {"error": str(e)})
+                        return
+                    self._reply(202, out)
+                elif path == "/drain":
+                    self._discard_body()
+                    drained = server.drain()
+                    self._reply(200, {
+                        "drained": drained,
+                        "stats": server.scheduler.stats(),
+                    })
+                else:
+                    self._discard_body()
+                    self._reply(404, {"error": f"no such endpoint {path}"})
+            except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+                # TypeError covers wrong JSON *types* in otherwise-present
+                # fields (priority: null, gen_limit: "x") — a client error,
+                # never allowed past Job validation into the queue.
+                self._reply(400, {"error": str(e)})
+
+        def do_DELETE(self):
+            path = urlparse(self.path).path
+            if not path.startswith("/jobs/"):
+                self._reply(404, {"error": f"no such endpoint {path}"})
+                return
+            job_id = path[len("/jobs/"):]
+            if server.scheduler.cancel(job_id):
+                self._reply(200, {"id": job_id, "state": "cancelled"})
+                return
+            out = server.job_json(job_id)
+            if out is None:
+                self._reply(404, {"error": f"unknown job {job_id}"})
+            else:
+                # Known but no longer cancellable (claimed or terminal).
+                self._reply(409, {
+                    "id": job_id, "state": out["state"],
+                    "error": "job is not queued; cannot cancel",
+                })
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            path = parsed.path
+            if path.startswith("/jobs/"):
+                out = server.job_json(path[len("/jobs/"):])
+                if out is None:
+                    self._reply(404, {"error": "unknown job"})
+                else:
+                    self._reply(200, out)
+            elif path.startswith("/result/"):
+                code, payload = server.result_json(path[len("/result/"):])
+                self._reply(code, payload)
+            elif path == "/metrics":
+                fmt = parse_qs(parsed.query).get("format", ["prometheus"])[0]
+                if fmt == "json":
+                    self._reply(200, server.metrics.snapshot())
+                else:
+                    self._reply(
+                        200, server.metrics.prometheus(),
+                        content_type="text/plain; version=0.0.4",
+                    )
+            elif path == "/healthz":
+                self._reply(200, {"ok": True, "stats": server.scheduler.stats()})
+            else:
+                self._reply(404, {"error": f"no such endpoint {path}"})
+
+    return Handler

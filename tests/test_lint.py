@@ -16,17 +16,36 @@ _LIBRARY_ROOT = pathlib.Path(gol_tpu.__file__).parent
 _FORBIDDEN = "sys.stderr.write"
 
 
-def test_no_raw_stderr_write_in_library_code():
-    offenders = []
-    for path in sorted(_LIBRARY_ROOT.rglob("*.py")):
+def _offenders(root: pathlib.Path, needle: str) -> list[str]:
+    out = []
+    for path in sorted(root.rglob("*.py")):
         for lineno, line in enumerate(
             path.read_text(encoding="utf-8").splitlines(), start=1
         ):
             code = line.split("#", 1)[0]  # prose may name the rule; code may not
-            if _FORBIDDEN in code:
-                offenders.append(f"{path.relative_to(_LIBRARY_ROOT)}:{lineno}")
+            if needle in code:
+                out.append(f"{path.relative_to(root)}:{lineno}")
+    return out
+
+
+def test_no_raw_stderr_write_in_library_code():
+    offenders = _offenders(_LIBRARY_ROOT, _FORBIDDEN)
     assert not offenders, (
         f"raw {_FORBIDDEN} in gol_tpu/ library code (route through "
         f"logging.getLogger(__name__) instead; see platform_env."
         f"configure_cli_logging): {offenders}"
+    )
+
+
+def test_no_wall_clock_in_serve_latency_paths():
+    """``time.time()`` is banned in gol_tpu/serve/: every latency sample and
+    dispatch-age decision there must come from ``time.perf_counter()``. The
+    wall clock steps under NTP (backwards included), which turns queue-age
+    math into negative waits and p99 latency into fiction. The journal
+    deliberately stores no timestamps at all, so nothing in the package has
+    a legitimate wall-clock need."""
+    offenders = _offenders(_LIBRARY_ROOT / "serve", "time.time(")
+    assert not offenders, (
+        "wall-clock time.time() in gol_tpu/serve/ (use time.perf_counter() "
+        f"for every latency/age path): {offenders}"
     )

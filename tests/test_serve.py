@@ -1,0 +1,573 @@
+"""The serving subsystem: batched engine exactness, batcher buckets, journal
+replay, scheduler policy, and the HTTP API.
+
+The load-bearing assertion, repeated at every layer: a board's result coming
+out of a batch — even a board that exits early while the rest of the batch
+keeps running — is byte/value-identical to a solo ``engine`` run AND to the
+NumPy oracle, for BOTH loop-accounting conventions.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.io import text_grid
+from gol_tpu.resilience.retry import RetryPolicy
+from gol_tpu.serve import batcher
+from gol_tpu.serve.jobs import (
+    CANCELLED, DONE, FAILED, QUEUED,
+    JobJournal, JobResult, new_job,
+)
+from gol_tpu.serve.scheduler import Draining, QueueFull, Scheduler
+from gol_tpu.serve.server import GolServer
+
+CONVENTIONS = [Convention.C, Convention.CUDA]
+
+
+def _mixed_fate_boards():
+    """Three 32x32 boards with three different fates at gen_limit=60."""
+    dies = np.zeros((32, 32), np.uint8)
+    dies[4, 4] = 1  # lone cell: dead after one generation
+    still = np.zeros((32, 32), np.uint8)
+    still[3:5, 3:5] = 1  # block still life: similarity exit
+    soup = text_grid.generate(32, 32, seed=7)  # runs to the limit
+    return [("dies", dies, "empty"), ("still", still, "similar"),
+            ("soup", soup, "gen_limit")]
+
+
+class TestBatchEngine:
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_mixed_fate_batch_matches_solo_and_oracle(self, convention):
+        """One bucket, three fates: early-empty, similarity exit, and
+        runs-to-limit — each board's (grid, count, exit reason) must equal a
+        solo engine run and the oracle, while the batch as a whole keeps
+        stepping to the last live board."""
+        named = _mixed_fate_boards()
+        cfg = GameConfig(gen_limit=60, convention=convention)
+        results = engine.simulate_batch([b for _, b, _ in named], cfg)
+        for (name, board, reason), got in zip(named, results):
+            want = oracle.run(board, cfg)
+            solo = engine.simulate(board, cfg)
+            assert np.array_equal(got.grid, want.grid), (convention, name)
+            assert np.array_equal(got.grid, solo.grid), (convention, name)
+            assert got.generations == want.generations == solo.generations, (
+                convention, name,
+            )
+            assert got.exit_reason == reason, (convention, name)
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_masked_bucket_mixed_shapes(self, convention):
+        """Different true extents share one padded canvas: the masked kernel
+        wraps each board at its own (h, w), so every result still matches
+        the solo torus bit-for-bit — including an early exit mid-batch."""
+        b1 = text_grid.generate(30, 30, seed=1)
+        b2 = text_grid.generate(18, 24, seed=2)
+        b3 = np.zeros((10, 13), np.uint8)
+        b3[2:4, 2:4] = 1  # block: similarity exit inside a running batch
+        cfg = GameConfig(gen_limit=40, convention=convention)
+        results = engine.simulate_batch(
+            [b1, b2, b3], cfg, padded_shape=(32, 32), pad_batch_to=4
+        )
+        for board, got in zip((b1, b2, b3), results):
+            want = oracle.run(board, cfg)
+            solo = engine.simulate(board, cfg)
+            assert np.array_equal(got.grid, want.grid), board.shape
+            assert got.generations == want.generations == solo.generations
+        assert results[2].exit_reason == "similar"
+
+    def test_byte_mode_unpackable_width(self):
+        """Exact-fit boards whose width does not pack (33) take the byte
+        kernel; results still match the oracle."""
+        board = text_grid.generate(33, 20, seed=5)  # width=33, height=20
+        assert engine.resolve_batch_mode([20], [33], (20, 33)) == "byte"
+        cfg = GameConfig(gen_limit=25)
+        got = engine.simulate_batch([board], cfg)[0]
+        want = oracle.run(board, cfg)
+        assert np.array_equal(got.grid, want.grid)
+        assert got.generations == want.generations
+
+    def test_per_board_gen_limits_share_one_program(self):
+        """gen_limit is a dynamic operand: three different limits hit the
+        same compiled runner (one cache entry), results all oracle-exact."""
+        soup = text_grid.generate(32, 32, seed=9)
+        before = engine.make_batch_runner.cache_info()
+        cfgs = [GameConfig(gen_limit=g) for g in (5, 17, 60)]
+        results = engine.simulate_batch([soup] * 3, cfgs)
+        after = engine.make_batch_runner.cache_info()
+        assert after.currsize - before.currsize <= 1
+        for cfg, got in zip(cfgs, results):
+            want = oracle.run(soup, cfg)
+            assert np.array_equal(got.grid, want.grid)
+            assert got.generations == want.generations
+
+    def test_batch_rejects_mixed_conventions(self):
+        soup = text_grid.generate(32, 32, seed=9)
+        with pytest.raises(ValueError, match="share convention"):
+            engine.simulate_batch(
+                [soup, soup],
+                [GameConfig(convention=Convention.C),
+                 GameConfig(convention=Convention.CUDA)],
+            )
+
+    def test_board_exceeding_canvas_rejected(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            engine.resolve_batch_mode([40], [40], (32, 32))
+
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.default_rng(3)
+        stacked = rng.integers(0, 2, size=(3, 16, 64), dtype=np.uint8)
+        words = engine._pack_board_words(stacked)
+        assert words.shape == (3, 16, 2) and words.dtype == np.uint32
+        np.testing.assert_array_equal(
+            engine._unpack_board_words(words), stacked
+        )
+
+
+class TestBatcher:
+    def test_bucket_assignment(self):
+        j30 = new_job(30, 30, np.zeros((30, 30), np.uint8))
+        j32 = new_job(32, 32, np.zeros((32, 32), np.uint8))
+        jc = new_job(32, 32, np.zeros((32, 32), np.uint8),
+                     convention=Convention.CUDA)
+        k30, k32, kc = (batcher.bucket_for(j) for j in (j30, j32, jc))
+        assert (k30.height, k30.width, k30.kernel) == (32, 32, "masked")
+        assert (k32.height, k32.width, k32.kernel) == (32, 32, "packed")
+        assert k32 != k30  # padded vs exact-fit never share a program
+        assert kc != k32  # conventions never share a program
+        assert batcher.pad_dim(1) == 32 and batcher.pad_dim(33) == 64
+
+    def test_pad_batch_ladder(self):
+        assert [batcher.pad_batch(n) for n in (1, 2, 3, 8, 9, 48, 64)] == [
+            1, 2, 4, 8, 16, 64, 64,
+        ]
+        # Never rounds DOWN: the rung is the denominator of occupancy.
+        for n in range(1, batcher.MAX_BATCH + 1):
+            assert batcher.pad_batch(n) >= n
+        with pytest.raises(ValueError):
+            batcher.pad_batch(batcher.MAX_BATCH + 1)
+        with pytest.raises(ValueError):
+            batcher.pad_batch(0)
+
+    def test_run_batch_rejects_foreign_job(self):
+        j30 = new_job(30, 30, np.zeros((30, 30), np.uint8))
+        j32 = new_job(32, 32, np.zeros((32, 32), np.uint8))
+        with pytest.raises(ValueError, match="belongs to bucket"):
+            batcher.run_batch(batcher.bucket_for(j32), [j30])
+
+    def test_run_batch_results_in_job_order(self):
+        boards = [text_grid.generate(32, 32, seed=s) for s in (1, 2, 3)]
+        jobs = [new_job(32, 32, b, gen_limit=20) for b in boards]
+        key = batcher.bucket_for(jobs[0])
+        results = batcher.run_batch(key, jobs)
+        for board, res in zip(boards, results):
+            want = oracle.run(board, GameConfig(gen_limit=20))
+            assert np.array_equal(res.grid, want.grid)
+            assert res.generations == want.generations
+
+
+class TestJournal:
+    def test_replay_roundtrip(self, tmp_path):
+        journal = JobJournal(str(tmp_path))
+        a = new_job(8, 8, np.zeros((8, 8), np.uint8))
+        b = new_job(8, 8, np.ones((8, 8), np.uint8), gen_limit=7, priority=3)
+        c = new_job(8, 8, np.zeros((8, 8), np.uint8))
+        d = new_job(8, 8, np.zeros((8, 8), np.uint8))
+        for j in (a, b, c, d):
+            journal.record_submit(j)
+        a.result = JobResult(
+            grid=np.ones((8, 8), np.uint8), generations=4, exit_reason="empty"
+        )
+        journal.record_done(a)
+        c.error = "boom"
+        journal.record_failed(c)
+        journal.record_cancelled(d)
+        journal.close()
+
+        replay = JobJournal(str(tmp_path)).replay()
+        assert [j.id for j in replay.pending] == [b.id]
+        assert replay.pending[0].gen_limit == 7
+        assert replay.pending[0].priority == 3
+        assert replay.results.keys() == {a.id}
+        np.testing.assert_array_equal(
+            replay.results[a.id].grid, np.ones((8, 8), np.uint8)
+        )
+        assert replay.results[a.id].generations == 4
+        assert replay.failed == {c.id: "boom"}
+        assert replay.cancelled == {d.id}
+        assert replay.torn_lines == 0
+
+    def test_torn_tail_dropped(self, tmp_path):
+        journal = JobJournal(str(tmp_path))
+        job = new_job(8, 8, np.zeros((8, 8), np.uint8))
+        journal.record_submit(job)
+        journal.close()
+        # A crash mid-append: the tail line is half a record.
+        with open(journal.path, "ab") as f:
+            f.write(b'{"event": "done", "id": "xyz", "gen')
+        replay = JobJournal(str(tmp_path)).replay()
+        assert [j.id for j in replay.pending] == [job.id]
+        assert replay.torn_lines == 1
+
+
+def _wait(predicate, timeout=30.0, interval=0.01):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestScheduler:
+    def test_end_to_end_mixed_buckets(self, tmp_path):
+        journal = JobJournal(str(tmp_path))
+        sched = Scheduler(journal=journal, flush_age=0.01)
+        boards = [
+            text_grid.generate(32, 32, seed=1),
+            text_grid.generate(30, 30, seed=2),  # different bucket (masked)
+            text_grid.generate(32, 32, seed=3),
+        ]
+        jobs = [new_job(b.shape[1], b.shape[0], b, gen_limit=15) for b in boards]
+        sched.start()
+        try:
+            for j in jobs:
+                sched.submit(j)
+            assert _wait(lambda: all(j.state == DONE for j in jobs)), [
+                j.state for j in jobs
+            ]
+        finally:
+            sched.stop()
+        for board, j in zip(boards, jobs):
+            want = oracle.run(board, GameConfig(gen_limit=15))
+            assert np.array_equal(j.result.grid, want.grid)
+            assert j.result.generations == want.generations
+        assert sched.metrics.counter("jobs_completed_total") == 3
+        replay = JobJournal(str(tmp_path)).replay()
+        assert not replay.pending  # every accepted job reached a terminal record
+        assert set(replay.results) == {j.id for j in jobs}
+
+    def test_queue_full_rejects(self):
+        sched = Scheduler(max_queue_depth=2)  # never started: jobs sit queued
+        for seed in (1, 2):
+            sched.submit(new_job(8, 8, text_grid.generate(8, 8, seed=seed)))
+        with pytest.raises(QueueFull):
+            sched.submit(new_job(8, 8, text_grid.generate(8, 8, seed=3)))
+        assert sched.metrics.counter("jobs_rejected_total") == 1
+
+    def test_replay_bypasses_admission_cap(self, tmp_path):
+        """Journal replay can exceed max_queue_depth: replayed jobs were
+        already accepted once, and bouncing them would turn a full-queue
+        crash into an unrecoverable restart loop."""
+        journal = JobJournal(str(tmp_path))
+        for seed in range(3):
+            journal.record_submit(
+                new_job(8, 8, text_grid.generate(8, 8, seed=seed))
+            )
+        journal.close()
+        replay = JobJournal(str(tmp_path)).replay()
+        sched = Scheduler(max_queue_depth=1)  # smaller than the backlog
+        assert sched.resubmit_replayed(replay.pending) == 3
+        assert sched.stats()["queued"] == 3
+        # Fresh admissions still hit the cap.
+        with pytest.raises(QueueFull):
+            sched.submit(new_job(8, 8, text_grid.generate(8, 8, seed=9)))
+
+    def test_draining_rejects(self):
+        sched = Scheduler()
+        sched.drain(timeout=0.1)
+        with pytest.raises(Draining):
+            sched.submit(new_job(8, 8, np.zeros((8, 8), np.uint8)))
+
+    def test_cancel_queued_job(self):
+        sched = Scheduler()  # not started
+        job = sched.submit(new_job(8, 8, np.zeros((8, 8), np.uint8)))
+        assert sched.cancel(job.id) is True
+        assert job.state == CANCELLED
+        assert sched.cancel(job.id) is False  # already terminal
+        assert sched.stats()["queued"] == 0
+
+    def test_priority_and_deadline_order_dispatch(self):
+        sched = Scheduler(max_batch=2, flush_age=0.0)  # not started
+        low = sched.submit(new_job(8, 8, np.zeros((8, 8), np.uint8), priority=0))
+        high = sched.submit(new_job(8, 8, np.zeros((8, 8), np.uint8), priority=5))
+        mid = sched.submit(
+            new_job(8, 8, np.zeros((8, 8), np.uint8), priority=0, deadline_s=0.5)
+        )
+        with sched._cv:
+            _key, take = sched._claim_locked(time.perf_counter() + 1)
+        # priority first, then nearest deadline beats plain arrival.
+        assert [j.id for j in take] == [high.id, mid.id]
+        assert low.state == QUEUED
+
+    def test_transient_dispatch_error_retries(self):
+        calls = {"n": 0}
+        real = batcher.run_batch
+
+        def flaky(key, jobs):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("UNAVAILABLE: injected transient hiccup")
+            return real(key, jobs)
+
+        sched = Scheduler(
+            flush_age=0.0,
+            retry=RetryPolicy(attempts=3, base_delay=0.0),
+            run_batch=flaky,
+        )
+        job = sched.submit(new_job(8, 8, text_grid.generate(8, 8, seed=4),
+                                   gen_limit=5))
+        sched.start()
+        try:
+            assert _wait(lambda: job.state == DONE), job.state
+        finally:
+            sched.stop()
+        assert calls["n"] == 3
+        assert sched.metrics.counter("batch_retries_total") == 2
+        want = oracle.run(job.board, GameConfig(gen_limit=5))
+        assert np.array_equal(job.result.grid, want.grid)
+
+    def test_persistent_dispatch_error_fails_jobs(self, tmp_path):
+        def broken(key, jobs):
+            raise ValueError("bad batch")  # never classified transient
+
+        journal = JobJournal(str(tmp_path))
+        sched = Scheduler(journal=journal, flush_age=0.0, run_batch=broken)
+        job = sched.submit(new_job(8, 8, text_grid.generate(8, 8, seed=4)))
+        sched.start()
+        try:
+            assert _wait(lambda: job.state == FAILED), job.state
+        finally:
+            sched.stop()
+        assert "bad batch" in job.error
+        assert JobJournal(str(tmp_path)).replay().failed.keys() == {job.id}
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestServer:
+    @pytest.fixture
+    def server(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "journal"),
+                        flush_age=0.01)
+        srv.start()
+        yield srv
+        srv.shutdown()
+
+    def test_submit_poll_result_metrics_drain(self, server):
+        base = server.url
+        boards = {
+            "a": text_grid.generate(32, 32, seed=11),
+            "b": text_grid.generate(30, 30, seed=12),  # second bucket shape
+        }
+        ids = {}
+        for name, board in boards.items():
+            status, raw = _http("POST", f"{base}/jobs", {
+                "width": board.shape[1],
+                "height": board.shape[0],
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": 12,
+            })
+            assert status == 202, raw
+            ids[name] = json.loads(raw)["id"]
+
+        for name, board in boards.items():
+            jid = ids[name]
+            assert _wait(lambda: json.loads(
+                _http("GET", f"{base}/jobs/{jid}")[1]
+            )["state"] == DONE)
+            status, raw = _http("GET", f"{base}/result/{jid}")
+            assert status == 200
+            payload = json.loads(raw)
+            want = oracle.run(board, GameConfig(gen_limit=12))
+            got = text_grid.decode(
+                payload["grid"].encode("ascii"),
+                payload["width"], payload["height"],
+            )
+            np.testing.assert_array_equal(np.asarray(got), want.grid)
+            assert payload["generations"] == want.generations
+
+        status, raw = _http("GET", f"{base}/metrics?format=json")
+        snap = json.loads(raw)
+        assert snap["counters"]["jobs_completed_total"] == 2
+        assert "queue_latency_seconds" in snap["histograms"]
+        assert "run_latency_seconds" in snap["histograms"]
+        status, raw = _http("GET", f"{base}/metrics")
+        text = raw.decode()
+        assert "gol_serve_jobs_completed_total 2" in text
+        assert 'gol_serve_run_latency_seconds{quantile="0.99"}' in text
+
+        status, raw = _http("POST", f"{base}/drain", {})
+        assert status == 200 and json.loads(raw)["drained"] is True
+        # Draining servers refuse new work with 429.
+        status, raw = _http("POST", f"{base}/jobs", {
+            "width": 8, "height": 8,
+            "cells": text_grid.encode(np.zeros((8, 8), np.uint8)).decode(),
+        })
+        assert status == 429
+
+    def test_bad_requests(self, server):
+        base = server.url
+        assert _http("POST", f"{base}/jobs", {"width": 8})[0] == 400
+        assert _http("GET", f"{base}/jobs/nope")[0] == 404
+        assert _http("GET", f"{base}/result/nope")[0] == 404
+        assert _http("POST", f"{base}/nope", {})[0] == 404
+
+    def test_bad_field_types_rejected_not_queued(self, server):
+        """Wrong JSON *types* (priority: null, gen_limit: "x") must be 400 at
+        admission — an accepted-but-poisoned job would kill the worker
+        thread at dispatch-key time and wedge the scheduler forever."""
+        base = server.url
+        cells = text_grid.encode(text_grid.generate(8, 8, seed=1)).decode()
+        for bad in (
+            {"priority": None}, {"priority": "high"},
+            {"gen_limit": "x"}, {"similarity_frequency": None},
+            {"deadline_s": "soon"}, {"check_similarity": "false"},
+        ):
+            body = {"width": 8, "height": 8, "cells": cells, **bad}
+            status, raw = _http("POST", f"{base}/jobs", body)
+            assert status == 400, (bad, raw)
+        # The scheduler is still alive: a well-formed job completes.
+        status, raw = _http("POST", f"{base}/jobs", {
+            "width": 8, "height": 8, "cells": cells, "gen_limit": 5,
+        })
+        assert status == 202
+        jid = json.loads(raw)["id"]
+        assert _wait(lambda: json.loads(
+            _http("GET", f"{base}/jobs/{jid}")[1]
+        )["state"] == DONE)
+
+    def test_cancel_endpoint(self, tmp_path):
+        # flush_age 10s: the lone job sits QUEUED long enough to cancel.
+        srv = GolServer(port=0, flush_age=10.0)
+        srv.start()
+        try:
+            base = srv.url
+            job = srv.scheduler.submit(new_job(8, 8, np.zeros((8, 8), np.uint8)))
+            status, raw = _http("DELETE", f"{base}/jobs/{job.id}")
+            assert status == 200 and json.loads(raw)["state"] == CANCELLED
+            assert job.state == CANCELLED
+            # Terminal job: no longer cancellable.
+            assert _http("DELETE", f"{base}/jobs/{job.id}")[0] == 409
+            assert _http("DELETE", f"{base}/jobs/unknown")[0] == 404
+        finally:
+            srv.shutdown()
+
+    def test_worker_survives_journal_append_failure(self, tmp_path):
+        """A journal I/O error on a terminal record must not kill the worker
+        thread: the job stays DONE in-memory and later batches still run."""
+        journal = JobJournal(str(tmp_path))
+        real_done = JobJournal.record_done
+        fail = {"armed": True}
+
+        def flaky_done(self_j, job):
+            if fail.pop("armed", False):
+                raise OSError(28, "No space left on device")
+            return real_done(self_j, job)
+
+        sched = Scheduler(journal=journal, flush_age=0.0)
+        try:
+            JobJournal.record_done = flaky_done
+            sched.start()
+            j1 = sched.submit(new_job(8, 8, text_grid.generate(8, 8, seed=1),
+                                      gen_limit=3))
+            assert _wait(lambda: j1.state == DONE), j1.state
+            # The worker is still alive: a second job completes and journals.
+            j2 = sched.submit(new_job(8, 8, text_grid.generate(8, 8, seed=2),
+                                      gen_limit=3))
+            assert _wait(lambda: j2.state == DONE), j2.state
+        finally:
+            JobJournal.record_done = real_done
+            sched.stop()
+        assert sched.metrics.counter("journal_errors_total") == 1
+        replay = JobJournal(str(tmp_path)).replay()
+        # j1's done record was lost (it would re-run after restart, loudly
+        # logged); j2's landed.
+        assert j2.id in replay.results and j1.id in {j.id for j in replay.pending}
+
+    def test_result_not_ready_conflict(self, tmp_path):
+        # Scheduler intentionally not started: the job stays queued.
+        srv = GolServer(port=0, flush_age=10.0)
+        srv.httpd.server_close()
+        job = srv.scheduler.submit(
+            new_job(8, 8, np.zeros((8, 8), np.uint8))
+        )
+        code, payload = srv.result_json(job.id)
+        assert code == 409 and payload["state"] == QUEUED
+
+    def test_restart_replays_journal_exactly_once(self, tmp_path):
+        journal_dir = str(tmp_path / "journal")
+        board = text_grid.generate(32, 32, seed=21)
+        # Server 1 accepts (journals) a job but is killed before running it:
+        # its scheduler never starts.
+        srv1 = GolServer(port=0, journal_dir=journal_dir, flush_age=0.01)
+        srv1.httpd.server_close()  # simulate the crash: no drain, no stop
+        job = srv1.scheduler.submit(
+            new_job(32, 32, board, gen_limit=18)
+        )
+        srv1.scheduler.journal.close()
+
+        # Server 2 replays: the accepted job runs to DONE exactly once.
+        srv2 = GolServer(port=0, journal_dir=journal_dir, flush_age=0.01)
+        assert srv2.replayed == 1
+        srv2.start()
+        try:
+            assert _wait(
+                lambda: (j := srv2.scheduler.job(job.id)) is not None
+                and j.state == DONE
+            )
+        finally:
+            srv2.shutdown()
+        want = oracle.run(board, GameConfig(gen_limit=18))
+        replayed_job = srv2.scheduler.job(job.id)
+        assert np.array_equal(replayed_job.result.grid, want.grid)
+        assert replayed_job.result.generations == want.generations
+
+        # Exactly-once: one submit record, one done record for the id.
+        with open(JobJournal(journal_dir).path, "rb") as f:
+            events = [json.loads(line) for line in f.read().splitlines() if line]
+        submits = [e for e in events if e["event"] == "submit"
+                   and e["job"]["id"] == job.id]
+        dones = [e for e in events if e["event"] == "done" and e["id"] == job.id]
+        assert len(submits) == 1 and len(dones) == 1
+
+        # Server 3 replays nothing (the job is terminal) but still serves
+        # the result from the journal.
+        srv3 = GolServer(port=0, journal_dir=journal_dir, flush_age=0.01)
+        assert srv3.replayed == 0
+        code, payload = srv3.result_json(job.id)
+        assert code == 200 and payload["generations"] == want.generations
+        srv3.httpd.server_close()
+        srv3.scheduler.journal.close()
+
+    def test_cancelled_job_survives_restart_as_410(self, tmp_path):
+        journal_dir = str(tmp_path / "journal")
+        srv1 = GolServer(port=0, journal_dir=journal_dir)
+        srv1.httpd.server_close()
+        job = srv1.scheduler.submit(new_job(8, 8, np.zeros((8, 8), np.uint8)))
+        assert srv1.scheduler.cancel(job.id) is True
+        srv1.scheduler.journal.close()
+
+        srv2 = GolServer(port=0, journal_dir=journal_dir)
+        assert srv2.replayed == 0  # cancelled is terminal: not re-run
+        assert srv2.job_json(job.id)["state"] == CANCELLED
+        code, payload = srv2.result_json(job.id)
+        assert code == 410 and payload["state"] == CANCELLED
+        srv2.httpd.server_close()
+        srv2.scheduler.journal.close()

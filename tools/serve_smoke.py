@@ -1,0 +1,242 @@
+"""Serving crash/restart smoke: kill `gol serve` mid-batch, replay, verify.
+
+The `make serve-smoke` harness, exercising the restart-safety acceptance
+end-to-end against real OS processes:
+
+1. boot `gol serve` on a free port with a fresh journal directory;
+2. submit N jobs (default 50) across TWO bucket shapes (32x32 exact-fit
+   packed and 30x30 masked) — every accepted id is remembered;
+3. SIGKILL the server while work is in flight (mid-compile/mid-batch);
+4. restart on the same journal: replay must re-queue exactly the accepted
+   jobs with no terminal record;
+5. wait until every accepted job reports DONE, then POST /drain and
+   SIGTERM (the graceful path);
+6. verify from the journal that every accepted id has EXACTLY one done
+   record (none lost, none double-completed) and that every result is
+   byte-identical to the NumPy oracle.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/serve_smoke.py [--jobs 50] [--gen-limit 400]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu import oracle  # noqa: E402
+from gol_tpu.config import GameConfig  # noqa: E402
+from gol_tpu.io import text_grid  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _start_server(port: int, journal_dir: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "serve",
+            "--port", str(port),
+            "--journal-dir", journal_dir,
+            "--flush-age", "0.05",
+        ],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.perf_counter() + 120
+    base = f"http://127.0.0.1:{port}"
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(f"server died on boot rc={proc.returncode}:\n{out[-3000:]}")
+        try:
+            status, _ = _http("GET", f"{base}/healthz", timeout=2)
+            if status == 200:
+                return proc
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("server did not become healthy within 120s")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=50)
+    parser.add_argument("--gen-limit", type=int, default=400)
+    parser.add_argument(
+        "--kill-after", type=float, default=0.8,
+        help="seconds after the last submit to SIGKILL the first server",
+    )
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-serve-smoke-")
+    journal_dir = os.path.join(workdir, "journal")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    cfg = GameConfig(gen_limit=args.gen_limit)
+
+    # Two bucket shapes: exact-fit packed (32x32) and padded masked (30x30).
+    boards = {}
+    rc = 1
+    proc = None
+    try:
+        proc = _start_server(port, journal_dir)
+        print(f"serve-smoke: server up on {base}, journal {journal_dir}")
+        accepted = {}
+        for i in range(args.jobs):
+            side = 32 if i % 2 == 0 else 30
+            board = text_grid.generate(side, side, seed=1000 + i)
+            status, payload = _http("POST", f"{base}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": args.gen_limit,
+            })
+            if status != 202:
+                print(f"serve-smoke: submit {i} rejected HTTP {status}: {payload}")
+                return 1
+            accepted[payload["id"]] = board
+            boards[payload["id"]] = board
+        print(f"serve-smoke: accepted {len(accepted)} jobs across 2 buckets")
+
+        # Kill mid-flight: the first dispatch of each bucket is still
+        # compiling or running its first batches this soon after submit.
+        time.sleep(args.kill_after)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        proc = None
+
+        done_before = _count_done(journal_dir)
+        print(f"serve-smoke: SIGKILL'd server; journal shows "
+              f"{len(done_before)} done of {len(accepted)}")
+
+        # Restart on the same journal: replay finishes the remainder.
+        proc = _start_server(port, journal_dir)
+        deadline = time.perf_counter() + 600
+        pending = set(accepted)
+        while pending and time.perf_counter() < deadline:
+            for job_id in list(pending):
+                status, payload = _http("GET", f"{base}/jobs/{job_id}")
+                if status != 200:
+                    print(f"serve-smoke: job {job_id} LOST after restart "
+                          f"(HTTP {status}: {payload})")
+                    return 1
+                state = payload["state"]
+                if state == "done":
+                    pending.discard(job_id)
+                elif state in ("failed", "cancelled"):
+                    print(f"serve-smoke: job {job_id} ended {state}: {payload}")
+                    return 1
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            print(f"serve-smoke: {len(pending)} job(s) never completed")
+            return 1
+
+        status, payload = _http("POST", f"{base}/drain", {}, timeout=60)
+        if status != 200 or not payload.get("drained"):
+            print(f"serve-smoke: drain failed HTTP {status}: {payload}")
+            return 1
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            print("serve-smoke: server ignored SIGTERM")
+            proc.kill()
+            return 1
+        proc = None
+
+        # The exactly-once ledger: every accepted id -> exactly 1 done
+        # record, and every recorded result matches the oracle.
+        done = _count_done(journal_dir)
+        lost = set(accepted) - set(done)
+        extra = set(done) - set(accepted)
+        dup = {k: v for k, v in done.items() if len(v) != 1}
+        if lost or extra or dup:
+            print(f"serve-smoke: lost={lost} unknown={extra} "
+                  f"duplicated={{k: len(v) for k, v in dup.items()}}")
+            return 1
+        mismatches = 0
+        for job_id, records in done.items():
+            rec = records[0]
+            want = oracle.run(accepted[job_id], cfg)
+            got = text_grid.decode(
+                rec["grid"].encode("ascii"), rec["width"], rec["height"]
+            )
+            if (
+                not np.array_equal(np.asarray(got), want.grid)
+                or rec["generations"] != want.generations
+            ):
+                mismatches += 1
+        if mismatches:
+            print(f"serve-smoke: {mismatches} result(s) diverge from the oracle")
+            return 1
+        print(
+            f"serve-smoke: PASS — {len(accepted)} accepted, "
+            f"{len(done_before)} done before the kill, remainder replayed; "
+            f"every job done exactly once, all oracle-identical"
+        )
+        rc = 0
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        if rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"serve-smoke: artifacts kept in {workdir}")
+
+
+def _count_done(journal_dir: str) -> dict:
+    """id -> [done records] from the journal (tolerates a torn tail)."""
+    path = os.path.join(journal_dir, "journal.jsonl")
+    done: dict = {}
+    if not os.path.exists(path):
+        return done
+    with open(path, "rb") as f:
+        for line in f.read().split(b"\n"):
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") == "done":
+                done.setdefault(rec["id"], []).append(rec)
+    return done
+
+
+if __name__ == "__main__":
+    sys.exit(main())
