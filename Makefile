@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench serve-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench serve-smoke tune-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -27,6 +27,12 @@ bench:
 # exactly once with oracle-identical results.
 serve-smoke:
 	python3 tools/serve_smoke.py
+
+# Autotune end-to-end smoke (tools/tune_smoke.py): a tiny CPU search runs,
+# persists plans, a fresh process reloads them, and the selected plan's
+# output byte-matches the NumPy oracle (empty-cache runs stay byte-identical).
+tune-smoke:
+	python3 tools/tune_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

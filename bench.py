@@ -386,6 +386,104 @@ def _bench_compare(args) -> int:
     return 0
 
 
+def _bench_tune(args) -> int:
+    """Tuned-vs-default through the autotuner (--suite tune).
+
+    Runs the gol_tpu/tune search on two engine shapes plus the serve-bucket
+    geometry, each candidate byte-gated against the default engine (itself
+    oracle-checked where affordable), and records the full per-candidate
+    series in BENCH_r06.json. The winner is the measured argmin over a
+    candidate set that CONTAINS the default ladder, so tuned >= default on
+    every shape by construction; the headline value is the best
+    tuned-over-default speedup, and ``strictly_faster`` says whether any
+    shape's winner beat the ladder outright (a >2% win — inside that the
+    search keeps the default).
+    """
+    import jax
+
+    from gol_tpu.config import GameConfig
+    from gol_tpu.tune import measure
+
+    gen_limit = args.gen_limit if args.gen_limit is not None else 64
+    shapes = ((256, 256), (512, 512))
+    records = []
+    print(
+        f"bench tune: shapes {['x'.join(map(str, s)) for s in shapes]} + "
+        f"serve geometry, gen_limit={gen_limit}, iters={args.repeats}, "
+        f"platform={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    detail = {}
+    for height, width in shapes:
+        print(f"  engine search {height}x{width}/c", file=sys.stderr)
+        result = measure.run_engine_search(
+            height, width, GameConfig(gen_limit=gen_limit),
+            iters=args.repeats,
+        )
+        records.append(result.to_dict())
+        detail[f"engine:{height}x{width}"] = round(result.speedup, 4)
+        print(
+            f"  -> winner {result.winner.label()} at {result.speedup:.3f}x "
+            f"default ({result.default_label})",
+            file=sys.stderr,
+        )
+    print("  serve geometry search (48x48 boards)", file=sys.stderr)
+    serve_result = measure.run_serve_search(
+        48, 48, gen_limit=min(gen_limit, 8), iters=args.repeats,
+    )
+    records.append(serve_result.to_dict())
+    detail["serve:48x48"] = round(serve_result.speedup, 4)
+    print(
+        f"  -> winner {serve_result.winner.label()} at "
+        f"{serve_result.speedup:.3f}x default",
+        file=sys.stderr,
+    )
+
+    speedups = [r["tuned_vs_default"] for r in records]
+    gates_ok = all(r["gates_all_ok"] for r in records)
+    payload = {
+        "metric": "tuned_vs_default_speedup",
+        "value": max(speedups),
+        "unit": "x",
+        # No external baseline: the default ladder IS the denominator.
+        "vs_baseline": None,
+        "detail": detail,
+        "tuned_ge_default_everywhere": all(s >= 1.0 for s in speedups),
+        "strictly_faster_somewhere": any(s > 1.0 for s in speedups),
+        "all_candidates_passed_gate": gates_ok,
+        "gen_limit": gen_limit,
+        "searches": records,
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r06.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    # The stdout contract: ONE JSON line (without the bulky per-candidate
+    # series, which lives in the artifact).
+    print(json.dumps({k: v for k, v in payload.items() if k != "searches"}))
+    return 0 if gates_ok else 1
+
+
+# Named measurement suites, table-driven: adding one is one line here (plus
+# its _bench_* function) — no if/elif chain to grow. Each entry is
+# (runner, one-line help shown by --list-suites). Suites pin their own
+# workloads; the size/config resolution in main() is for the solo lanes.
+SUITES = {
+    "batch": (
+        _bench_batch,
+        "boards/sec and occupancy through the serve batcher at B in "
+        "{1, 8, 64} on 256^2 boards (the amortized-dispatch serving win)",
+    ),
+    "tune": (
+        _bench_tune,
+        "tuned-vs-default via gol_tpu/tune on two engine shapes + the serve "
+        "bucket geometry; writes BENCH_r06.json",
+    ),
+}
+
+
 def resolve_workload(args, n_devices: int | None = None) -> None:
     """Resolve --config presets and the default workload, in that order.
 
@@ -482,11 +580,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--suite",
-        choices=("batch",),
+        choices=sorted(SUITES),
         default=None,
-        help="named measurement suite: 'batch' measures boards/sec and "
-        "occupancy through the serve batcher at B in {1, 8, 64} on 256^2 "
-        "boards (the amortized-dispatch serving win)",
+        help="named measurement suite (see --list-suites)",
+    )
+    parser.add_argument(
+        "--list-suites",
+        action="store_true",
+        help="print the available suites and exit",
     )
     parser.add_argument(
         "--halo",
@@ -513,11 +614,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.list_suites:
+        for name in sorted(SUITES):
+            print(f"{name}\t{SUITES[name][1]}")
+        return 0
     _honor_platform_env()
-    if args.suite == "batch":
-        # The suite pins its own workload (64 boards of 256^2); the
-        # size/config resolution below is for the solo-engine lanes.
-        return _bench_batch(args)
+    if args.suite:
+        return SUITES[args.suite][0](args)
     if args.gen_limit is None:
         args.gen_limit = 1000
     resolve_workload(args)

@@ -180,7 +180,10 @@ def _validate_checkpoint_args(args) -> None:
 
 
 def _run(args) -> int:
+    from gol_tpu.platform_env import enable_compile_cache
     from gol_tpu.resilience import faults
+
+    enable_compile_cache(args.compile_cache)
 
     if args.fault_plan:
         faults.install(faults.FaultPlan.parse(args.fault_plan))
@@ -669,13 +672,24 @@ def _serve(args) -> int:
     Boots the HTTP API (gol_tpu/serve/server.py) over the journaled
     scheduler. SIGTERM/SIGINT drain gracefully: admission stops, queued
     buckets flush, in-flight batches finish, then the process exits — no
-    accepted job is lost (the journal replays any that were cut off)."""
+    accepted job is lost (the journal replays any that were cut off).
+
+    ``--compile-cache`` persists XLA/Mosaic compiles across restarts;
+    ``--warm-plans`` pre-compiles the bucket programs of every shape the
+    offline tuner (`gol tune`) recorded, so tuned fleets pay neither
+    compile on the first request after a restart."""
     import signal
+
+    from gol_tpu.platform_env import enable_compile_cache
+
+    enable_compile_cache(args.compile_cache)
 
     from gol_tpu.serve.server import GolServer
 
     if args.flush_age < 0:
         raise ValueError(f"--flush-age must be >= 0, got {args.flush_age}")
+    if args.warm_plans:
+        _warm_plans()
     server = GolServer(
         host=args.host,
         port=args.port,
@@ -711,6 +725,161 @@ def _serve(args) -> int:
     # A second signal raises SystemExit(1) in the main thread (the hard-exit
     # path) — it must PROPAGATE so supervisors see a non-zero status for an
     # aborted drain, not a clean 0.
+    return 0
+
+
+def _warm_plans() -> None:
+    """Pre-compile the bucket programs of every tuner-recorded serve shape
+    (plus the tuned quantum/ladder geometry, consulted implicitly by
+    ``bucket_for``). EVERY ladder rung compiles, not just the full batch:
+    real flushes dispatch at whatever rung the flushed count rounds to, and
+    each rung is a distinct compiled program — warming only the top rung
+    would leave the common partial-flush sizes paying compile on their
+    first request. Warm failures are loud but non-fatal: a server that
+    compiles on first dispatch still serves."""
+    from gol_tpu.serve import batcher
+    from gol_tpu.serve.jobs import new_job
+    from gol_tpu.tune import select
+
+    entries = select.warm_entries()
+    if not entries:
+        print("no tuned serve shapes to warm (run `gol tune --serve-board` "
+              "first)", file=sys.stderr)
+        return
+    rungs = batcher._plan().batch_ladder
+    for entry in entries:
+        t0 = time.perf_counter()
+        # The whole per-entry path sits inside the guard: warm entries are
+        # cache-file content, and a stale or hand-edited entry (bad
+        # convention, non-numeric extent) must degrade like every other
+        # cache problem — loudly, to compiling on first dispatch — never
+        # abort server boot.
+        try:
+            height, width = int(entry["height"]), int(entry["width"])
+            convention = str(entry.get("convention", "c"))
+            board = np.zeros((height, width), dtype=np.uint8)
+            key = batcher.bucket_for(
+                new_job(width, height, board, convention=convention)
+            )
+            for rung in rungs:
+                batcher.warm(key, batch=rung)
+        except Exception as err:  # noqa: BLE001 - warmup must not kill boot
+            print(f"warm entry {entry} failed ({type(err).__name__}: {err})",
+                  file=sys.stderr)
+            continue
+        print(f"warmed bucket {key.label()} ({len(rungs)} batch rungs) in "
+              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+
+def _tune(args) -> int:
+    """``gol tune``: the offline measured search.
+
+    Searches the declarative space (gol_tpu/tune/space.py) for each
+    requested shape x convention, byte-gating every candidate against the
+    default engine (oracle-checked where affordable), and commits the
+    winners to the persistent plan cache — after which `gol run`/`gol
+    serve` on the same machine pick them up automatically. A human-readable
+    report goes to --report (or stderr)."""
+    from gol_tpu.platform_env import enable_compile_cache
+
+    enable_compile_cache(args.compile_cache)
+
+    from gol_tpu.parallel.mesh import topology_for
+    from gol_tpu.tune import measure, plans, select
+
+    shapes = []
+    for spec in args.shape or ["256x256"]:
+        m = re.fullmatch(r"(\d+)x(\d+)", spec)
+        if not m:
+            raise ValueError(f"--shape must look like HxW, got {spec!r}")
+        shapes.append((int(m.group(1)), int(m.group(2))))
+    conventions = (
+        ["c", "cuda"] if args.convention == "both" else [args.convention]
+    )
+    mesh = _parse_mesh_arg(args.mesh, bool(args.mesh))
+    store = plans.PlanStore(args.plan_cache)
+    results = []
+    families = [False]
+    if args.packed:
+        # The packed-state lane (--packed-io runs) consults its own
+        # family's fingerprints — tune it explicitly or it stays on the
+        # built-in ladder.
+        bad = [f"{h}x{w}" for h, w in shapes if w % 32 != 0]
+        if bad:
+            raise ValueError(
+                f"--packed needs widths divisible by 32 (the packed word), "
+                f"got {bad}"
+            )
+        families.append(True)
+    for height, width in shapes:
+        for convention in conventions:
+            for packed_state in families:
+                config = GameConfig(gen_limit=args.gen_limit,
+                                    convention=convention)
+                family = "packed" if packed_state else "byte"
+                print(f"tune engine: {height}x{width}/{convention}/{family} "
+                      f"(gen_limit={args.gen_limit}, iters={args.iters})",
+                      file=sys.stderr)
+                result = measure.run_engine_search(
+                    height, width, config, mesh, packed_state=packed_state,
+                    iters=args.iters, quick=args.quick,
+                )
+                results.append(result)
+                store.put(
+                    select.engine_fingerprint((height, width), config, mesh,
+                                              packed_state=packed_state),
+                    result.winner.to_dict(),
+                    measured=result.to_dict() if args.provenance else {
+                        "tuned_vs_default": round(result.speedup, 4),
+                        "default": result.default_label,
+                    },
+                )
+                print(f"  winner {result.winner.label()} at "
+                      f"{result.speedup:.3f}x the default ladder",
+                      file=sys.stderr)
+
+    if args.serve_board:
+        m = re.fullmatch(r"(\d+)x(\d+)", args.serve_board)
+        if not m:
+            raise ValueError(
+                f"--serve-board must look like HxW, got {args.serve_board!r}"
+            )
+        height, width = int(m.group(1)), int(m.group(2))
+        if mesh is not None and topology_for(mesh).distributed:
+            raise ValueError("--serve-board tunes the single-device serving "
+                             "lane; drop --mesh")
+        print(f"tune serve: {height}x{width} boards", file=sys.stderr)
+        result = measure.run_serve_search(
+            height, width, conventions[0],
+            gen_limit=min(args.gen_limit, 8), iters=args.iters,
+        )
+        results.append(result)
+        plan_dict = result.winner.to_dict()
+        plan_dict["warm"] = [
+            {"height": height, "width": width, "convention": convention}
+            for convention in conventions
+        ]
+        store.put(
+            select.serve_fingerprint(), plan_dict,
+            measured={"tuned_vs_default": round(result.speedup, 4)},
+        )
+        print(f"  winner {result.winner.label()} at "
+              f"{result.speedup:.3f}x the default geometry", file=sys.stderr)
+
+    report = measure.render_report(results)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            f.write(report)
+        print(f"report -> {args.report}", file=sys.stderr)
+    else:
+        print(report, file=sys.stderr)
+    print(f"plans -> {store.path}", file=sys.stderr)
+    # A same-process serve (tests, tune-then-serve scripts) must see the
+    # fresh plans: drop the consult caches.
+    select.reset()
+    from gol_tpu.serve import batcher
+
+    batcher._reset_plan()
     return 0
 
 
@@ -1062,6 +1231,11 @@ def build_parser() -> argparse.ArgumentParser:
         "list (see gol_tpu/resilience/faults.py; also honored from the "
         "GOL_FAULTS env var). Testing only.",
     )
+    run.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persist XLA/Mosaic compiles in DIR (JAX persistent "
+        "compilation cache): re-running a tuned shape skips recompilation",
+    )
     run.set_defaults(func=_run)
 
     shw = sub.add_parser("show", help="render a grid in the terminal (VT100, src/game.c:42-58)")
@@ -1104,7 +1278,71 @@ def build_parser() -> argparse.ArgumentParser:
     )
     srv.add_argument("--max-inflight", type=int, default=1,
                      help="concurrently running batches (worker threads)")
+    srv.add_argument(
+        "--warm-plans", action="store_true",
+        help="pre-compile the bucket programs of every serve shape recorded "
+        "by `gol tune` before accepting traffic",
+    )
+    srv.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persist XLA/Mosaic compiles in DIR (JAX persistent "
+        "compilation cache): restarted servers skip recompilation",
+    )
     srv.set_defaults(func=_serve)
+
+    tun = sub.add_parser(
+        "tune",
+        help="offline measured search: pick kernel/depth/block/bucket plans "
+        "and persist them to the plan cache (gol_tpu/tune/)",
+    )
+    tun.add_argument(
+        "--shape", action="append", metavar="HxW",
+        help="engine grid shape(s) to tune (repeatable; default 256x256)",
+    )
+    tun.add_argument(
+        "--convention", choices=("c", "cuda", "both"), default="both",
+        help="loop-accounting convention(s) to tune (default: both)",
+    )
+    tun.add_argument("--mesh", default=None,
+                     help="tune the RxC-mesh context instead of single-device")
+    tun.add_argument(
+        "--gen-limit", type=int, default=64,
+        help="generations per timed trial (default 64: long enough that the "
+        "loop dominates dispatch, short enough to search exhaustively)",
+    )
+    tun.add_argument("--iters", type=int, default=5,
+                     help="timed trials per candidate (trimmed median)")
+    tun.add_argument(
+        "--quick", action="store_true",
+        help="prune the depth/block axes to their extremes (smoke/CI)",
+    )
+    tun.add_argument(
+        "--packed", action="store_true",
+        help="also tune the packed-state family (the --packed-io lane "
+        "consults its own plans; widths must divide by 32)",
+    )
+    tun.add_argument(
+        "--serve-board", default=None, metavar="HxW",
+        help="also tune the serve batcher's bucket geometry on this request "
+        "shape (recorded for `gol serve --warm-plans`)",
+    )
+    tun.add_argument(
+        "--plan-cache", default=None, metavar="FILE",
+        help="plan cache file (default: $GOL_PLAN_CACHE or "
+        "~/.cache/gol_tpu/plans.json)",
+    )
+    tun.add_argument("--report", default=None, metavar="FILE",
+                     help="write the human-readable report here")
+    tun.add_argument(
+        "--provenance", action="store_true",
+        help="store the full per-candidate measurement series in the plan "
+        "cache, not just the winner",
+    )
+    tun.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persist XLA/Mosaic compiles in DIR while searching",
+    )
+    tun.set_defaults(func=_tune)
 
     sbm = sub.add_parser(
         "submit", help="submit jobs to a running gol serve and fetch results"
@@ -1160,7 +1398,8 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in (
-        "run", "generate", "show", "serve", "submit", "batch", "-h", "--help"
+        "run", "generate", "show", "serve", "submit", "batch", "tune",
+        "-h", "--help"
     ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)

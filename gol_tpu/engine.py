@@ -32,7 +32,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.config import Convention, DEFAULT_CONFIG, GameConfig
 from gol_tpu.resilience.retry import RetryPolicy
-from gol_tpu.ops import Kernel, fallback_chain, resolve_kernel
+from gol_tpu.ops import (
+    Kernel,
+    fallback_chain,
+    get_kernel,
+    resolve_kernel,
+    with_temporal_depth,
+)
 from gol_tpu.parallel import collectives
 from gol_tpu.parallel.mesh import (
     Topology,
@@ -122,11 +128,14 @@ def _similarity_vote(fire, cur, new, similar_local, topology: Topology):
 # 16384^2, ~35% over the raw kernel); running K generations per iteration
 # amortizes that sync — and, on a mesh, turns K per-generation Allreduce votes
 # (the reference's loop-condition cost, src/game_mpi_collective.c:331,76) into
-# one K-vector psum per block.
+# one K-vector psum per block. This is the *default*: the blocked loops take
+# the block size as a parameter, so a measured plan (gol_tpu/tune) or an A/B
+# harness (tools/measure.py block) can vary it per runner without mutating
+# this module.
 _TERMINATION_BLOCK = 16
 
 
-def _block_generations(start, t, config, topology, kernel):
+def _block_generations(start, t, config, topology, kernel, block):
     """Run ``t`` generations from ``start``, voting flags once for the block.
 
     The shared machinery of both conventions' blocked loops: temporally-
@@ -141,7 +150,7 @@ def _block_generations(start, t, config, topology, kernel):
     carries keep one dtype). ``s_all`` is None when the similarity check is
     disabled (the vote is dropped entirely).
     """
-    zeros = jnp.zeros((_TERMINATION_BLOCK,), jnp.int32)
+    zeros = jnp.zeros((block,), jnp.int32)
 
     def single_gen(slot_base):
         # One generation, flags recorded at slot_base + i.
@@ -197,7 +206,7 @@ def _replay_similarity(counter, freq, s_all, i, check: bool):
     return fire & s_all[i], jnp.where(fire, 0, counter + 1)
 
 
-def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound):
+def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound, block):
     """Blocked C-convention loop: K generations per flag sync, bit-exact.
 
     Exactness argument: the C loop's two early exits are *fixed points* of the
@@ -211,7 +220,7 @@ def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound):
     never crosses ``bound``: the inner trip count is clamped to the
     generations remaining.
     """
-    K = _TERMINATION_BLOCK
+    K = block
     freq = jnp.int32(config.similarity_frequency)
 
     def cond(state):
@@ -221,7 +230,7 @@ def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound):
     def body(state):
         cur, gen, counter, alive, similar = state
         t = jnp.minimum(jnp.int32(K), bound - gen + 1)
-        cur, a_all, s_all = _block_generations(cur, t, config, topology, kernel)
+        cur, a_all, s_all = _block_generations(cur, t, config, topology, kernel, K)
 
         def replay(i, c):
             gen, counter, alive, similar, stopped = c
@@ -250,7 +259,8 @@ def _simulate_c_block(grid, config, topology, kernel, gen0, counter0, bound):
     return jax.lax.while_loop(cond, body, state0)
 
 
-def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel, resume=None):
+def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel,
+                resume=None, block: int | None = None):
     """C-variant loop (src/game.c:177-196, src/game_mpi_collective.c:331-365).
 
     Emptiness is checked at the top of every generation on the current grid;
@@ -273,7 +283,8 @@ def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel, re
 
     if kernel.fused is not None:
         final, gen, counter, alive, similar = _simulate_c_block(
-            grid, config, topology, kernel, gen0, counter0, bound
+            grid, config, topology, kernel, gen0, counter0, bound,
+            block or _TERMINATION_BLOCK,
         )
         stopped = jnp.logical_not(alive) | similar | (gen > limit)
         return final, gen, counter, stopped
@@ -304,7 +315,8 @@ def _simulate_c(grid, config: GameConfig, topology: Topology, kernel: Kernel, re
     return final, gen, counter, stopped
 
 
-def _simulate_cuda_block(grid, config, topology, kernel, gen0, counter0, bound):
+def _simulate_cuda_block(grid, config, topology, kernel, gen0, counter0, bound,
+                         block):
     """Blocked CUDA-convention loop: K generations per flag sync, bit-exact.
 
     The CUDA loop's break-before-swap (src/game_cuda.cu:250,266) keeps the
@@ -319,7 +331,7 @@ def _simulate_cuda_block(grid, config, topology, kernel, gen0, counter0, bound):
     per-block lax.cond measured ~28% on the whole loop; hoisted it is free).
     Counts replay exactly like the C block.
     """
-    K = _TERMINATION_BLOCK
+    K = block
     freq = jnp.int32(config.similarity_frequency)
 
     def cond(state):
@@ -329,7 +341,7 @@ def _simulate_cuda_block(grid, config, topology, kernel, gen0, counter0, bound):
     def body(state):
         start, _, _, gen, counter, _, _ = state
         t = jnp.minimum(jnp.int32(K), bound - gen)
-        cur, a_all, s_all = _block_generations(start, t, config, topology, kernel)
+        cur, a_all, s_all = _block_generations(start, t, config, topology, kernel, K)
 
         # Scalar replay: flag entry i is (alive, similar) of the *new* grid
         # of CUDA iteration i — exactly what its per-generation checks read
@@ -378,7 +390,8 @@ def _simulate_cuda_block(grid, config, topology, kernel, gen0, counter0, bound):
     return final, gen, counter, stopped
 
 
-def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel, resume=None):
+def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel,
+                   resume=None, block: int | None = None):
     """CUDA-variant loop (src/game_cuda.cu:222-276).
 
     0-based exclusive bound; no emptiness test before the first evolve; the
@@ -397,7 +410,8 @@ def _simulate_cuda(grid, config: GameConfig, topology: Topology, kernel: Kernel,
 
     if kernel.fused is not None:
         final, gen, counter, stop = _simulate_cuda_block(
-            grid, config, topology, kernel, gen0, counter0, bound
+            grid, config, topology, kernel, gen0, counter0, bound,
+            block or _TERMINATION_BLOCK,
         )
         return final, gen, counter, stop | (gen >= limit)
 
@@ -626,6 +640,36 @@ def compile_runner(runner, *args):
     return runner.lower(*args).compile()
 
 
+def _apply_plan(tuned, kernel_obj, local_h, local_w, topology, packed_state):
+    """Resolve a measured plan (gol_tpu/tune) against this build's shape.
+
+    Returns ``(tuned, kernel_obj)`` — the plan dropped (with a loud warning)
+    when its kernel cannot serve the shape/lane, the kernel swapped to the
+    planned one otherwise. Depth/block application happens at the call
+    sites; this only settles *which* kernel the ladder starts from.
+    """
+    if tuned is None or not tuned.kernel or tuned.kernel == kernel_obj.name:
+        return tuned, kernel_obj
+    if packed_state and tuned.kernel not in ("packed", "packed-jnp"):
+        logger.warning(
+            "tuned plan names kernel %r, which cannot carry packed word "
+            "state; ignoring the plan", tuned.kernel,
+        )
+        return None, kernel_obj
+    try:
+        planned = get_kernel(tuned.kernel)
+    except ValueError:
+        planned = None
+    if planned is None or not planned.supports(local_h, local_w, topology):
+        logger.warning(
+            "tuned plan names kernel %r, which does not support a %dx%d "
+            "shard on a %dx%d topology; ignoring the plan",
+            tuned.kernel, local_h, local_w, *topology.shape,
+        )
+        return None, kernel_obj
+    return tuned, planned
+
+
 def _build_runner(
     shape: tuple[int, int],
     config: GameConfig,
@@ -634,6 +678,7 @@ def _build_runner(
     *,
     segmented: bool,
     packed_state: bool,
+    plan=None,
 ):
     """Shared scaffold of the four runner factories: topology/kernel
     validation, the simulate wrapper, and the shard_map lowering.
@@ -647,11 +692,27 @@ def _build_runner(
     ladder (compile failures demote instead of crashing); an explicitly
     named unpacked kernel stays strict — the caller asked for that kernel
     and a silent demotion would mislabel benchmark numbers.
+
+    ``plan`` is a measured execution plan (``gol_tpu.tune.space.EnginePlan``)
+    naming the kernel flavor / temporal depth / termination block / Pallas
+    band target to build. The auto-selected lanes (kernel='auto' and the
+    packed-state lane) consult the persistent plan cache when no plan is
+    passed; an explicitly named unpacked kernel never consults — the caller
+    asked for that kernel by name. With no cached plan the consult returns
+    None and this builds exactly the pre-tune ladder (test-pinned).
     """
     topology = topology_for(mesh)
     local_h, local_w = validate_grid(shape[0], shape[1], topology)
+    tuned = plan
+    if tuned is None and (kernel == "auto" or packed_state):
+        from gol_tpu.tune import select
+
+        tuned = select.engine_plan(shape, config, mesh,
+                                   packed_state=packed_state)
     kernel_obj = resolve_kernel("packed" if packed_state else kernel,
                                 local_h, local_w, topology)
+    tuned, kernel_obj = _apply_plan(tuned, kernel_obj, local_h, local_w,
+                                    topology, packed_state)
     if not kernel_obj.supports(local_h, local_w, topology):
         hint = (
             "packed state has no fallback — use the unpacked lane"
@@ -662,6 +723,26 @@ def _build_runner(
             f"kernel {kernel_obj.name!r} does not support a {local_h}x{local_w} "
             f"local shard on a {topology.shape[0]}x{topology.shape[1]} "
             f"topology; {hint}"
+        )
+    block = None
+    if tuned is not None:
+        if tuned.termination_block:
+            block = tuned.termination_block
+        if tuned.temporal_depth:
+            try:
+                kernel_obj = with_temporal_depth(kernel_obj, tuned.temporal_depth)
+            except ValueError as err:
+                logger.warning("tuned plan temporal depth dropped: %s", err)
+    if kernel_obj.name in ("packed", "packed-jnp", "pallas"):
+        # Unconditional (None clears): the override is process-global and
+        # read at trace time, so a plan-less build after a planned one must
+        # restore the width-aware default — a stale 2MB target on a shape
+        # the default deliberately caps at 1MB reproduces the documented
+        # Mosaic compile failure.
+        from gol_tpu.ops import stencil_packed
+
+        stencil_packed.set_band_target_override(
+            tuned.band_bytes if tuned is not None else None
         )
     simulate = _SIMULATORS[config.convention]
     report = _REPORT[config.convention]
@@ -684,7 +765,8 @@ def _build_runner(
                 if encode is not None:
                     g = encode(g)
                 final, gen, counter, stopped = simulate(
-                    g, config, topology, kobj, resume=(gen0, counter0, seg_end)
+                    g, config, topology, kobj,
+                    resume=(gen0, counter0, seg_end), block=block,
                 )
                 if decode is not None:
                     final = decode(final)
@@ -697,7 +779,7 @@ def _build_runner(
             def local_fn(g):
                 if encode is not None:
                     g = encode(g)
-                final, gen, _, _ = simulate(g, config, topology, kobj)
+                final, gen, _, _ = simulate(g, config, topology, kobj, block=block)
                 if decode is not None:
                     final = decode(final)
                 return final, report(gen)

@@ -18,6 +18,7 @@ import dataclasses
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 
 from gol_tpu.ops import stencil_lax
 from gol_tpu.parallel import halo
@@ -108,6 +109,61 @@ def _registry() -> dict[str, Kernel]:
     except ImportError:  # pragma: no cover - pallas unavailable on some backends
         pass
     return kernels
+
+
+def with_temporal_depth(kernel: Kernel, depth: int) -> Kernel:
+    """A depth-``T`` temporally-grouped variant of ``kernel``.
+
+    The engine's blocked loops consume ``fused_multi`` at whatever
+    ``multi_gens`` the kernel declares, and the scalar replay is oblivious
+    to the grouping (engine._block_generations), so *any* depth is bit-exact
+    with the per-generation loop — depth is purely a performance knob, which
+    makes it a tunable axis (gol_tpu/tune/space.py) rather than a constant:
+
+    - ``depth == kernel.multi_gens`` with a native ``fused_multi`` returns
+      the kernel unchanged (the deep-halo Pallas pass at its built-in T);
+    - ``depth == 1`` strips ``fused_multi``: one fused pass per generation,
+      flags recorded per-step (the pre-temporal-blocking form);
+    - other depths compose ``depth`` fused passes into one ``fused_multi``
+      call via a fori_loop, amortizing the per-call flag-vector plumbing
+      without requiring a kernel rebuild — valid wherever the per-step
+      kernel runs (``supports_multi`` becomes the per-step ``supports``).
+
+    Kernels without a fused pass (byte lax) only admit depth 1.
+    """
+    if depth < 1:
+        raise ValueError(f"temporal depth must be >= 1, got {depth}")
+    if depth == kernel.multi_gens and kernel.fused_multi is not None:
+        return kernel
+    if depth == 1:
+        if kernel.fused_multi is None:
+            return kernel
+        return dataclasses.replace(
+            kernel, fused_multi=None, multi_gens=1,
+            supports_multi=lambda height, width, topology: False,
+        )
+    if kernel.fused is None:
+        raise ValueError(
+            f"kernel {kernel.name!r} has no fused pass; temporal depth "
+            f"{depth} needs one (only depth 1 is valid)"
+        )
+    fused = kernel.fused
+
+    def fused_multi(cur, topology):
+        def sub(i, carry):
+            cur, a_vec, s_vec = carry
+            new, alive, similar = fused(cur, topology)
+            a_vec = a_vec.at[i].set(alive.astype(jnp.int32))
+            s_vec = s_vec.at[i].set(similar.astype(jnp.int32))
+            return new, a_vec, s_vec
+
+        zeros = jnp.zeros((depth,), jnp.int32)
+        return jax.lax.fori_loop(0, depth, sub, (cur, zeros, zeros))
+
+    return dataclasses.replace(
+        kernel, fused_multi=fused_multi, multi_gens=depth,
+        supports_multi=kernel.supports,
+    )
 
 
 def get_kernel(name: str) -> Kernel:

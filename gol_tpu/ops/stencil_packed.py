@@ -54,6 +54,22 @@ _MAX_WORDS = 32 << 10
 # after its live set shrank.
 _BAND_BYTES = 2 << 20
 
+# Measured-plan band target (gol_tpu/tune): when set, replaces the
+# width-aware default target in _pick_band/_bandt_target. Read at TRACE
+# time, so it must be set before the runner's first call compiles
+# (engine._build_runner applies it when a plan names one; per-process — two
+# live plans wanting different targets would need per-kernel plumbing this
+# deliberately avoids). The temporal kernels still clamp the override
+# through their scoped-VMEM budget, so a stale plan can shrink a band but
+# never Mosaic-OOM one.
+_BAND_TARGET_OVERRIDE: int | None = None
+
+
+def set_band_target_override(target_bytes: int | None) -> None:
+    global _BAND_TARGET_OVERRIDE
+    _BAND_TARGET_OVERRIDE = target_bytes
+
+
 # Re-exported for the kernel registry: the engine packs/unpacks at the loop
 # boundary through these.
 encode = packed_math.encode
@@ -105,8 +121,10 @@ def _pick_band(height: int, words: int, target_bytes: int | None = None) -> int:
         # Width-aware default: the kernel's live set scales with the band, so
         # 64KB+ rows (16K+ words) keep the 1MB target whose band sizes were
         # compile-validated up to the _MAX_WORDS cap; 2MB 16-row bands at
-        # 32768 words fail to compile.
-        target_bytes = _BAND_BYTES if row_bytes < (64 << 10) else (1 << 20)
+        # 32768 words fail to compile. A measured plan's override wins.
+        target_bytes = _BAND_TARGET_OVERRIDE or (
+            _BAND_BYTES if row_bytes < (64 << 10) else (1 << 20)
+        )
     target = max(_SUBLANES, min(height, target_bytes // row_bytes))
     for band in range(target, _SUBLANES - 1, -1):
         if height % band == 0 and band % _SUBLANES == 0:
@@ -271,7 +289,12 @@ def _bandt_target(height: int, nwords: int) -> int:
     near-cap rows shrink the target before the cap, instead of jumping from
     the 2MB target straight to a Mosaic OOM at the _MAX_WORDS_T edge."""
     padded_row = max(-(-nwords // 128) * 128, 128) * 4
-    for target in (_BANDT_BYTES, 3 << 19, 1 << 20):
+    targets = (_BANDT_BYTES, 3 << 19, 1 << 20)
+    if _BAND_TARGET_OVERRIDE:
+        # Plan override first, but still budget-gated below — falls through
+        # to the built-in ladder when it would blow scoped VMEM.
+        targets = (_BAND_TARGET_OVERRIDE, *targets)
+    for target in targets:
         band = _pick_band(height, nwords, target)
         if (band + 2 * TEMPORAL_GENS) * padded_row <= _BANDT_EXT_BUDGET:
             return target

@@ -33,22 +33,50 @@ from gol_tpu.serve.jobs import Job, JobResult
 logger = logging.getLogger(__name__)
 
 # Board extents round up to multiples of this (also the packed-word width, so
-# every exact-fit bucket width packs).
+# every exact-fit bucket width packs). DEFAULT: a measured plan
+# (gol_tpu/tune, written by `gol tune`) overrides the quantum and the ladder
+# below via the per-process consult in _plan(); with no plan cached the
+# consult returns exactly these values, byte-identically (test-pinned).
 PAD_QUANTUM = 32
 
 # The batch-size ladder: request counts round up to the next rung so the
-# compiled-program space stays small. The last rung is the hard batch cap.
+# compiled-program space stays small. The last rung is the hard batch cap —
+# an invariant plans cannot change (space.valid_serve_plan pins every
+# ladder's top rung to MAX_BATCH, so scheduler/server admission bounds hold
+# under any plan).
 BATCH_SIZES = (1, 2, 4, 8, 16, 32, 64)
 MAX_BATCH = BATCH_SIZES[-1]
 
-
-def pad_dim(n: int) -> int:
-    """Round a board extent up to the bucket quantum."""
-    return max(PAD_QUANTUM, -(-n // PAD_QUANTUM) * PAD_QUANTUM)
+_PLAN = None  # resolved once per process; tests reset via _reset_plan()
 
 
-def pad_batch(n: int) -> int:
-    """Round a job count (1..MAX_BATCH) up the BATCH_SIZES ladder.
+def _plan():
+    global _PLAN
+    if _PLAN is None:
+        from gol_tpu.tune import select
+
+        _PLAN = select.serve_plan(MAX_BATCH)
+    return _PLAN
+
+
+def _reset_plan() -> None:
+    """Forget the consulted plan (tests, and in-process tune-then-serve)."""
+    global _PLAN
+    _PLAN = None
+
+
+def pad_dim(n: int, plan=None) -> int:
+    """Round a board extent up to the bucket quantum.
+
+    ``plan`` (a tune ServePlan) overrides the consulted geometry — the
+    tuner's search measures THROUGH these helpers, so the geometry it times
+    is by construction the geometry the server later runs."""
+    quantum = (plan or _plan()).pad_quantum
+    return max(quantum, -(-n // quantum) * quantum)
+
+
+def pad_batch(n: int, plan=None) -> int:
+    """Round a job count (1..MAX_BATCH) up the plan's batch-size ladder.
 
     Always returns a rung >= n — the padded size the compiled program
     actually runs, which is also the denominator of the occupancy metric
@@ -56,7 +84,8 @@ def pad_batch(n: int) -> int:
     """
     if not 1 <= n <= MAX_BATCH:
         raise ValueError(f"batch count must be in [1, {MAX_BATCH}], got {n}")
-    return BATCH_SIZES[bisect.bisect_left(BATCH_SIZES, n)]
+    ladder = (plan or _plan()).batch_ladder
+    return ladder[bisect.bisect_left(ladder, n)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,12 +160,35 @@ def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
 
 
 def warm(key: BucketKey, batch: int = MAX_BATCH) -> None:
-    """Pre-compile a bucket's program (optional server warmup path)."""
-    engine.make_batch_runner(
+    """Pre-compile a bucket's program (optional server warmup path).
+
+    ``make_batch_runner`` returns a *lazy* jitted callable — tracing and
+    compilation happen at the first call, so building it alone warms
+    nothing. This dispatches the runner once on inert operands (all-zero
+    boards with generation limit 0 never enter the loop in either
+    convention), which pays the trace+compile now and executes in
+    microseconds; the scalar readback blocks until the program is live.
+    """
+    import jax.numpy as jnp
+
+    total = pad_batch(min(batch, MAX_BATCH))
+    runner = engine.make_batch_runner(
         (key.height, key.width),
-        pad_batch(min(batch, MAX_BATCH)),
+        total,
         key.convention,
         key.check_similarity,
         key.similarity_frequency,
         key.kernel,
     )
+    if key.kernel == "packed":
+        boards = np.zeros((total, key.height, key.width // 32), np.uint32)
+    else:
+        boards = np.zeros((total, key.height, key.width), np.uint8)
+    # Extents of 1 (not 0): the masked kernel wraps indices mod each
+    # board's extent, and a zero extent would divide by zero.
+    ones = np.ones((total,), np.int32)
+    _, gens, _ = runner(
+        jnp.asarray(boards), jnp.asarray(ones), jnp.asarray(ones),
+        jnp.asarray(np.zeros((total,), np.int32)),
+    )
+    int(gens[0])

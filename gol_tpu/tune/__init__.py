@@ -1,0 +1,26 @@
+"""Autotuning + persistent plan cache: measured kernel/layout selection.
+
+The reference tunes by recompilation: BLOCK_SIZE, THREADS, GEN_LIMIT are
+compile-time ``#define``s (src/game_cuda.cu:4, src/game_openmp.c:11), so
+"try a different configuration" means "edit, rebuild, rerun". This package
+promotes those decisions — and the ones this codebase accreted as
+hard-coded ladders (kernel flavor, deep-halo temporal depth, termination
+block size, Pallas band target, the serve batcher's padding quantum and
+batch-size ladder) — to *measured* choices, made once offline and reused:
+
+- ``space``   — the declarative search space, validity-filtered per
+  (shape, convention, mesh, device kind);
+- ``measure`` — timed trials (``perf_counter`` only, warmup + outlier-
+  trimmed medians) behind a byte-exact correctness gate;
+- ``plans``   — the persistent JSON plan cache: stable fingerprints,
+  atomic writes, stale-key invalidation, bundled defaults;
+- ``select``  — runtime consult: the engine and the serve batcher ask here
+  instead of their inlined ladders (bit-identical behavior when no plan
+  exists).
+
+Import layering: ``plans`` is stdlib-only (jax is touched lazily, for the
+version/device fingerprint); ``select`` adds ``space``; ``measure`` pulls
+the engine and is imported only by the offline drivers (``gol tune``,
+``bench.py --suite tune``, tools/tune_smoke.py). Nothing here may read the
+wall clock — ``time.perf_counter`` only (enforced by tests/test_lint.py).
+"""

@@ -1,0 +1,409 @@
+"""Timed trials behind a byte-exact correctness gate.
+
+Measurement discipline (the hard-won protocol of tools/measure.py, applied
+in-process):
+
+- ``time.perf_counter`` ONLY — the wall clock steps under NTP and is banned
+  from this package (tests/test_lint.py);
+- every candidate is warmed (compile + first dispatch) before any sample;
+- per-candidate samples are reduced by an **outlier-trimmed median** (drop
+  the extremes, median the rest) — robust to the one-off stalls shared
+  machines inject;
+- completion is forced by a scalar readback (``int(...)``), the only
+  reliable barrier over remote-attach tunnels;
+- and NO timing counts until the candidate passes the **correctness gate**:
+  its final grid and generation count are byte-compared against the
+  reference output (the default-ladder solo engine — itself oracle-checked
+  on small grids). A mismatching candidate is excluded from selection and
+  reported loudly; it can never win a race it cheated.
+
+The searches are exhaustive over ``space`` candidates; winners are returned
+as plans ready for ``plans.PlanStore.put``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+import numpy as np
+
+from gol_tpu.config import GameConfig
+from gol_tpu.tune import space
+
+logger = logging.getLogger(__name__)
+
+# A grid this small is cheap to oracle-check, so the reference output itself
+# is verified against ground truth before any candidate is gated on it.
+_ORACLE_GATE_CELLS = 1 << 16
+
+
+def trimmed_median(samples) -> float:
+    """Median after dropping the min and max (when there are enough samples
+    to spare them): one cold-cache or preempted run cannot shift the stat."""
+    ordered = sorted(samples)
+    if not ordered:
+        raise ValueError("no samples")
+    if len(ordered) >= 4:
+        ordered = ordered[1:-1]
+    mid = len(ordered) // 2
+    if len(ordered) % 2:
+        return ordered[mid]
+    return (ordered[mid - 1] + ordered[mid]) / 2
+
+
+def timed_samples(fn, *, warmup: int = 1, iters: int = 5) -> list[float]:
+    """Run ``fn`` ``warmup`` untimed + ``iters`` timed times."""
+    if iters < 1:
+        raise ValueError(f"iters must be >= 1, got {iters}")
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return samples
+
+
+@dataclasses.dataclass
+class Trial:
+    label: str
+    plan: object  # EnginePlan | ServePlan
+    median_s: float | None  # None when the gate failed (never timed)
+    samples: list[float]
+    gate: str  # "ok" | "mismatch" | "error: <type>"
+
+    def to_dict(self) -> dict:
+        return {
+            "label": self.label,
+            "plan": self.plan.to_dict(),
+            "median_s": self.median_s,
+            "samples": [round(s, 6) for s in self.samples],
+            "gate": self.gate,
+        }
+
+
+@dataclasses.dataclass
+class SearchResult:
+    kind: str  # "engine" | "serve"
+    context: dict  # human-readable search context (shape, convention, ...)
+    trials: list[Trial]
+    default_label: str
+    winner: object  # the winning plan (EnginePlan | ServePlan)
+
+    @property
+    def winner_trial(self) -> Trial:
+        label = self.winner.label()
+        return next(t for t in self.trials if t.label == label)
+
+    @property
+    def default_trial(self) -> Trial:
+        return next(t for t in self.trials if t.label == self.default_label)
+
+    @property
+    def speedup(self) -> float:
+        """default median / winner median: >= 1.0 by construction (the
+        default is in the candidate set and the winner is the argmin)."""
+        return self.default_trial.median_s / self.winner_trial.median_s
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "context": self.context,
+            "default": self.default_label,
+            "winner": self.winner.label(),
+            "winner_plan": self.winner.to_dict(),
+            "tuned_vs_default": round(self.speedup, 4),
+            "gates_all_ok": all(t.gate == "ok" for t in self.trials),
+            "trials": [t.to_dict() for t in self.trials],
+        }
+
+
+def _pick_winner(trials: list[Trial], default_label: str):
+    ok = [t for t in trials if t.gate == "ok"]
+    if not ok:
+        raise RuntimeError("no candidate passed the correctness gate")
+    bad = [t.label for t in trials if t.gate != "ok"]
+    if bad:
+        logger.warning("correctness gate FAILED for candidate(s) %s — "
+                       "excluded from selection", bad)
+    winner = min(ok, key=lambda t: t.median_s)
+    # Within measurement noise, keep the default: a plan should only exist
+    # when it buys something real (2% here is well inside the trimmed-median
+    # scatter of shared machines).
+    default = next((t for t in ok if t.label == default_label), None)
+    if default is not None and default is not winner:
+        if default.median_s / winner.median_s < 1.02:
+            winner = default
+    return winner
+
+
+def _pack_words(grid: np.ndarray) -> np.ndarray:
+    packed = np.packbits(grid, axis=1, bitorder="little")
+    return np.ascontiguousarray(packed).view(np.uint32)
+
+
+def run_engine_search(
+    height: int,
+    width: int,
+    config: GameConfig,
+    mesh=None,
+    *,
+    packed_state: bool = False,
+    seed: int = 42,
+    warmup: int = 1,
+    iters: int = 5,
+    quick: bool = False,
+) -> SearchResult:
+    """Exhaustively measure the engine candidates for one shape/context.
+
+    The reference output is the DEFAULT candidate's run (the hard-coded
+    ladder's choice, built with an explicit empty-plan bypass so an existing
+    plan cache cannot shift the baseline), itself byte-checked against the
+    NumPy oracle when the grid is small enough to afford it.
+    """
+    import jax
+
+    from gol_tpu import engine
+
+    ctx = space.context_for((height, width), config, mesh, packed_state)
+    candidates = space.engine_candidates(ctx, quick=quick)
+    default_label = candidates[0].label()
+
+    rng = np.random.default_rng(seed)
+    grid = rng.integers(0, 2, size=(height, width), dtype=np.uint8)
+    if packed_state:
+        host_state = _pack_words(grid)
+    else:
+        host_state = grid
+    if mesh is None:
+        operand = jax.device_put(host_state)
+    else:
+        from gol_tpu.parallel.mesh import grid_sharding
+
+        operand = jax.device_put(host_state, grid_sharding(mesh))
+
+    reference: tuple[np.ndarray, int] | None = None
+    trials: list[Trial] = []
+    try:
+        for cand in candidates:
+            try:
+                runner = engine._build_runner(
+                    (height, width), config, mesh, cand.kernel or "auto",
+                    segmented=False, packed_state=packed_state, plan=cand,
+                )
+
+                def run_once(runner=runner):
+                    final, gen = runner(operand)
+                    return np.asarray(jax.device_get(final)), int(gen)
+
+                out_grid, out_gen = run_once()  # compile + warm + gate material
+            except Exception as err:  # noqa: BLE001 - candidate isolation
+                # Candidates are built with explicit kernel names (no
+                # demotion ladder) and band targets deliberately probe
+                # compile limits — one Mosaic refusal must cost one
+                # candidate, not the whole search. The default candidate
+                # stays fatal: with no reference there is nothing to tune.
+                if reference is None:
+                    raise
+                logger.warning(
+                    "candidate %s failed to build/run (%s: %s); excluded",
+                    cand.label(), type(err).__name__, err,
+                )
+                trials.append(Trial(cand.label(), cand, None, [],
+                                    f"error: {type(err).__name__}"))
+                continue
+            if reference is None:
+                # First candidate IS the default: it becomes the reference,
+                # after an oracle check where affordable.
+                if not packed_state and height * width <= _ORACLE_GATE_CELLS:
+                    from gol_tpu import oracle
+
+                    expect = oracle.run(grid, config)
+                    if not (np.array_equal(out_grid, expect.grid)
+                            and out_gen == expect.generations):
+                        raise RuntimeError(
+                            f"default candidate {cand.label()} disagrees "
+                            f"with the oracle on {height}x{width}/"
+                            f"{config.convention} — refusing to tune against "
+                            "a wrong reference"
+                        )
+                reference = (out_grid, out_gen)
+            ok = (
+                np.array_equal(out_grid, reference[0])
+                and out_gen == reference[1]
+            )
+            if not ok:
+                trials.append(Trial(cand.label(), cand, None, [], "mismatch"))
+                continue
+
+            samples = timed_samples(
+                lambda: int(runner(operand)[1]), warmup=max(0, warmup - 1),
+                iters=iters,
+            )
+            trials.append(
+                Trial(cand.label(), cand, trimmed_median(samples), samples, "ok")
+            )
+            logger.info("  %-28s %8.3f ms", cand.label(),
+                        trials[-1].median_s * 1e3)
+    finally:
+        # A band-target candidate leaves its override armed at trace time;
+        # never leak it past the search.
+        from gol_tpu.ops import stencil_packed
+
+        stencil_packed.set_band_target_override(None)
+
+    winner = _pick_winner(trials, default_label)
+    return SearchResult(
+        kind="engine",
+        context={
+            "height": height,
+            "width": width,
+            "convention": config.convention,
+            "family": ctx.family,
+            "mesh": f"{ctx.mesh_shape[0]}x{ctx.mesh_shape[1]}",
+            "device_kind": ctx.device_kind,
+            "gen_limit": config.gen_limit,
+            "seed": seed,
+            "iters": iters,
+        },
+        trials=trials,
+        default_label=default_label,
+        winner=winner.plan,
+    )
+
+
+# Serving-shaped request-count mix: the sizes a flush under light-to-bursty
+# load actually dispatches (partial buckets, odd counts, one full batch).
+_SERVE_COUNTS = (1, 3, 5, 8, 13, 21)
+
+
+def run_serve_search(
+    board_height: int,
+    board_width: int,
+    convention: str = "c",
+    *,
+    gen_limit: int = 8,
+    nboards: int = 21,
+    seed: int = 42,
+    warmup: int = 1,
+    iters: int = 5,
+    max_batch: int = 64,
+) -> SearchResult:
+    """Measure the serve-bucket geometry candidates on one request shape.
+
+    Each candidate's bucket math is applied THROUGH the batcher's own
+    ``pad_dim``/``pad_batch`` (with the candidate as the plan override), so
+    the measured geometry is exactly what the server later runs, driving
+    ``engine.simulate_batch`` over a serving-shaped mix of request counts;
+    the gate byte-compares every board of every candidate against solo
+    engine runs.
+    """
+    from gol_tpu import engine
+    from gol_tpu.serve import batcher
+
+    candidates = space.serve_candidates(max_batch)
+    default_label = candidates[0].label()
+    config = GameConfig(gen_limit=gen_limit, convention=convention)
+
+    rng = np.random.default_rng(seed)
+    boards = [
+        rng.integers(0, 2, size=(board_height, board_width), dtype=np.uint8)
+        for _ in range(nboards)
+    ]
+    solo = [engine.simulate(b, config) for b in boards]
+    chunks = []
+    i = 0
+    for count in _SERVE_COUNTS:
+        count = min(count, nboards)
+        chunks.append([boards[(i + j) % nboards] for j in range(count)])
+        i += count
+
+    trials: list[Trial] = []
+    for cand in candidates:
+        ph = batcher.pad_dim(board_height, plan=cand)
+        pw = batcher.pad_dim(board_width, plan=cand)
+
+        def dispatch(cand=cand, ph=ph, pw=pw, gate=False):
+            for chunk in chunks:
+                results = engine.simulate_batch(
+                    chunk, config, padded_shape=(ph, pw),
+                    pad_batch_to=batcher.pad_batch(len(chunk), plan=cand),
+                )
+                if gate:
+                    for board, result in zip(chunk, results):
+                        idx = next(
+                            k for k, b in enumerate(boards) if b is board
+                        )
+                        if not (
+                            np.array_equal(result.grid, solo[idx].grid)
+                            and result.generations == solo[idx].generations
+                        ):
+                            return False
+            return True
+
+        if not dispatch(gate=True):  # compile + warm + gate in one pass
+            trials.append(Trial(cand.label(), cand, None, [], "mismatch"))
+            continue
+        samples = timed_samples(dispatch, warmup=max(0, warmup - 1),
+                                iters=iters)
+        trials.append(
+            Trial(cand.label(), cand, trimmed_median(samples), samples, "ok")
+        )
+        logger.info("  %-28s %8.3f ms", cand.label(),
+                    trials[-1].median_s * 1e3)
+
+    winner = _pick_winner(trials, default_label)
+    return SearchResult(
+        kind="serve",
+        context={
+            "board": f"{board_height}x{board_width}",
+            "convention": convention,
+            "gen_limit": gen_limit,
+            "counts": [len(c) for c in chunks],
+            "device_kind": space.context_for(
+                (board_height, board_width), config
+            ).device_kind,
+            "seed": seed,
+            "iters": iters,
+        },
+        trials=trials,
+        default_label=default_label,
+        winner=winner.plan,
+    )
+
+
+def render_report(results: list[SearchResult]) -> str:
+    """Human-readable tuning report (``gol tune`` prints/writes this)."""
+    lines = ["# gol tune report", ""]
+    for res in results:
+        ctx = ", ".join(f"{k}={v}" for k, v in res.context.items())
+        lines.append(f"## {res.kind}: {ctx}")
+        lines.append("")
+        lines.append("| candidate | median | vs default | gate |")
+        lines.append("|---|---|---|---|")
+        default_s = res.default_trial.median_s
+        for t in sorted(res.trials,
+                        key=lambda t: (t.median_s is None, t.median_s)):
+            if t.median_s is None:
+                lines.append(f"| {t.label} | — | — | {t.gate} |")
+                continue
+            marks = []
+            if t.label == res.winner.label():
+                marks.append("**winner**")
+            if t.label == res.default_label:
+                marks.append("default")
+            ratio = default_s / t.median_s
+            lines.append(
+                f"| {t.label} {' '.join(marks)} | {t.median_s * 1e3:.3f} ms "
+                f"| {ratio:.3f}x | {t.gate} |"
+            )
+        lines.append("")
+        lines.append(
+            f"winner: `{res.winner.label()}` at {res.speedup:.3f}x the "
+            "default ladder"
+        )
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,261 @@
+"""The declarative search space: every tunable the engine and batcher expose.
+
+A *plan* is a point in this space; a *candidate* is a plan the validity
+filter admits for a concrete (height, width, convention, mesh shape, device
+kind) context. The axes mirror the reference's compile-time configuration
+surface (BLOCK_SIZE/THREADS ``#define``s) plus the ladders this codebase
+hard-coded as it grew:
+
+- kernel flavor      — byte lax vs Pallas band vs bit-packed words (the
+                       ``ops`` registry names);
+- temporal depth     — generations fused per deep-halo/VMEM pass, in
+                       {1, 2, 4, 8} (``ops.with_temporal_depth``);
+- termination block  — generations per flag-sync of the blocked while loop
+                       (``engine._TERMINATION_BLOCK``'s measured override);
+- Pallas band target — VMEM bytes per band of the packed kernels (TPU only;
+                       ``stencil_packed.set_band_target_override``);
+- packed vs byte carried state — which runner *family* a plan describes
+                       (searched side by side; selection stays per-family
+                       because the CLI's I/O lane fixes the family);
+- serve padding quantum + batch-size ladder — the batcher's bucket geometry.
+
+Validity filtering happens HERE, once, instead of being scattered through
+the measurement loop: a candidate that comes out of ``engine_candidates``
+builds and runs on that context by construction (kernel ``supports`` gates,
+packing divisibility, depth needing a fused pass, band targets needing a
+TPU backend).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gol_tpu import ops
+from gol_tpu.parallel.mesh import MESH_TOPOLOGY_AXES, Topology
+
+# Axis domains. Kept small and explicit — the space is searched exhaustively
+# per shape, so every value here multiplies measurement time.
+TEMPORAL_DEPTHS = (1, 2, 4, 8)
+TERMINATION_BLOCKS = (8, 16, 32, 64)
+# VMEM band-byte targets for the compiled packed kernels (the values the
+# width-aware default in stencil_packed._pick_band chooses among).
+BAND_TARGETS = (1 << 20, 3 << 19, 2 << 20)
+# Serve batcher geometry: board extents round up to the quantum; request
+# counts round up the ladder. Every quantum is a multiple of 32 so exact-fit
+# buckets keep the bit-packed fast path; every ladder ends at the batcher's
+# hard cap so scheduler/server admission bounds stay invariant.
+PAD_QUANTA = (32, 64, 128)
+BATCH_LADDERS = (
+    (1, 2, 4, 8, 16, 32, 64),
+    (1, 4, 16, 64),
+    (1, 8, 64),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EnginePlan:
+    """A point in the solo-engine space; ``None`` fields mean "built-in".
+
+    Doubles as the runtime plan object ``engine._build_runner`` applies —
+    the search measures exactly what selection later builds.
+    """
+
+    kernel: str | None = None  # ops registry name; None = the auto ladder
+    temporal_depth: int | None = None  # generations per fused_multi pass
+    termination_block: int | None = None  # generations per flag sync
+    band_bytes: int | None = None  # Pallas band VMEM target (TPU only)
+
+    def label(self) -> str:
+        parts = [self.kernel or "auto"]
+        if self.temporal_depth:
+            parts.append(f"T{self.temporal_depth}")
+        if self.termination_block:
+            parts.append(f"K{self.termination_block}")
+        if self.band_bytes:
+            parts.append(f"band{self.band_bytes >> 10}K")
+        return "/".join(parts)
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "EnginePlan":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for key, value in data.items():
+            if key not in fields or value is None:
+                continue
+            kwargs[key] = str(value) if key == "kernel" else int(value)
+        return cls(**kwargs)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePlan:
+    """Serve-batcher geometry: one plan covers the whole fleet's buckets."""
+
+    pad_quantum: int = 32
+    batch_ladder: tuple[int, ...] = BATCH_LADDERS[0]
+
+    def label(self) -> str:
+        return f"q{self.pad_quantum}/ladder{'-'.join(map(str, self.batch_ladder))}"
+
+    def to_dict(self) -> dict:
+        return {
+            "pad_quantum": self.pad_quantum,
+            "batch_ladder": list(self.batch_ladder),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ServePlan":
+        return cls(
+            pad_quantum=int(data["pad_quantum"]),
+            batch_ladder=tuple(int(x) for x in data["batch_ladder"]),
+        )
+
+
+# The behavior the hard-coded ladders implement today: these plans are what
+# "no plan" means, and the bundled default_plans.json encodes them — so a
+# cold machine (or a torn cache file) gets exactly the pre-tune ladders.
+DEFAULT_SERVE_PLAN = ServePlan()
+
+
+def valid_serve_plan(plan: ServePlan, max_batch: int) -> bool:
+    """Admission gate for serve plans, shared by the candidate generator and
+    the runtime consult (a stale/hand-edited cache entry must not be able to
+    change the server's admission invariants)."""
+    ladder = plan.batch_ladder
+    return (
+        plan.pad_quantum >= 32
+        and plan.pad_quantum % 32 == 0
+        and len(ladder) >= 1
+        and ladder[0] == 1
+        and ladder[-1] == max_batch
+        and all(a < b for a, b in zip(ladder, ladder[1:]))
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneContext:
+    """Everything the validity filter (and the plan fingerprint) keys on."""
+
+    height: int
+    width: int
+    convention: str
+    packed_state: bool  # carried-state family: words vs uint8 grid
+    mesh_shape: tuple[int, int] = (1, 1)
+    device_kind: str = "cpu"
+
+    @property
+    def family(self) -> str:
+        return "packed" if self.packed_state else "byte"
+
+    @property
+    def topology(self) -> Topology:
+        if self.mesh_shape == (1, 1):
+            return Topology()
+        return Topology(shape=self.mesh_shape, axes=MESH_TOPOLOGY_AXES)
+
+    @property
+    def local_shape(self) -> tuple[int, int]:
+        return (self.height // self.mesh_shape[0],
+                self.width // self.mesh_shape[1])
+
+    @property
+    def on_tpu(self) -> bool:
+        return "tpu" in self.device_kind.lower()
+
+
+def context_for(shape, config, mesh=None, packed_state=False) -> TuneContext:
+    """Derive the tuning context of a concrete run (reads jax lazily)."""
+    import jax
+
+    mesh_shape = (1, 1)
+    if mesh is not None:
+        from gol_tpu.parallel.mesh import topology_for
+
+        mesh_shape = topology_for(mesh).shape
+    return TuneContext(
+        height=int(shape[0]),
+        width=int(shape[1]),
+        convention=config.convention,
+        packed_state=packed_state,
+        mesh_shape=mesh_shape,
+        device_kind=jax.devices()[0].device_kind,
+    )
+
+
+def default_engine_plan(ctx: TuneContext) -> EnginePlan:
+    """The plan the hard-coded ladder picks for this context today: the
+    search's baseline candidate, and the ratio denominator in reports."""
+    local_h, local_w = ctx.local_shape
+    kernel = (
+        "packed" if ctx.packed_state
+        else ops.resolve_kernel("auto", local_h, local_w, ctx.topology).name
+    )
+    kobj = ops.get_kernel(kernel)
+    depth = (
+        kobj.multi_gens
+        if kobj.fused_multi is not None
+        and kobj.supports_multi(local_h, local_w, ctx.topology)
+        else 1
+    )
+    return EnginePlan(kernel=kernel, temporal_depth=depth,
+                      termination_block=16)
+
+
+def engine_candidates(ctx: TuneContext, quick: bool = False) -> list[EnginePlan]:
+    """Every engine plan valid for ``ctx``, default candidate first.
+
+    Kernel flavors come from the ops registry filtered by their own
+    ``supports`` gates (the packed family only where the width packs, the
+    byte Pallas kernel only on TPU — off TPU it would run wholly in
+    interpret mode, a measurement of nothing). Depth needs a fused pass
+    (byte lax has none); band targets need the compiled Pallas path.
+
+    ``quick`` prunes the depth/block axes to their extremes — the smoke and
+    CI searches, where each candidate costs a compile.
+    """
+    local_h, local_w = ctx.local_shape
+    topo = ctx.topology
+    if ctx.packed_state:
+        kernel_names = ["packed", "packed-jnp"]
+    else:
+        kernel_names = ["packed", "packed-jnp", "lax"]
+        if ctx.on_tpu:
+            kernel_names.insert(2, "pallas")
+    all_depths = (1, TEMPORAL_DEPTHS[-1]) if quick else TEMPORAL_DEPTHS
+    all_blocks = (16, TERMINATION_BLOCKS[-1]) if quick else TERMINATION_BLOCKS
+    candidates = [default_engine_plan(ctx)]
+    for name in kernel_names:
+        try:
+            kobj = ops.get_kernel(name)
+        except ValueError:  # registry pruned (pallas unavailable)
+            continue
+        if not kobj.supports(local_h, local_w, topo):
+            continue
+        depths = all_depths if kobj.fused is not None else (1,)
+        bands = (
+            BAND_TARGETS if ctx.on_tpu and name in ("packed", "pallas")
+            else (None,)
+        )
+        for depth in depths:
+            blocks = all_blocks if kobj.fused is not None else (16,)
+            for block in blocks:
+                for band in bands:
+                    cand = EnginePlan(kernel=name, temporal_depth=depth,
+                                      termination_block=block, band_bytes=band)
+                    if cand not in candidates:
+                        candidates.append(cand)
+    return candidates
+
+
+def serve_candidates(max_batch: int = 64) -> list[ServePlan]:
+    """Every serve-geometry plan, default first."""
+    candidates = [DEFAULT_SERVE_PLAN]
+    for quantum in PAD_QUANTA:
+        for ladder in BATCH_LADDERS:
+            cand = ServePlan(pad_quantum=quantum, batch_ladder=ladder)
+            if valid_serve_plan(cand, max_batch) and cand not in candidates:
+                candidates.append(cand)
+    return candidates

@@ -36,6 +36,16 @@ if not os.environ.get("GOL_TPU_HW"):
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Isolate the suite from any real autotune plan cache on this machine: the
+# kernel-selection and batcher-geometry tests pin the DEFAULT ladders, and a
+# developer's ~/.cache/gol_tpu/plans.json would silently reroute them. Tests
+# that exercise plans point GOL_PLAN_CACHE at their own tmp files.
+import tempfile as _tempfile
+
+os.environ["GOL_PLAN_CACHE"] = os.path.join(
+    _tempfile.mkdtemp(prefix="gol_test_plans_"), "plans.json"
+)
+
 
 # ---------------------------------------------------------------------------
 # Hardware-lane evidence artifact: GOL_TPU_HW=1 runs record every hardware

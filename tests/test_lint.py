@@ -49,3 +49,16 @@ def test_no_wall_clock_in_serve_latency_paths():
         "wall-clock time.time() in gol_tpu/serve/ (use time.perf_counter() "
         f"for every latency/age path): {offenders}"
     )
+
+
+def test_no_wall_clock_in_tune():
+    """Same rule for gol_tpu/tune/, where the stakes are higher still: a
+    wall-clock step during a timed trial silently corrupts the *persisted*
+    plan — every later run on the machine then executes the wrong
+    configuration. Trial timing is ``time.perf_counter()`` only."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "tune", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/tune/ (use time.perf_counter() "
+            f"for every trial timing): {offenders}"
+        )

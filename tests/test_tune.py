@@ -1,0 +1,454 @@
+"""gol_tpu/tune: plan cache durability, fingerprint invalidation, selection,
+and — most load-bearing — the no-plan path staying byte-identical to the
+hard-coded ladders for both conventions."""
+
+import dataclasses
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import GameConfig
+from gol_tpu.ops import get_kernel, resolve_kernel, with_temporal_depth
+from gol_tpu.parallel.mesh import SINGLE_DEVICE
+from gol_tpu.serve import batcher
+from gol_tpu.tune import measure, plans, select, space
+
+
+@pytest.fixture
+def plan_cache(tmp_path, monkeypatch):
+    """A private, initially-absent plan cache; consult caches dropped on
+    entry and exit so no other test sees this one's plans."""
+    path = str(tmp_path / "plans.json")
+    monkeypatch.setenv(plans.ENV_CACHE_PATH, path)
+    select.reset()
+    batcher._reset_plan()
+    yield path
+    select.reset()
+    batcher._reset_plan()
+
+
+def _grid(h=48, w=64, seed=11):
+    return np.random.default_rng(seed).integers(0, 2, (h, w), dtype=np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Plan store: round-trip, crash tolerance, invalidation.
+# ---------------------------------------------------------------------------
+
+
+def test_store_round_trip(plan_cache):
+    store = plans.PlanStore(plan_cache)
+    fp = plans.fingerprint("engine", 48, 64, "c", "byte", (1, 1), "cpu")
+    plan = {"kernel": "packed-jnp", "termination_block": 64}
+    store.put(fp, plan, measured={"tuned_vs_default": 1.5})
+    assert store.get(fp) == plan
+    # A fresh store (fresh process) reads the same entry back.
+    assert plans.PlanStore(plan_cache).get(fp) == plan
+    # The commit left no staging litter.
+    from gol_tpu.resilience import STAGING_SUFFIX
+
+    leftovers = [f for f in os.listdir(os.path.dirname(plan_cache))
+                 if f.endswith(STAGING_SUFFIX)]
+    assert leftovers == []
+
+
+def test_store_missing_file_is_empty(plan_cache):
+    store = plans.PlanStore(plan_cache)
+    assert store.get("anything") is None
+    # Bundled defaults still resolve.
+    assert store.get_default("serve")["pad_quantum"] == 32
+    assert store.get_default("engine") == {}
+
+
+@pytest.mark.parametrize("body", [
+    "{\"schema\": 1, \"plans\": {\"k\": {\"pl",  # torn mid-write
+    "not json at all",
+    "{\"schema\": 1}",  # missing plans
+    "{\"plans\": [1, 2]}",  # wrong container type
+])
+def test_store_torn_file_falls_back_loudly(plan_cache, body, caplog):
+    with open(plan_cache, "w", encoding="utf-8") as f:
+        f.write(body)
+    store = plans.PlanStore(plan_cache)
+    with caplog.at_level(logging.WARNING, logger="gol_tpu.tune.plans"):
+        assert store.get("anything") is None
+    assert any("unreadable" in rec.message for rec in caplog.records)
+    # The runtime consult degrades to the built-in ladders, not an error.
+    select.reset()
+    assert select.serve_plan() == space.DEFAULT_SERVE_PLAN
+    # And put() recovers the file: a torn cache is replaced, not appended to.
+    store.put("fp", {"kernel": "lax"})
+    assert plans.PlanStore(plan_cache).get("fp") == {"kernel": "lax"}
+
+
+def test_fingerprint_jax_version_invalidates(plan_cache, monkeypatch):
+    store = plans.PlanStore(plan_cache)
+    config = GameConfig(gen_limit=30)
+    fp = select.engine_fingerprint((48, 64), config)
+    store.put(fp, {"kernel": "lax"})
+    select.reset()
+    assert select.engine_plan((48, 64), config).kernel == "lax"
+    # A different jax version produces a different fingerprint: clean miss.
+    monkeypatch.setattr(plans, "_jax_version", lambda: "999.0.0")
+    select.reset()
+    assert select.engine_plan((48, 64), config) is None
+
+
+def test_fingerprint_schema_invalidates(plan_cache, monkeypatch):
+    store = plans.PlanStore(plan_cache)
+    config = GameConfig(gen_limit=30)
+    fp = select.engine_fingerprint((48, 64), config)
+    store.put(fp, {"kernel": "lax"})
+    monkeypatch.setattr(plans, "SCHEMA_VERSION", 2)
+    select.reset()
+    assert select.engine_plan((48, 64), config) is None
+
+
+def test_put_prunes_stale_entries(plan_cache, monkeypatch):
+    store = plans.PlanStore(plan_cache)
+    store.put("old-key", {"kernel": "lax"})
+    monkeypatch.setattr(plans, "_jax_version", lambda: "999.0.0")
+    fresh = plans.PlanStore(plan_cache)
+    fresh.put("new-key", {"kernel": "packed"})
+    body = json.load(open(plan_cache, encoding="utf-8"))
+    # The stale-jax entry was swept on write; only the new one remains.
+    assert set(body["plans"]) == {"new-key"}
+
+
+# ---------------------------------------------------------------------------
+# The no-plan path: byte-identical to the hard-coded ladders.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("convention", ["c", "cuda"])
+def test_no_plan_engine_identical(plan_cache, convention):
+    """With an absent cache the consult returns None, the auto ladder picks
+    exactly what ops.resolve_kernel picks, and the output matches the
+    oracle — the pre-tune contract for both conventions."""
+    config = GameConfig(gen_limit=25, convention=convention)
+    assert select.engine_plan((48, 64), config) is None
+    grid = _grid()
+    runner = engine._build_runner((48, 64), config, None, "auto",
+                                  segmented=False, packed_state=False)
+    expected_name = resolve_kernel("auto", 48, 64, SINGLE_DEVICE).name
+    assert runner.kernel_name == expected_name
+    final, gen = runner(jax.device_put(grid))
+    expect = oracle.run(grid, config)
+    assert np.array_equal(np.asarray(final), expect.grid)
+    assert int(gen) == expect.generations
+
+
+def test_no_plan_batcher_constants(plan_cache):
+    """pad_dim/pad_batch under an absent cache are the original constants."""
+    assert batcher.pad_dim(1) == 32
+    assert batcher.pad_dim(33) == 64
+    assert [batcher.pad_batch(n) for n in (1, 2, 3, 5, 9, 17, 33, 64)] == \
+        [1, 2, 4, 8, 16, 32, 64, 64]
+
+
+# ---------------------------------------------------------------------------
+# Plan application.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("convention", ["c", "cuda"])
+def test_planned_kernel_applied_and_exact(plan_cache, convention):
+    config = GameConfig(gen_limit=27, convention=convention)
+    store = plans.PlanStore(plan_cache)
+    store.put(
+        select.engine_fingerprint((48, 64), config),
+        {"kernel": "packed-jnp", "temporal_depth": 2, "termination_block": 8},
+    )
+    select.reset()
+    plan = select.engine_plan((48, 64), config)
+    assert plan == space.EnginePlan(kernel="packed-jnp", temporal_depth=2,
+                                    termination_block=8)
+    runner = engine._build_runner((48, 64), config, None, "auto",
+                                  segmented=False, packed_state=False)
+    assert runner.kernel_name == "packed-jnp"
+    grid = _grid()
+    final, gen = runner(jax.device_put(grid))
+    expect = oracle.run(grid, config)
+    assert np.array_equal(np.asarray(final), expect.grid)
+    assert int(gen) == expect.generations
+
+
+def test_unsupported_plan_kernel_ignored_loudly(plan_cache, caplog):
+    """A plan naming a kernel the shape can't run (stale hardware, hand
+    edit) degrades to the default ladder with a warning, not a crash."""
+    config = GameConfig(gen_limit=25)
+    store = plans.PlanStore(plan_cache)
+    # 48x50 does not pack (width % 32 != 0): the packed kernel is invalid.
+    store.put(select.engine_fingerprint((48, 50), config),
+              {"kernel": "packed"})
+    select.reset()
+    with caplog.at_level(logging.WARNING, logger="gol_tpu.engine"):
+        runner = engine._build_runner((48, 50), config, None, "auto",
+                                      segmented=False, packed_state=False)
+    assert any("ignoring the plan" in rec.message for rec in caplog.records)
+    grid = _grid(48, 50)
+    final, gen = runner(jax.device_put(grid))
+    expect = oracle.run(grid, config)
+    assert np.array_equal(np.asarray(final), expect.grid)
+    assert int(gen) == expect.generations
+
+
+def test_packed_state_plan_rejects_byte_kernel(plan_cache, caplog):
+    config = GameConfig(gen_limit=10)
+    with caplog.at_level(logging.WARNING, logger="gol_tpu.engine"):
+        runner = engine._build_runner(
+            (48, 64), config, None, "packed", segmented=False,
+            packed_state=True, plan=space.EnginePlan(kernel="lax"),
+        )
+    assert any("packed word state" in rec.message for rec in caplog.records)
+    assert runner.kernel_name == "packed"
+
+
+@pytest.mark.parametrize("convention", ["c", "cuda"])
+@pytest.mark.parametrize("depth", [1, 2, 4, 8])
+def test_temporal_depth_bit_exact(convention, depth):
+    """Every depth is a pure performance knob: same grid, same count."""
+    config = GameConfig(gen_limit=30, convention=convention)
+    grid = _grid()
+    plan = space.EnginePlan(kernel="packed-jnp", temporal_depth=depth)
+    runner = engine._build_runner((48, 64), config, None, "packed-jnp",
+                                  segmented=False, packed_state=False,
+                                  plan=plan)
+    final, gen = runner(jax.device_put(grid))
+    expect = oracle.run(grid, config)
+    assert np.array_equal(np.asarray(final), expect.grid)
+    assert int(gen) == expect.generations
+
+
+@pytest.mark.parametrize("convention", ["c", "cuda"])
+@pytest.mark.parametrize("block", [8, 64])
+def test_termination_block_bit_exact(convention, block):
+    """Still-life early exit lands on the same generation at any block size
+    (the blocked-loop exactness argument, now under a tuned block)."""
+    config = GameConfig(gen_limit=200, convention=convention)
+    grid = np.zeros((48, 64), np.uint8)
+    grid[10:12, 10:12] = 1  # block still life -> similarity exit
+    plan = space.EnginePlan(kernel="packed-jnp", termination_block=block)
+    runner = engine._build_runner((48, 64), config, None, "packed-jnp",
+                                  segmented=False, packed_state=False,
+                                  plan=plan)
+    final, gen = runner(jax.device_put(grid))
+    expect = oracle.run(grid, config)
+    assert np.array_equal(np.asarray(final), expect.grid)
+    assert int(gen) == expect.generations
+
+
+def test_with_temporal_depth_validity():
+    lax = get_kernel("lax")
+    assert with_temporal_depth(lax, 1) is lax
+    with pytest.raises(ValueError, match="no fused pass"):
+        with_temporal_depth(lax, 4)
+    packed = get_kernel("packed")
+    assert with_temporal_depth(packed, packed.multi_gens) is packed
+    stripped = with_temporal_depth(packed, 1)
+    assert stripped.fused_multi is None and stripped.multi_gens == 1
+    composed = with_temporal_depth(packed, 4)
+    assert composed.multi_gens == 4
+    assert composed.supports_multi(48, 64, SINGLE_DEVICE) == \
+        packed.supports(48, 64, SINGLE_DEVICE)
+
+
+# ---------------------------------------------------------------------------
+# Serve plan: batcher geometry consult.
+# ---------------------------------------------------------------------------
+
+
+def _put_serve(path, plan_dict):
+    plans.PlanStore(path).put(select.serve_fingerprint(), plan_dict)
+    select.reset()
+    batcher._reset_plan()
+
+
+def test_serve_plan_changes_geometry(plan_cache):
+    _put_serve(plan_cache, {"pad_quantum": 64, "batch_ladder": [1, 8, 64]})
+    assert batcher.pad_dim(1) == 64
+    assert batcher.pad_dim(65) == 128
+    assert [batcher.pad_batch(n) for n in (1, 2, 8, 9, 64)] == \
+        [1, 8, 8, 64, 64]
+    # Bucket routing composes: a 48x48 board pads to the tuned 64x64 canvas.
+    from gol_tpu.serve.jobs import new_job
+
+    key = batcher.bucket_for(new_job(48, 48, np.zeros((48, 48), np.uint8)))
+    assert (key.height, key.width) == (64, 64)
+    assert key.kernel == "masked"
+
+
+@pytest.mark.parametrize("bad", [
+    {"pad_quantum": 48, "batch_ladder": [1, 8, 64]},  # quantum % 32 != 0
+    {"pad_quantum": 32, "batch_ladder": [1, 8, 32]},  # top rung != cap
+    {"pad_quantum": 32, "batch_ladder": [2, 8, 64]},  # no rung 1
+    {"pad_quantum": 32, "batch_ladder": [1, 8, 8, 64]},  # not ascending
+])
+def test_invalid_serve_plan_rejected_loudly(plan_cache, bad, caplog):
+    with caplog.at_level(logging.WARNING, logger="gol_tpu.tune.select"):
+        _put_serve(plan_cache, bad)
+        assert batcher.pad_dim(1) == 32
+        assert batcher.pad_batch(3) == 4
+    assert any("bucket" in rec.message for rec in caplog.records)
+
+
+def test_warm_actually_compiles(plan_cache):
+    """batcher.warm must dispatch (jit is lazy): after warm, the first real
+    batch of that bucket reuses the compiled program instead of tracing."""
+    import time
+
+    from gol_tpu.serve.jobs import new_job
+
+    board = _grid(40, 40, seed=3)
+    job = new_job(40, 40, board, gen_limit=5)
+    key = batcher.bucket_for(job)
+    batcher.warm(key, batch=1)
+    t0 = time.perf_counter()
+    first = batcher.run_batch(key, [job])
+    first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batcher.run_batch(key, [job])
+    second_s = time.perf_counter() - t0
+    # A cold trace+compile is orders of magnitude above a warm dispatch;
+    # 5x headroom keeps this robust to CI noise while still catching a
+    # warm() that builds the callable without compiling it.
+    assert first_s < max(5 * second_s, 0.25), (first_s, second_s)
+    exp = oracle.run(board, GameConfig(gen_limit=5))
+    assert np.array_equal(first[0].grid, exp.grid)
+
+
+def test_warm_plans_survives_corrupt_entries(plan_cache, capsys):
+    """A stale/hand-edited warm entry degrades loudly, never aborts boot."""
+    from gol_tpu.cli import _warm_plans
+
+    _put_serve(plan_cache, {
+        "pad_quantum": 32, "batch_ladder": [1, 2, 4, 8, 16, 32, 64],
+        "warm": [{"height": "big", "width": 48},
+                 {"height": 48, "width": 48, "convention": "not-a-conv"},
+                 {"height": 40, "width": 40, "convention": "c"}],
+    })
+    _warm_plans()  # must not raise
+    err = capsys.readouterr().err
+    assert err.count("failed") == 2
+    assert "warmed bucket" in err
+
+
+def test_warm_entries(plan_cache):
+    _put_serve(plan_cache, {
+        "pad_quantum": 32, "batch_ladder": [1, 2, 4, 8, 16, 32, 64],
+        "warm": [{"height": 48, "width": 48, "convention": "c"},
+                 {"bogus": True}],
+    })
+    entries = select.warm_entries()
+    assert entries == [{"height": 48, "width": 48, "convention": "c"}]
+
+
+# ---------------------------------------------------------------------------
+# Measurement machinery.
+# ---------------------------------------------------------------------------
+
+
+def test_trimmed_median():
+    assert measure.trimmed_median([3.0]) == 3.0
+    assert measure.trimmed_median([1.0, 2.0]) == 1.5
+    # The outlier (100) is trimmed before the median.
+    assert measure.trimmed_median([1.0, 2.0, 3.0, 100.0]) == 2.5
+    with pytest.raises(ValueError):
+        measure.trimmed_median([])
+
+
+def test_pick_winner_excludes_gate_failures(caplog):
+    ok = measure.Trial("slow-ok", space.EnginePlan(kernel="lax"),
+                       2.0, [2.0], "ok")
+    cheat = measure.Trial("fast-wrong", space.EnginePlan(kernel="packed"),
+                          None, [], "mismatch")
+    with caplog.at_level(logging.WARNING, logger="gol_tpu.tune.measure"):
+        winner = measure._pick_winner([ok, cheat], "slow-ok")
+    assert winner is ok
+    assert any("gate FAILED" in rec.message for rec in caplog.records)
+    with pytest.raises(RuntimeError, match="no candidate passed"):
+        measure._pick_winner([cheat], "fast-wrong")
+
+
+def test_pick_winner_keeps_default_within_noise():
+    default = measure.Trial("default", space.EnginePlan(kernel="packed"),
+                            1.00, [1.0], "ok")
+    rival = measure.Trial("rival", space.EnginePlan(kernel="lax"),
+                          0.99, [0.99], "ok")
+    assert measure._pick_winner([default, rival], "default") is default
+    clear_win = measure.Trial("rival2", space.EnginePlan(kernel="lax"),
+                              0.5, [0.5], "ok")
+    assert measure._pick_winner([default, clear_win], "default") is clear_win
+
+
+def test_engine_search_smoke(plan_cache):
+    """Tiny end-to-end search: every candidate gated, winner >= default."""
+    config = GameConfig(gen_limit=12)
+    result = measure.run_engine_search(32, 32, config, quick=True,
+                                       iters=2, warmup=1)
+    assert result.default_label == result.trials[0].label
+    assert all(t.gate == "ok" for t in result.trials)
+    assert result.speedup >= 1.0
+    # The winner round-trips through the store and the consult.
+    store = plans.PlanStore(plan_cache)
+    store.put(select.engine_fingerprint((32, 32), config),
+              result.winner.to_dict())
+    select.reset()
+    got = select.engine_plan((32, 32), config)
+    if result.winner == space.EnginePlan():
+        assert got is None
+    else:
+        assert got == result.winner
+
+
+def test_search_result_report():
+    config = GameConfig(gen_limit=10)
+    result = measure.run_engine_search(32, 32, config, quick=True,
+                                       iters=2, warmup=1)
+    text = measure.render_report([result])
+    assert "winner" in text and result.winner.label() in text
+    payload = result.to_dict()
+    assert payload["gates_all_ok"] is True
+    assert payload["tuned_vs_default"] >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# Space sanity.
+# ---------------------------------------------------------------------------
+
+
+def test_engine_candidates_validity():
+    ctx = space.TuneContext(height=48, width=64, convention="c",
+                            packed_state=False)
+    cands = space.engine_candidates(ctx)
+    assert cands[0] == space.default_engine_plan(ctx)
+    names = {c.kernel for c in cands}
+    assert "lax" in names and "packed" in names
+    assert "pallas" not in names  # TPU-only off TPU
+    for cand in cands:
+        if cand.kernel == "lax":
+            assert cand.temporal_depth in (None, 1)
+        assert cand.band_bytes is None  # TPU-only axis
+    # An unpackable width drops the packed family entirely.
+    odd = dataclasses.replace(ctx, width=50)
+    assert {c.kernel for c in space.engine_candidates(odd)} == {"lax"}
+
+
+def test_engine_plan_from_dict_tolerates_junk():
+    plan = space.EnginePlan.from_dict(
+        {"kernel": "packed", "temporal_depth": "4", "unknown_field": 7,
+         "band_bytes": None}
+    )
+    assert plan == space.EnginePlan(kernel="packed", temporal_depth=4)
+
+
+def test_serve_candidates_all_valid():
+    cands = space.serve_candidates()
+    assert cands[0] == space.DEFAULT_SERVE_PLAN
+    assert all(space.valid_serve_plan(c, 64) for c in cands)

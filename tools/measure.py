@@ -1,0 +1,684 @@
+"""TPU measurement battery — all protocol revisions, one tool.
+
+Consolidates the accreted per-round scripts (measure_r3.py, measure_r4.py,
+measure_r5.py, measure_block_r5.py — now thin shims over this module) under
+a ``--rev`` flag. The protocol lineage, documented in benchmarks/README.md:
+
+- **r3**: interleaved chained marginals in one process; plus the one-off
+  probes (h2d/d2h codec + transfer decomposition, config5 end-to-end).
+- **r4**: published ratios become MEDIANS across >= 5 fresh-process
+  sessions (the attach tunnel's chip throughput drifts ±35% between
+  processes); chains lengthened so the two-length subtraction amortizes the
+  ~90 ms dispatch floor to < 2%; best-effort device time via xprof.
+- **r5**: r4 plus the ``single_fast`` path (post-fast-flag engine pass) as
+  the honest single-chip denominator.
+
+Artifacts land in benchmarks/ with the rev in the filename, so documented
+commands — and round-over-round comparisons — keep working:
+
+    python tools/measure.py [--rev 5] session <size>
+    python tools/measure.py [--rev 5] compare <size> [sessions=5]
+    python tools/measure.py [--rev 5] podshard [sessions=5]
+    python tools/measure.py --rev 3 h2d|d2h|config5|compare32k
+    python tools/measure.py block [size] [gens] [blocks...]
+    python tools/measure.py all
+
+``block`` is the termination-block A/B (formerly measure_block_r5.py); it
+now drives the engine's per-runner ``termination_block`` plan parameter
+(gol_tpu/tune/space.EnginePlan) instead of mutating a module global.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _host_words(h: int, w: int, seed: int = 42) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    grid = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
+    return np.packbits(grid, axis=1, bitorder="little").view(np.uint32)
+
+
+def _force(x) -> None:
+    # block_until_ready is unreliable over the attach tunnel; a scalar
+    # readback is the only dependable completion barrier.
+    int(np.asarray(x[0, 0]))
+
+
+def _write(name: str, payload: dict) -> None:
+    path = os.path.join(OUT, name)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    log("wrote", path)
+
+
+def _device_time_per_pass(fn, words, n: int):
+    """Best-effort: total TPU device time for one n-pass chain, via xprof.
+
+    Returns ms per pass or None if the trace/parse path is unavailable.
+    """
+    import glob
+    import tempfile
+
+    import jax
+
+    try:
+        from xprof.convert import raw_to_tool_data
+    except Exception:
+        return None
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            with jax.profiler.trace(td):
+                _force(fn(words, n))
+            planes = glob.glob(os.path.join(td, "**", "*.xplane.pb"),
+                               recursive=True)
+            if not planes:
+                return None
+            data, _ = raw_to_tool_data.xspace_to_tool_data(
+                planes, "op_profile", {}
+            )
+            if isinstance(data, bytes):
+                data = data.decode("utf-8", "replace")
+            # op_profile's byProgram rawTime is total DEVICE picoseconds in
+            # the traced window — the chain dominates it (dispatch and the
+            # tunnel never appear in device time).
+            raw_ps = json.loads(data)["byProgram"]["metrics"]["rawTime"]
+            return raw_ps / 1e9 / n
+    except Exception as e:  # noqa: BLE001 - best effort, never fail the session
+        log("device-time parse failed:", type(e).__name__, str(e)[:120])
+        return None
+
+
+# ---------------------------------------------------------------------------
+# r4/r5 protocol: fresh-process sessions of interleaved chained marginals.
+# ---------------------------------------------------------------------------
+
+
+def session(size: int, rev: int = 5, reps: int = 3, trace: bool = True) -> dict:
+    """One process's interleaved A/B/C: single-chip temporal vs rows-only
+    mesh form vs split-edge 2D form, marginal over two chain lengths. Rev 5
+    adds the ``single_fast`` (post-fast-flag) denominator."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import stencil_packed as sp
+    from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    T = sp.TEMPORAL_GENS
+    words = jnp.asarray(_host_words(size, size))
+
+    def chain(step):
+        def fn(w, n):
+            return jax.lax.fori_loop(0, n, lambda i, x: step(x), w)
+        return jax.jit(fn, static_argnums=1)
+
+    paths = {
+        # 'single' is the r4 denominator (exact per-generation flags), kept
+        # for round-over-round comparability; 'single_fast' (rev 5) is what
+        # the engine actually runs on one chip since the fast-flag passes
+        # (packed_step_multi -> _step_t_fast) — the honest denominator for
+        # "what does a pod chip pay vs a single chip".
+        "single": chain(lambda w: sp._step_t(w)[0]),
+        "rows": chain(lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0]),
+        "split2d": chain(lambda w: sp._distributed_step_multi(w, PROXY_2D)[0]),
+    }
+    if rev >= 5:
+        paths["single_fast"] = chain(lambda w: sp._step_t_fast(w)[0])
+    # Chain lengths: >= 200 passes of margin, scaled down for the larger grid.
+    n1, n2 = (50, 250) if size <= 16384 else (25, 100)
+
+    # Compile + warm every path before any timing.
+    for name, fn in paths.items():
+        t0 = time.perf_counter()
+        _force(fn(words, 2))
+        log(f"  warm {name}: {time.perf_counter() - t0:.0f}s")
+
+    def timed(fn, n):
+        t0 = time.perf_counter()
+        _force(fn(words, n))
+        return time.perf_counter() - t0
+
+    # Discard round: the first full-length timed pass after compile absorbs
+    # one-time upload/init effects (observed as negative marginals otherwise).
+    for fn in paths.values():
+        timed(fn, n1)
+
+    rates = {k: [] for k in paths}
+    for rep in range(reps):
+        # Interleave across paths at both lengths within each rep.
+        t1 = {k: timed(fn, n1) for k, fn in paths.items()}
+        t2 = {k: timed(fn, n2) for k, fn in paths.items()}
+        for k in paths:
+            per_pass = (t2[k] - t1[k]) / (n2 - n1)
+            rates[k].append(size * size * T / per_pass)
+        log(f"  rep {rep}: " + ", ".join(
+            f"{k}={rates[k][-1] / 1e12:.2f}T" for k in paths))
+
+    med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
+    out = {
+        "size": size,
+        "reps": reps,
+        "chain_lengths": [n1, n2],
+        "cells_per_s": {k: [round(r, 0) for r in v] for k, v in rates.items()},
+        "ratio_rows": round(med["rows"] / med["single"], 4),
+        "ratio_2d": round(med["split2d"] / med["single"], 4),
+        "single_median_cells_per_s": round(med["single"], 0),
+    }
+    if rev >= 5:
+        out["ratio_rows_vs_fast"] = round(med["rows"] / med["single_fast"], 4)
+        out["ratio_2d_vs_fast"] = round(med["split2d"] / med["single_fast"], 4)
+        out["single_fast_median_cells_per_s"] = round(med["single_fast"], 0)
+    if trace:
+        dt = {k: _device_time_per_pass(fn, words, n1) for k, fn in paths.items()}
+        if all(v is not None for v in dt.values()):
+            out["device_ms_per_pass"] = {k: round(v, 3) for k, v in dt.items()}
+            out["device_ratio_rows"] = round(dt["single"] / dt["rows"], 4)
+            out["device_ratio_2d"] = round(dt["single"] / dt["split2d"], 4)
+            if rev >= 5:
+                out["device_ratio_rows_vs_fast"] = round(
+                    dt["single_fast"] / dt["rows"], 4)
+                out["device_ratio_2d_vs_fast"] = round(
+                    dt["single_fast"] / dt["split2d"], 4)
+        else:
+            out["device_ms_per_pass"] = None
+    return out
+
+
+def _fresh_sessions(args: list[str], sessions: int, label: str) -> list[dict]:
+    """Run `sessions` fresh-process invocations of this tool, one JSON line
+    each — the r4 protocol's answer to minute-scale tunnel drift."""
+    results = []
+    for i in range(sessions):
+        log(f"{label} session {i + 1}/{sessions}")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *args],
+            capture_output=True, text=True, cwd=REPO, timeout=3600,
+        )
+        if proc.returncode != 0:
+            log(f"  session failed: {proc.stderr[-800:]}")
+            continue
+        results.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    if not results:
+        raise SystemExit("no session succeeded")
+    return results
+
+
+def compare(size: int, rev: int = 5, sessions: int = 5) -> None:
+    """Publish medians + full series across fresh-process sessions."""
+    results = _fresh_sessions(
+        ["--rev", str(rev), "session", str(size)], sessions, f"compare {size}"
+    )
+    for r in results:
+        log(f"  ratios: rows={r['ratio_rows']} 2d={r['ratio_2d']}")
+    ratios_rows = sorted(r["ratio_rows"] for r in results)
+    ratios_2d = sorted(r["ratio_2d"] for r in results)
+    _write(
+        f"compare_{size}_r{rev}.json",
+        {
+            "protocol": "interleaved chained marginals; median across "
+                        "fresh-process sessions (see benchmarks/README.md, "
+                        "r4 protocol)",
+            "size": size,
+            "sessions": results,
+            "runs_rows_ratio": ratios_rows,
+            "runs_2d_ratio": ratios_2d,
+            "rows_ratio_median": ratios_rows[len(ratios_rows) // 2],
+            "2d_ratio_median": ratios_2d[len(ratios_2d) // 2],
+        },
+    )
+
+
+def podshard_session() -> dict:
+    """BASELINE config 5's per-chip shard both ways, one interleaved session:
+    16x1 rows-only -> a (4096, 65536) shard; 4x4 2D -> a (16384, 16384)
+    shard. Plus the single-chip temporal rate on the SAME (4096, 65536)
+    array as the shared denominator."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import stencil_packed as sp
+    from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
+
+    assert jax.default_backend() == "tpu"
+    T = sp.TEMPORAL_GENS
+    shard_16x1 = jnp.asarray(_host_words(4096, 65536))
+    shard_4x4 = jnp.asarray(_host_words(16384, 16384, seed=43))
+
+    def chain(step):
+        def fn(w, n):
+            return jax.lax.fori_loop(0, n, lambda i, x: step(x), w)
+        return jax.jit(fn, static_argnums=1)
+
+    runs = {
+        "single_ref": (chain(lambda w: sp._step_t(w)[0]), shard_16x1),
+        "rows_16x1": (
+            chain(lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0]),
+            shard_16x1,
+        ),
+        "split2d_4x4": (
+            chain(lambda w: sp._distributed_step_multi(w, PROXY_2D)[0]),
+            shard_4x4,
+        ),
+    }
+    n1, n2 = 25, 100
+    for name, (fn, w) in runs.items():
+        t0 = time.perf_counter()
+        _force(fn(w, 2))
+        log(f"  warm {name}: {time.perf_counter() - t0:.0f}s")
+    for fn, w in runs.values():  # discard round (see session())
+        _force(fn(w, n1))
+    rates = {k: [] for k in runs}
+    for rep in range(3):
+        t1 = {k: None for k in runs}
+        t2 = {k: None for k in runs}
+        for k, (fn, w) in runs.items():
+            t0 = time.perf_counter(); _force(fn(w, n1)); t1[k] = time.perf_counter() - t0
+        for k, (fn, w) in runs.items():
+            t0 = time.perf_counter(); _force(fn(w, n2)); t2[k] = time.perf_counter() - t0
+        for k in runs:
+            per_pass = (t2[k] - t1[k]) / (n2 - n1)
+            cells = 4096 * 65536  # both shards are the same cell count
+            rates[k].append(cells * T / per_pass)
+        log(f"  rep {rep}: " + ", ".join(f"{k}={rates[k][-1]/1e12:.2f}T" for k in runs))
+    med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
+    return {
+        "cells_per_s": {k: [round(x) for x in v] for k, v in rates.items()},
+        "ratio_rows_16x1": round(med["rows_16x1"] / med["single_ref"], 4),
+        "ratio_split2d_4x4": round(med["split2d_4x4"] / med["single_ref"], 4),
+        "single_ref_cells_per_s": round(med["single_ref"]),
+    }
+
+
+def podshard(rev: int = 5, sessions: int = 5) -> None:
+    results = _fresh_sessions(
+        ["--rev", str(rev), "podshard-session"], sessions, "podshard"
+    )
+    for r in results:
+        log(f"  ratios: 16x1={r['ratio_rows_16x1']} "
+            f"4x4={r['ratio_split2d_4x4']}")
+    r16 = sorted(r["ratio_rows_16x1"] for r in results)
+    r44 = sorted(r["ratio_split2d_4x4"] for r in results)
+    _write(
+        f"configs_r{rev}.json",
+        {
+            "what": "BASELINE config 5 (65536^2 on 16 chips) per-chip shard, "
+                    "both meshes, one chip with local wraps standing in for "
+                    "ICI ppermutes; ratios vs the single-chip temporal rate "
+                    "on the same cell count",
+            "sessions": results,
+            "ratio_16x1_runs": r16,
+            "ratio_4x4_runs": r44,
+            "ratio_16x1_median": r16[len(r16) // 2],
+            "ratio_4x4_median": r44[len(r44) // 2],
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# Termination-block A/B (formerly measure_block_r5.py): now via the engine's
+# per-runner plan parameter, so every variant is a first-class build.
+# ---------------------------------------------------------------------------
+
+
+def block_ab(size: int = 65536, gens: int = 1000,
+             blocks: list[int] | None = None) -> None:
+    blocks = blocks or [16, 64, 128]
+
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu import engine
+    from gol_tpu.config import GameConfig
+    from gol_tpu.tune.space import EnginePlan
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    rng = np.random.default_rng(42)
+    words = jnp.asarray(rng.integers(
+        0, np.iinfo(np.uint32).max, size=(size, size // 32),
+        dtype=np.uint32, endpoint=True,
+    ))
+    config = GameConfig(gen_limit=gens)
+
+    runners = {}
+    for b in blocks:
+        t0 = time.perf_counter()
+        # _build_runner directly with an explicit plan: the lru_cached
+        # factories key on (shape, config, mesh, kernel), not the block.
+        r = engine._build_runner(
+            (size, size), config, None, "packed",
+            segmented=False, packed_state=True,
+            plan=EnginePlan(termination_block=b),
+        )
+        out = r(words)
+        g = int(out[1])  # scalar readback = reliable completion barrier
+        log(f"  block {b}: compile+first run {time.perf_counter() - t0:.0f}s, "
+            f"{g} generations")
+        runners[b] = r
+
+    reps = 4
+    times = {b: [] for b in blocks}
+    for rep in range(reps):
+        for b in blocks:  # interleaved round-robin
+            t0 = time.perf_counter()
+            out = runners[b](words)
+            int(out[1])
+            times[b].append(time.perf_counter() - t0)
+            log(f"  rep {rep} block {b}: {times[b][-1]:.2f}s")
+    best = {b: min(v) for b, v in times.items()}
+    rates = {b: size * size * gens / best[b] for b in blocks}
+    payload = {
+        "what": "termination-block A/B on the headline packed-state run via "
+                "the engine's plan parameter; interleaved repeats in one "
+                "process, best-of wall",
+        "size": size,
+        "gen_limit": gens,
+        "wall_s": {str(b): [round(t, 3) for t in v] for b, v in times.items()},
+        "cells_per_s_best": {str(b): round(r) for b, r in rates.items()},
+        "ratio_vs_first": {
+            str(b): round(rates[b] / rates[blocks[0]], 4) for b in blocks
+        },
+    }
+    _write("block_ab_r5.json", payload)
+    print(json.dumps(payload["cells_per_s_best"]))
+
+
+# ---------------------------------------------------------------------------
+# r3 one-off probes (codec/transfer decomposition, config5 end-to-end).
+# ---------------------------------------------------------------------------
+
+
+def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
+    """r3 single-process A/B: kept for artifact reproducibility; the r4/r5
+    ``compare`` protocol (fresh-process medians) supersedes it."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import stencil_packed as sp
+    from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
+
+    words = jnp.asarray(_host_words(size, size))
+    words.block_until_ready()
+    log("words on device")
+
+    def loop(step, calls):
+        def run(state):
+            final = jax.lax.fori_loop(0, calls, lambda i, s: step(s), state)
+            return final[0, 0]
+
+        return jax.jit(run)
+
+    paths = {
+        "packed-temporal-T8": lambda w: sp._step_t(w)[0],
+        "packed-dist-temporal": lambda w: sp._distributed_step_multi(
+            w, SINGLE_DEVICE
+        )[0],
+        "packed-dist-temporal-2d": lambda w: sp._distributed_step_multi(
+            w, PROXY_2D
+        )[0],
+    }
+    g2 = 3 * g1
+    runs, best = {}, {}
+    for name, step in paths.items():
+        for gens in (g1, g2):
+            run = loop(step, gens // sp.TEMPORAL_GENS)
+            int(run(words))
+            log("compiled", name, gens)
+            runs[name, gens] = run
+            best[name, gens] = float("inf")
+    for rep in range(repeats):
+        for key, run in runs.items():
+            t0 = time.perf_counter()
+            int(run(words))
+            best[key] = min(best[key], time.perf_counter() - t0)
+        log(f"rep {rep + 1}/{repeats} done")
+    res = {}
+    for name in paths:
+        marg = (best[name, g2] - best[name, g1]) / (g2 - g1)
+        res[name] = size * size / marg
+        log(f"{name:26s} {marg * 1e3:8.3f} ms/gen  {res[name]:.3e} cells/s")
+    ratio = res["packed-dist-temporal"] / res["packed-temporal-T8"]
+    ratio_2d = res["packed-dist-temporal-2d"] / res["packed-temporal-T8"]
+    _write(
+        f"compare_{size}_r3.json",
+        {
+            "metric": "dist_temporal_vs_single_chip",
+            "value": ratio,
+            "unit": "ratio",
+            "vs_baseline": None,
+            "detail": res,
+            "ratio_2d_form": ratio_2d,
+            "size": size,
+            "generations": [g1, g2],
+            "note": (
+                "marginal rates, fixed-count fori_loop, one chip, repeats "
+                "interleaved across paths to cancel the tunnel chip's "
+                "minute-scale drift; superseded by the r4/r5 fresh-process "
+                "median protocol (tools/measure.py compare)."
+            ),
+        },
+    )
+
+
+def h2d(size: int = 65536) -> None:
+    """Read-phase decomposition: codec pack throughput (text bytes -> packed
+    words, host-only) and host->device upload throughput, measured apart so
+    the config5 Reading-file number has a written breakdown — which side is
+    the bound, storage/codec or the attach tunnel."""
+    import jax
+
+    from gol_tpu import native
+    from gol_tpu.io.text_grid import row_stride
+
+    rng = np.random.default_rng(7)
+    rows = 8192  # 8192 x 65537 text bytes ~ 512MB sample of the 4.3GB file
+    text = rng.integers(ord("0"), ord("2"), size=(rows, row_stride(size)),
+                        dtype=np.uint8)
+    text[:, -1] = ord("\n")
+    t0 = time.perf_counter()
+    packed = native.pack_text(text, size)
+    pack_s = time.perf_counter() - t0
+    text_mb = text.nbytes / (1 << 20)
+
+    words = rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)
+    t0 = time.perf_counter()
+    jax.device_put(words).block_until_ready()
+    # block_until_ready can return early over the tunnel; settle with a
+    # tiny readback tied to the uploaded buffer.
+    up = jax.device_put(words)
+    int(up[0, 0])
+    h2d_s = (time.perf_counter() - t0) / 2  # two uploads timed
+    mb = words.nbytes / (1 << 20)
+    _write(
+        "h2d_probe_r3.json",
+        {
+            "metric": "h2d_throughput",
+            "value": mb / h2d_s,
+            "unit": "MB/s",
+            "vs_baseline": None,
+            "detail": {
+                "pack_text_MBps": round(text_mb / pack_s, 1),
+                "pack_sample_bytes": text.nbytes,
+                "h2d_s_per_512MB": round(h2d_s, 3),
+            },
+            "bytes": words.nbytes,
+            "note": "codec pack rate is per-thread (read_packed fans it "
+            "over a pool); upload is one 512MB device_put over the attach "
+            "tunnel — together they bound the packed read phase.",
+        },
+    )
+
+
+def d2h(size: int = 65536) -> None:
+    """Device->host throughput probes for the write phase: one-shot vs
+    chunked at prefetch depths 1, 2 and 4 (the packed_io pipeline's knob)."""
+    import jax.numpy as jnp
+
+    from gol_tpu.io import packed_io
+
+    nwords = size // 32
+    rng = np.random.default_rng(1)
+    host = rng.integers(0, 2**32, size=(size, nwords), dtype=np.uint32)
+    words = jnp.asarray(host)
+    words.block_until_ready()
+    log("words on device:", host.nbytes >> 20, "MB")
+    results = {}
+
+    t0 = time.perf_counter()
+    np.asarray(words)
+    results["oneshot_s"] = time.perf_counter() - t0
+
+    chunk_rows = max(1, packed_io._WRITE_CHUNK_BYTES // (nwords * 4))
+    for depth in (1, 2, 4):
+        import concurrent.futures
+
+        starts = list(range(0, size, chunk_rows))
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(max_workers=depth) as pool:
+            blocks = list(
+                pool.map(
+                    lambda s: np.ascontiguousarray(words[s : s + chunk_rows]),
+                    starts,
+                )
+            )
+        results[f"chunked_depth{depth}_s"] = time.perf_counter() - t0
+        del blocks
+    mb = host.nbytes / (1 << 20)
+    _write(
+        "d2h_probe_r3.json",
+        {
+            "metric": "d2h_throughput",
+            "value": mb / results["oneshot_s"],
+            "unit": "MB/s",
+            "vs_baseline": None,
+            "detail": {k: round(v, 3) for k, v in results.items()},
+            "bytes": host.nbytes,
+            "note": "device->host transfer probes over the attach tunnel; "
+            "chunked figures include the per-chunk device slice dispatch.",
+        },
+    )
+
+
+def config5(size: int = 65536, gens: int = 10000) -> None:
+    """The north-star workload end-to-end through the CLI, phases recorded."""
+    import re
+    import tempfile
+
+    td = tempfile.mkdtemp(prefix="gol_config5_")
+    inp = os.path.join(td, "input.txt")
+    env = dict(os.environ)
+    # The package is not installed; prepend (don't clobber — it carries the
+    # TPU backend registration) the repo onto PYTHONPATH.
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    log("generating", size, "input at", inp)
+    subprocess.run(
+        [sys.executable, "-m", "gol_tpu", "generate", str(size), str(size),
+         "--seed", "5", "--output", inp],
+        check=True, cwd=REPO, env=env,
+    )
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "gol_tpu", str(size), str(size), inp,
+         "--variant", "tpu", "--packed-io", "--warmup",
+         "--gen-limit", str(gens)],
+        capture_output=True, text=True, check=True, cwd=td, env=env,
+    )
+    wall = time.perf_counter() - t0
+    log(proc.stdout)
+    phases = dict(
+        re.findall(r"(Reading file|Execution time|Writing file):\t([0-9.]+)",
+                   proc.stdout)
+    )
+    generations = int(re.search(r"Generations:\t(\d+)", proc.stdout).group(1))
+    exec_s = float(phases["Execution time"]) / 1000
+    rate = size * size * generations / exec_s
+    _write(
+        "config5_r3.json",
+        {
+            "metric": "cell_updates_per_sec_per_chip",
+            "value": rate,
+            "unit": "cells/s",
+            "vs_baseline": rate / 1e11,
+            "phases_ms": {k: float(v) for k, v in phases.items()},
+            "generations": generations,
+            "wall_s": round(wall, 1),
+            "size": size,
+            "note": "BASELINE.md config 5 end-to-end via the CLI on one "
+            "chip: packed I/O + temporal kernel + chunked D2H write "
+            "pipeline at depth GOL_D2H_DEPTH (default 2). Read/write "
+            "phases ride the attach tunnel, whose throughput drifts "
+            "several-x between sessions; Execution time is on-device and "
+            "comparable across sessions.",
+        },
+    )
+
+
+_R3_STEPS = {"compare32k": compare32k, "h2d": h2d, "d2h": d2h,
+             "config5": config5}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    rev = 5
+    if argv[:1] == ["--rev"]:
+        if len(argv) < 2:
+            raise SystemExit("--rev needs a value (3, 4 or 5)")
+        rev = int(argv[1])
+        argv = argv[2:]
+    if rev not in (3, 4, 5):
+        raise SystemExit(f"unknown protocol rev {rev}; one of 3, 4, 5")
+    cmd = argv[0] if argv else "all"
+    rest = argv[1:]
+
+    if cmd == "block":
+        block_ab(
+            int(rest[0]) if len(rest) > 0 else 65536,
+            int(rest[1]) if len(rest) > 1 else 1000,
+            [int(b) for b in rest[2:]] or None,
+        )
+        return 0
+    if rev == 3:
+        names = list(_R3_STEPS) if cmd == "all" else [cmd]
+        for name in names:
+            if name not in _R3_STEPS:
+                raise SystemExit(
+                    f"unknown r3 step {name}; one of {sorted(_R3_STEPS)} or block"
+                )
+            log("=== step:", name)
+            _R3_STEPS[name]()
+        return 0
+    if cmd == "session":
+        print(json.dumps(session(int(rest[0]), rev=rev)))
+    elif cmd == "podshard-session":
+        print(json.dumps(podshard_session()))
+    elif cmd == "compare":
+        compare(int(rest[0]), rev, int(rest[1]) if len(rest) > 1 else 5)
+    elif cmd == "podshard":
+        podshard(rev, int(rest[0]) if len(rest) > 0 else 5)
+    elif cmd == "all":
+        compare(16384, rev)
+        compare(32768, rev)
+        podshard(rev)
+    else:
+        raise SystemExit(f"unknown subcommand {cmd}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

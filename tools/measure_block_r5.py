@@ -1,103 +1,19 @@
-"""Termination-block A/B at the headline size (VERDICT r4 item 7).
+"""Thin shim: the termination-block A/B lives in tools/measure.py (`block`).
 
-The 65536^2 wall rate (2.96e12, BENCH_r04) trails the 16384^2 post-fast-flag
-device rate (~3.46e12). One candidate cost: the blocked while_loop syncs
-flags every _TERMINATION_BLOCK=16 generations (2 fused passes per block) —
-each outer iteration ends in a vector vote + 16-step scalar replay between
-the flag production and the loop cond. With fast flags the per-pass flag
-cost is ~gone, so a larger block may amortize the remaining per-block cost.
-
-A/B protocol per the r4 measurement notes (memory: axon tunnel): both block
-sizes are traced IN ONE PROCESS (engine._TERMINATION_BLOCK is read at trace
-time; the runner cache keys do not include it, so each variant gets a fresh
-_build_runner call), repeats interleaved round-robin so tunnel drift
-cancels from the ratio, completion forced by scalar readback.
-
-Usage: python tools/measure_block_r5.py [size] [gens] [blocks...]
-Writes benchmarks/block_ab_r5.json.
+Kept so the documented command (`python tools/measure_block_r5.py [size]
+[gens] [blocks...]`) keeps working. The A/B now builds each block size
+through the engine's per-runner plan parameter
+(gol_tpu/tune/space.EnginePlan) instead of mutating engine's module global.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def main() -> int:
-    size = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
-    gens = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
-    blocks = [int(b) for b in sys.argv[3:]] or [16, 64, 128]
-
-    import jax
-    import jax.numpy as jnp
-
-    from gol_tpu import engine
-    from gol_tpu.config import GameConfig
-
-    assert jax.default_backend() == "tpu", jax.default_backend()
-    rng = np.random.default_rng(42)
-    words = jnp.asarray(rng.integers(
-        0, np.iinfo(np.uint32).max, size=(size, size // 32),
-        dtype=np.uint32, endpoint=True,
-    ))
-    config = GameConfig(gen_limit=gens)
-
-    runners = {}
-    for b in blocks:
-        engine._TERMINATION_BLOCK = b
-        t0 = time.time()
-        # _build_runner directly: the lru_cached factories would return the
-        # first variant's trace for every block size.
-        r = engine._build_runner((size, size), config, None, "packed",
-                                 segmented=False, packed_state=True)
-        out = r(words)
-        g = int(out[1])  # scalar readback = reliable completion barrier
-        log(f"  block {b}: compile+first run {time.time() - t0:.0f}s, "
-            f"{g} generations")
-        runners[b] = r
-
-    reps = 4
-    times = {b: [] for b in blocks}
-    for rep in range(reps):
-        for b in blocks:  # interleaved round-robin
-            t0 = time.perf_counter()
-            out = runners[b](words)
-            g = int(out[1])
-            times[b].append(time.perf_counter() - t0)
-            log(f"  rep {rep} block {b}: {times[b][-1]:.2f}s")
-    best = {b: min(v) for b, v in times.items()}
-    rates = {b: size * size * gens / best[b] for b in blocks}
-    payload = {
-        "what": "engine._TERMINATION_BLOCK A/B on the headline packed-state "
-                "run; interleaved repeats in one process, best-of wall",
-        "size": size,
-        "gen_limit": gens,
-        "wall_s": {str(b): [round(t, 3) for t in v] for b, v in times.items()},
-        "cells_per_s_best": {str(b): round(r) for b, r in rates.items()},
-        "ratio_vs_16": {
-            str(b): round(rates[b] / rates[blocks[0]], 4) for b in blocks
-        },
-    }
-    path = os.path.join(REPO, "benchmarks", "block_ab_r5.json")
-    with open(path, "w") as f:
-        json.dump(payload, f, indent=1)
-        f.write("\n")
-    print(json.dumps(payload["cells_per_s_best"]))
-    log("wrote", path)
-    return 0
-
+from measure import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["block", *sys.argv[1:]]))

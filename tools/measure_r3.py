@@ -1,308 +1,17 @@
-"""Round-3 TPU measurement battery — run when a real chip is attached.
+"""Thin shim: the r3 measurement battery lives in tools/measure.py (--rev 3).
 
-Each step is independently invocable (the attach tunnel can drop mid-way):
-
-    python tools/measure_r3.py compare32k   # single-chip vs mesh-form temporal
-    python tools/measure_r3.py h2d          # codec pack + host->device probes
-    python tools/measure_r3.py d2h          # raw/chunked device->host probes
-    python tools/measure_r3.py config5      # 65536^2 end-to-end CLI phases
-    python tools/measure_r3.py all
-
-Artifacts land in benchmarks/ as *_r3.json. The hardware test lane writes
-its own artifact: GOL_TPU_HW=1 python -m pytest tests/test_tpu_hw.py -q.
-
-Uploads use host-side packbits (128MB of words, not 1GB of bytes — the
-attach tunnel makes the byte-grid upload the slowest part of any 32768+
-measurement).
+Kept so documented commands (`python tools/measure_r3.py h2d` etc.) keep
+working; new work goes through `python tools/measure.py --rev 3 <step>`.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "benchmarks")
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def _host_words(size: int, seed: int = 42) -> np.ndarray:
-    rng = np.random.default_rng(seed)
-    grid = rng.integers(0, 2, size=(size, size), dtype=np.uint8)
-    return np.packbits(grid, axis=1, bitorder="little").view(np.uint32)
-
-
-def _write(name: str, payload: dict) -> None:
-    path = os.path.join(OUT, name)
-    with open(path, "w") as f:
-        json.dump(payload, f, indent=1)
-        f.write("\n")
-    log("wrote", path)
-
-
-def compare32k(size: int = 32768, g1: int = 200, repeats: int = 5) -> None:
-    """Mesh-form A/B: single-chip temporal vs the banded mesh form, marginal
-    over g1 -> 3*g1 generations. Repeats are INTERLEAVED across paths (all
-    four chains timed round-robin) so the chip's minute-scale throughput
-    drift — measured up to 35% between back-to-back processes on the shared
-    attach tunnel — cancels out of the ratio instead of biasing one path."""
-    import jax
-    import jax.numpy as jnp
-
-    from gol_tpu.ops import stencil_packed as sp
-    from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
-
-    words = jnp.asarray(_host_words(size))
-    words.block_until_ready()
-    log("words on device")
-
-    def loop(step, calls):
-        def run(state):
-            final = jax.lax.fori_loop(0, calls, lambda i, s: step(s), state)
-            return final[0, 0]
-
-        return jax.jit(run)
-
-    proxy_2d = PROXY_2D  # cols>1: ghost-plane form
-    paths = {
-        "packed-temporal-T8": lambda w: sp._step_t(w)[0],
-        # cols == 1 -> the rows-only kernel (R x 1 pod layout, full-width
-        # shards, no ghost-column machinery).
-        "packed-dist-temporal": lambda w: sp._distributed_step_multi(
-            w, SINGLE_DEVICE
-        )[0],
-        # cols > 1 with local wraps -> the 2D-mesh ghost-plane form.
-        "packed-dist-temporal-2d": lambda w: sp._distributed_step_multi(
-            w, proxy_2d
-        )[0],
-    }
-    g2 = 3 * g1
-    runs, best = {}, {}
-    for name, step in paths.items():
-        for gens in (g1, g2):
-            run = loop(step, gens // sp.TEMPORAL_GENS)
-            int(run(words))
-            log("compiled", name, gens)
-            runs[name, gens] = run
-            best[name, gens] = float("inf")
-    for rep in range(repeats):
-        for key, run in runs.items():
-            t0 = time.perf_counter()
-            int(run(words))
-            best[key] = min(best[key], time.perf_counter() - t0)
-        log(f"rep {rep + 1}/{repeats} done")
-    res = {}
-    for name in paths:
-        marg = (best[name, g2] - best[name, g1]) / (g2 - g1)
-        res[name] = size * size / marg
-        log(f"{name:26s} {marg * 1e3:8.3f} ms/gen  {res[name]:.3e} cells/s")
-    ratio = res["packed-dist-temporal"] / res["packed-temporal-T8"]
-    ratio_2d = res["packed-dist-temporal-2d"] / res["packed-temporal-T8"]
-    _write(
-        f"compare_{size}_r3.json",
-        {
-            "metric": "dist_temporal_vs_single_chip",
-            "value": ratio,
-            "unit": "ratio",
-            "vs_baseline": None,
-            "detail": res,
-            "ratio_2d_form": ratio_2d,
-            "size": size,
-            "generations": [g1, g2],
-            "note": (
-                "marginal rates, fixed-count fori_loop, one chip, repeats "
-                "interleaved across paths to cancel the tunnel chip's "
-                "minute-scale drift. packed-dist-temporal is the rows-only "
-                "kernel (R x 1 pod layout: full-width shards, E/W wrap = "
-                "own lane roll, no ghost-column machinery); -2d is the "
-                "ghost-plane form an R x C pod chip runs. The r3 "
-                "overlapped interior/frontier split measured 0.40 vs the "
-                "2d form's 0.49-0.88 across sessions and was retired — "
-                "its frontier kernels cost ~0.8x of the main kernel to "
-                "hide an exchange costing ~0.15x on-chip (see "
-                "stencil_packed._distributed_step_multi)."
-            ),
-        },
-    )
-
-
-def h2d(size: int = 65536) -> None:
-    """Read-phase decomposition: codec pack throughput (text bytes -> packed
-    words, host-only) and host->device upload throughput, measured apart so
-    the config5 Reading-file number has a written breakdown — which side is
-    the bound, storage/codec or the attach tunnel."""
-    import jax
-
-    from gol_tpu import native
-    from gol_tpu.io.text_grid import row_stride
-
-    rng = np.random.default_rng(7)
-    rows = 8192  # 8192 x 65537 text bytes ~ 512MB sample of the 4.3GB file
-    text = rng.integers(ord("0"), ord("2"), size=(rows, row_stride(size)),
-                        dtype=np.uint8)
-    text[:, -1] = ord("\n")
-    t0 = time.perf_counter()
-    packed = native.pack_text(text, size)
-    pack_s = time.perf_counter() - t0
-    text_mb = text.nbytes / (1 << 20)
-
-    words = rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)
-    t0 = time.perf_counter()
-    jax.device_put(words).block_until_ready()
-    # block_until_ready can return early over the tunnel; settle with a
-    # tiny readback tied to the uploaded buffer.
-    up = jax.device_put(words)
-    int(up[0, 0])
-    h2d_s = (time.perf_counter() - t0) / 2  # two uploads timed
-    mb = words.nbytes / (1 << 20)
-    _write(
-        "h2d_probe_r3.json",
-        {
-            "metric": "h2d_throughput",
-            "value": mb / h2d_s,
-            "unit": "MB/s",
-            "vs_baseline": None,
-            "detail": {
-                "pack_text_MBps": round(text_mb / pack_s, 1),
-                "pack_sample_bytes": text.nbytes,
-                "h2d_s_per_512MB": round(h2d_s, 3),
-            },
-            "bytes": words.nbytes,
-            "note": "codec pack rate is per-thread (read_packed fans it "
-            "over a pool); upload is one 512MB device_put over the attach "
-            "tunnel — together they bound the packed read phase.",
-        },
-    )
-
-
-def d2h(size: int = 65536) -> None:
-    """Device->host throughput probes for the write phase: one-shot vs
-    chunked at prefetch depths 1, 2 and 4 (the packed_io pipeline's knob)."""
-    import jax
-    import jax.numpy as jnp
-
-    from gol_tpu.io import packed_io
-
-    nwords = size // 32
-    rng = np.random.default_rng(1)
-    host = rng.integers(0, 2**32, size=(size, nwords), dtype=np.uint32)
-    words = jnp.asarray(host)
-    words.block_until_ready()
-    log("words on device:", host.nbytes >> 20, "MB")
-    results = {}
-
-    t0 = time.perf_counter()
-    np.asarray(words)
-    results["oneshot_s"] = time.perf_counter() - t0
-
-    chunk_rows = max(1, packed_io._WRITE_CHUNK_BYTES // (nwords * 4))
-    for depth in (1, 2, 4):
-        import concurrent.futures
-
-        starts = list(range(0, size, chunk_rows))
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(max_workers=depth) as pool:
-            blocks = list(
-                pool.map(
-                    lambda s: np.ascontiguousarray(words[s : s + chunk_rows]),
-                    starts,
-                )
-            )
-        results[f"chunked_depth{depth}_s"] = time.perf_counter() - t0
-        del blocks
-    mb = host.nbytes / (1 << 20)
-    _write(
-        "d2h_probe_r3.json",
-        {
-            "metric": "d2h_throughput",
-            "value": mb / results["oneshot_s"],
-            "unit": "MB/s",
-            "vs_baseline": None,
-            "detail": {k: round(v, 3) for k, v in results.items()},
-            "bytes": host.nbytes,
-            "note": "device->host transfer probes over the attach tunnel; "
-            "chunked figures include the per-chunk device slice dispatch.",
-        },
-    )
-
-
-def config5(size: int = 65536, gens: int = 10000) -> None:
-    """The north-star workload end-to-end through the CLI, phases recorded."""
-    import re
-    import subprocess
-    import tempfile
-
-    td = tempfile.mkdtemp(prefix="gol_config5_")
-    inp = os.path.join(td, "input.txt")
-    env = dict(os.environ)
-    # The package is not installed; prepend (don't clobber — it carries the
-    # TPU backend registration) the repo onto PYTHONPATH.
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    log("generating", size, "input at", inp)
-    subprocess.run(
-        [sys.executable, "-m", "gol_tpu", "generate", str(size), str(size),
-         "--seed", "5", "--output", inp],
-        check=True, cwd=REPO, env=env,
-    )
-    t0 = time.perf_counter()
-    proc = subprocess.run(
-        [sys.executable, "-m", "gol_tpu", str(size), str(size), inp,
-         "--variant", "tpu", "--packed-io", "--warmup",
-         "--gen-limit", str(gens)],
-        capture_output=True, text=True, check=True, cwd=td, env=env,
-    )
-    wall = time.perf_counter() - t0
-    log(proc.stdout)
-    phases = dict(
-        re.findall(r"(Reading file|Execution time|Writing file):\t([0-9.]+)",
-                   proc.stdout)
-    )
-    generations = int(re.search(r"Generations:\t(\d+)", proc.stdout).group(1))
-    exec_s = float(phases["Execution time"]) / 1000
-    rate = size * size * generations / exec_s
-    _write(
-        "config5_r3.json",
-        {
-            "metric": "cell_updates_per_sec_per_chip",
-            "value": rate,
-            "unit": "cells/s",
-            "vs_baseline": rate / 1e11,
-            "phases_ms": {k: float(v) for k, v in phases.items()},
-            "generations": generations,
-            "wall_s": round(wall, 1),
-            "size": size,
-            "note": "BASELINE.md config 5 end-to-end via the CLI on one "
-            "chip: packed I/O + temporal kernel + chunked D2H write "
-            "pipeline at depth GOL_D2H_DEPTH (default 2). Read/write "
-            "phases ride the attach tunnel, whose throughput drifts "
-            "several-x between sessions (benchmarks/d2h_probe_r3.json "
-            "records the same-session transfer floor); Execution time is "
-            "on-device and comparable across sessions (r2: exec 16.4s, "
-            "write 25.5s, read 10.1s).",
-        },
-    )
-
-
-STEPS = {"compare32k": compare32k, "h2d": h2d, "d2h": d2h, "config5": config5}
-
-
-def main() -> int:
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    names = list(STEPS) if which == "all" else [which]
-    for name in names:
-        log("=== step:", name)
-        STEPS[name]()
-    return 0
-
+from measure import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rev", "3", *sys.argv[1:]]))

@@ -1,324 +1,18 @@
-"""Round-4 TPU measurement battery (VERDICT r3 items 1, 2, 5).
+"""Thin shim: the r4 measurement battery lives in tools/measure.py (--rev 4).
 
-Protocol upgrades over r3 (documented in benchmarks/README.md):
-
-- RATIOS are computed within one process from interleaved chained runs
-  (round-robin across the compared paths), as in r3 — but the published
-  number is now the MEDIAN across >= 5 fresh-process sessions, with the
-  full per-session series recorded. The attach tunnel's chip throughput
-  drifts between processes (r3 measured ±35%); medians of interleaved
-  ratios are the statistic that survives it.
-- Chains are longer (marginal over >= 200 temporal passes) so the
-  two-length subtraction amortizes the ~90 ms dispatch floor to < 2%.
-- Best-effort DEVICE time per pass from a jax.profiler trace parsed with
-  xprof (immune to tunnel weather between dispatch and completion);
-  recorded alongside wall-clock marginals when the parse succeeds.
-
-Subcommands:
-
-    python tools/measure_r4.py session <size>   # one interleaved session, JSON to stdout
-    python tools/measure_r4.py compare <size> [sessions=5]
-    python tools/measure_r4.py podshard [sessions=5]   # BASELINE config-5 shard: 16x1 vs 4x4
-    python tools/measure_r4.py all
-
-compare writes benchmarks/compare_<size>_r4.json; podshard writes
-benchmarks/configs_r4.json (the 16x1-vs-4x4 reconciliation, item 5).
+Kept so documented commands (`python tools/measure_r4.py compare 16384` etc.)
+keep working — artifacts still land as *_r4.json; new work goes through
+`python tools/measure.py --rev 4 <step>`.
 """
 
 from __future__ import annotations
 
-import json
 import os
-import subprocess
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import numpy as np
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "benchmarks")
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def _host_words(h: int, w: int, seed: int = 42) -> np.ndarray:
-    rng = np.random.default_rng(seed)
-    grid = rng.integers(0, 2, size=(h, w), dtype=np.uint8)
-    return np.packbits(grid, axis=1, bitorder="little").view(np.uint32)
-
-
-def _force(x) -> None:
-    # block_until_ready is unreliable over the attach tunnel; a scalar
-    # readback is the only dependable completion barrier.
-    int(np.asarray(x[0, 0]))
-
-
-def _device_time_per_pass(fn, words, n: int):
-    """Best-effort: total TPU device time for one n-pass chain, via xprof.
-
-    Returns ms per pass or None if the trace/parse path is unavailable.
-    """
-    import glob
-    import tempfile
-
-    import jax
-
-    try:
-        from xprof.convert import raw_to_tool_data
-    except Exception:
-        return None
-    try:
-        with tempfile.TemporaryDirectory() as td:
-            with jax.profiler.trace(td):
-                _force(fn(words, n))
-            planes = glob.glob(os.path.join(td, "**", "*.xplane.pb"),
-                               recursive=True)
-            if not planes:
-                return None
-            data, _ = raw_to_tool_data.xspace_to_tool_data(
-                planes, "op_profile", {}
-            )
-            if isinstance(data, bytes):
-                data = data.decode("utf-8", "replace")
-            # op_profile's byProgram rawTime is total DEVICE picoseconds in
-            # the traced window — the chain dominates it (dispatch and the
-            # tunnel never appear in device time).
-            raw_ps = json.loads(data)["byProgram"]["metrics"]["rawTime"]
-            return raw_ps / 1e9 / n
-    except Exception as e:  # noqa: BLE001 - best effort, never fail the session
-        log("device-time parse failed:", type(e).__name__, str(e)[:120])
-        return None
-
-
-def session(size: int, reps: int = 3, trace: bool = True) -> dict:
-    """One process's interleaved A/B/C: single-chip temporal vs rows-only
-    mesh form vs split-edge 2D form, marginal over two chain lengths."""
-    import jax
-    import jax.numpy as jnp
-
-    from gol_tpu.ops import stencil_packed as sp
-    from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
-
-    assert jax.default_backend() == "tpu", jax.default_backend()
-    T = sp.TEMPORAL_GENS
-    words = jnp.asarray(_host_words(size, size))
-
-    def chain(step):
-        def fn(w, n):
-            return jax.lax.fori_loop(0, n, lambda i, x: step(x), w)
-        return jax.jit(fn, static_argnums=1)
-
-    paths = {
-        "single": chain(lambda w: sp._step_t(w)[0]),
-        "rows": chain(lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0]),
-        "split2d": chain(lambda w: sp._distributed_step_multi(w, PROXY_2D)[0]),
-    }
-    # Chain lengths: >= 200 passes of margin, scaled down for the larger grid.
-    n1, n2 = (50, 250) if size <= 16384 else (25, 100)
-
-    # Compile + warm every path before any timing.
-    for name, fn in paths.items():
-        t0 = time.time()
-        _force(fn(words, 2))
-        log(f"  warm {name}: {time.time() - t0:.0f}s")
-
-    def timed(fn, n):
-        t0 = time.perf_counter()
-        _force(fn(words, n))
-        return time.perf_counter() - t0
-
-    # Discard round: the first full-length timed pass after compile absorbs
-    # one-time upload/init effects (observed as negative marginals otherwise).
-    for fn in paths.values():
-        timed(fn, n1)
-
-    rates = {k: [] for k in paths}
-    for rep in range(reps):
-        # Interleave across paths at both lengths within each rep.
-        t1 = {k: timed(fn, n1) for k, fn in paths.items()}
-        t2 = {k: timed(fn, n2) for k, fn in paths.items()}
-        for k in paths:
-            per_pass = (t2[k] - t1[k]) / (n2 - n1)
-            rates[k].append(size * size * T / per_pass)
-        log(f"  rep {rep}: " + ", ".join(
-            f"{k}={rates[k][-1] / 1e12:.2f}T" for k in paths))
-
-    med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
-    out = {
-        "size": size,
-        "reps": reps,
-        "chain_lengths": [n1, n2],
-        "cells_per_s": {k: [round(r, 0) for r in v] for k, v in rates.items()},
-        "ratio_rows": round(med["rows"] / med["single"], 4),
-        "ratio_2d": round(med["split2d"] / med["single"], 4),
-        "single_median_cells_per_s": round(med["single"], 0),
-    }
-    if trace:
-        dt = {k: _device_time_per_pass(fn, words, n1) for k, fn in paths.items()}
-        if all(v is not None for v in dt.values()):
-            out["device_ms_per_pass"] = {k: round(v, 3) for k, v in dt.items()}
-            out["device_ratio_rows"] = round(dt["single"] / dt["rows"], 4)
-            out["device_ratio_2d"] = round(dt["single"] / dt["split2d"], 4)
-        else:
-            out["device_ms_per_pass"] = None
-    return out
-
-
-def compare(size: int, sessions: int = 5) -> None:
-    """Run `sessions` fresh-process sessions; publish medians + full series."""
-    results = []
-    for i in range(sessions):
-        log(f"session {i + 1}/{sessions} (size {size})")
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "session", str(size)],
-            capture_output=True, text=True, cwd=REPO, timeout=3600,
-        )
-        if proc.returncode != 0:
-            log(f"  session failed: {proc.stderr[-800:]}")
-            continue
-        line = proc.stdout.strip().splitlines()[-1]
-        results.append(json.loads(line))
-        log(f"  ratios: rows={results[-1]['ratio_rows']} "
-            f"2d={results[-1]['ratio_2d']}")
-    if not results:
-        raise SystemExit("no session succeeded")
-    ratios_rows = sorted(r["ratio_rows"] for r in results)
-    ratios_2d = sorted(r["ratio_2d"] for r in results)
-    payload = {
-        "protocol": "interleaved chained marginals; median across fresh-process "
-                    "sessions (see benchmarks/README.md, r4 protocol)",
-        "size": size,
-        "sessions": results,
-        "runs_rows_ratio": ratios_rows,
-        "runs_2d_ratio": ratios_2d,
-        "rows_ratio_median": ratios_rows[len(ratios_rows) // 2],
-        "2d_ratio_median": ratios_2d[len(ratios_2d) // 2],
-    }
-    path = os.path.join(OUT, f"compare_{size}_r4.json")
-    with open(path, "w") as f:
-        json.dump(payload, f, indent=1)
-        f.write("\n")
-    log("wrote", path)
-
-
-def podshard_session() -> dict:
-    """BASELINE config 5's per-chip shard both ways, one interleaved session:
-    16x1 rows-only -> a (4096, 65536) shard; 4x4 2D -> a (16384, 16384)
-    shard. Plus the single-chip temporal rate on the SAME (4096, 65536)
-    array as the shared denominator."""
-    import jax
-    import jax.numpy as jnp
-
-    from gol_tpu.ops import stencil_packed as sp
-    from gol_tpu.parallel.mesh import PROXY_2D, SINGLE_DEVICE
-
-    assert jax.default_backend() == "tpu"
-    T = sp.TEMPORAL_GENS
-    shard_16x1 = jnp.asarray(_host_words(4096, 65536))
-    shard_4x4 = jnp.asarray(_host_words(16384, 16384, seed=43))
-
-    def chain(step):
-        def fn(w, n):
-            return jax.lax.fori_loop(0, n, lambda i, x: step(x), w)
-        return jax.jit(fn, static_argnums=1)
-
-    runs = {
-        "single_ref": (chain(lambda w: sp._step_t(w)[0]), shard_16x1),
-        "rows_16x1": (
-            chain(lambda w: sp._distributed_step_multi(w, SINGLE_DEVICE)[0]),
-            shard_16x1,
-        ),
-        "split2d_4x4": (
-            chain(lambda w: sp._distributed_step_multi(w, PROXY_2D)[0]),
-            shard_4x4,
-        ),
-    }
-    n1, n2 = 25, 100
-    for name, (fn, w) in runs.items():
-        t0 = time.time()
-        _force(fn(w, 2))
-        log(f"  warm {name}: {time.time() - t0:.0f}s")
-    for fn, w in runs.values():  # discard round (see session())
-        _force(fn(w, n1))
-    rates = {k: [] for k in runs}
-    for rep in range(3):
-        t1 = {k: None for k in runs}
-        t2 = {k: None for k in runs}
-        for k, (fn, w) in runs.items():
-            t0 = time.perf_counter(); _force(fn(w, n1)); t1[k] = time.perf_counter() - t0
-        for k, (fn, w) in runs.items():
-            t0 = time.perf_counter(); _force(fn(w, n2)); t2[k] = time.perf_counter() - t0
-        for k in runs:
-            per_pass = (t2[k] - t1[k]) / (n2 - n1)
-            cells = 4096 * 65536  # both shards are the same cell count
-            rates[k].append(cells * T / per_pass)
-        log(f"  rep {rep}: " + ", ".join(f"{k}={rates[k][-1]/1e12:.2f}T" for k in runs))
-    med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
-    return {
-        "cells_per_s": {k: [round(x) for x in v] for k, v in rates.items()},
-        "ratio_rows_16x1": round(med["rows_16x1"] / med["single_ref"], 4),
-        "ratio_split2d_4x4": round(med["split2d_4x4"] / med["single_ref"], 4),
-        "single_ref_cells_per_s": round(med["single_ref"]),
-    }
-
-
-def podshard(sessions: int = 5) -> None:
-    results = []
-    for i in range(sessions):
-        log(f"podshard session {i + 1}/{sessions}")
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "podshard-session"],
-            capture_output=True, text=True, cwd=REPO, timeout=3600,
-        )
-        if proc.returncode != 0:
-            log(f"  session failed: {proc.stderr[-800:]}")
-            continue
-        results.append(json.loads(proc.stdout.strip().splitlines()[-1]))
-        log(f"  ratios: 16x1={results[-1]['ratio_rows_16x1']} "
-            f"4x4={results[-1]['ratio_split2d_4x4']}")
-    if not results:
-        raise SystemExit("no session succeeded")
-    r16 = sorted(r["ratio_rows_16x1"] for r in results)
-    r44 = sorted(r["ratio_split2d_4x4"] for r in results)
-    payload = {
-        "what": "BASELINE config 5 (65536^2 on 16 chips) per-chip shard, both "
-                "meshes, one chip with local wraps standing in for ICI "
-                "ppermutes; ratios vs the single-chip temporal rate on the "
-                "same cell count",
-        "sessions": results,
-        "ratio_16x1_runs": r16,
-        "ratio_4x4_runs": r44,
-        "ratio_16x1_median": r16[len(r16) // 2],
-        "ratio_4x4_median": r44[len(r44) // 2],
-    }
-    path = os.path.join(OUT, "configs_r4.json")
-    with open(path, "w") as f:
-        json.dump(payload, f, indent=1)
-        f.write("\n")
-    log("wrote", path)
-
-
-def main() -> None:
-    cmd = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if cmd == "session":
-        print(json.dumps(session(int(sys.argv[2]))))
-    elif cmd == "podshard-session":
-        print(json.dumps(podshard_session()))
-    elif cmd == "compare":
-        compare(int(sys.argv[2]), int(sys.argv[3]) if len(sys.argv) > 3 else 5)
-    elif cmd == "podshard":
-        podshard(int(sys.argv[2]) if len(sys.argv) > 2 else 5)
-    elif cmd == "all":
-        compare(16384)
-        compare(32768)
-        podshard()
-    else:
-        raise SystemExit(f"unknown subcommand {cmd}")
-
+from measure import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["--rev", "4", *sys.argv[1:]]))

@@ -1,0 +1,129 @@
+"""Tune-subsystem smoke: search -> persist -> reload -> oracle-exact replay.
+
+The `make tune-smoke` gate. On CPU, with a tiny search space, it drives the
+whole autotune loop end to end and fails loudly if any link breaks:
+
+1. **search** — `gol tune --quick` over a small grid (both conventions) and
+   the serve geometry, every candidate byte-gated in-process;
+2. **persist** — plans land in a throwaway cache file (atomic write path);
+3. **reload** — a FRESH process (`gol run` with GOL_PLAN_CACHE pointing at
+   the cache) consults the plan, logs the tuned selection, and its output
+   file byte-matches `--host` (the NumPy oracle) on the same input — i.e.
+   the selected plan *reproduces oracle output*, not just "runs";
+4. **no-plan identity** — the same run against an empty cache produces the
+   same bytes (plans are performance-only by construction).
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SIZE = 48
+GENS = 40
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run(args, env, cwd, check=True):
+    proc = subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *args],
+        capture_output=True, text=True, cwd=cwd, env=env, timeout=600,
+    )
+    if check and proc.returncode != 0:
+        log(f"FAIL: gol {' '.join(args)} -> rc {proc.returncode}")
+        log(proc.stdout[-2000:])
+        log(proc.stderr[-2000:])
+        raise SystemExit(1)
+    return proc
+
+
+def main() -> int:
+    td = tempfile.mkdtemp(prefix="gol_tune_smoke_")
+    cache = os.path.join(td, "plans.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["GOL_PLAN_CACHE"] = cache
+
+    inp = os.path.join(td, "input.txt")
+    run(["generate", str(SIZE), str(SIZE), "--seed", "7", "-o", inp], env, td)
+
+    log(f"[1/4] search (quick, {SIZE}x{SIZE}, both conventions + serve)")
+    run(
+        ["tune", "--shape", f"{SIZE}x{SIZE}", "--convention", "both",
+         "--gen-limit", "24", "--iters", "3", "--quick",
+         "--serve-board", f"{SIZE}x{SIZE}",
+         "--report", os.path.join(td, "report.md")],
+        env, td,
+    )
+
+    log("[2/4] persist: cache file parses and holds plans")
+    with open(cache, encoding="utf-8") as f:
+        body = json.load(f)
+    kinds = sorted(
+        key.split("kind=")[1].split("|")[0] for key in body["plans"]
+    )
+    if kinds.count("engine") != 2 or "serve" not in kinds:
+        log(f"FAIL: expected 2 engine plans + 1 serve plan, got keys {kinds}")
+        return 1
+    log(f"  {len(body['plans'])} plan(s) persisted")
+
+    log("[3/4] reload: fresh process consults the plan, output == oracle")
+    for variant, conv in (("tpu", "c"), ("cuda", "cuda")):
+        tuned_out = os.path.join(td, f"tuned_{conv}.out")
+        proc = run(
+            [str(SIZE), str(SIZE), inp, "--variant", variant,
+             "--gen-limit", str(GENS), "--output", tuned_out],
+            env, td,
+        )
+        if "tuned engine plan" not in proc.stderr:
+            log(f"FAIL: {conv}: no 'tuned engine plan' consult logged\n"
+                f"{proc.stderr[-800:]}")
+            return 1
+        oracle_out = os.path.join(td, f"oracle_{conv}.out")
+        host_variant = "game" if variant == "tpu" else variant
+        run(
+            [str(SIZE), str(SIZE), inp, "--variant", host_variant, "--host",
+             "--gen-limit", str(GENS), "--output", oracle_out],
+            env, td,
+        )
+        with open(tuned_out, "rb") as f1, open(oracle_out, "rb") as f2:
+            if f1.read() != f2.read():
+                log(f"FAIL: {conv}: tuned output differs from the oracle")
+                return 1
+        log(f"  {conv}: tuned plan reproduces oracle output")
+
+    log("[4/4] no-plan identity: empty cache produces identical bytes")
+    env_empty = dict(env)
+    env_empty["GOL_PLAN_CACHE"] = os.path.join(td, "missing", "plans.json")
+    for conv, variant in (("c", "tpu"), ("cuda", "cuda")):
+        plain_out = os.path.join(td, f"plain_{conv}.out")
+        proc = run(
+            [str(SIZE), str(SIZE), inp, "--variant", variant,
+             "--gen-limit", str(GENS), "--output", plain_out],
+            env_empty, td,
+        )
+        if "tuned engine plan" in proc.stderr:
+            log(f"FAIL: {conv}: consult hit with an empty cache")
+            return 1
+        with open(plain_out, "rb") as f1, \
+                open(os.path.join(td, f"tuned_{conv}.out"), "rb") as f2:
+            if f1.read() != f2.read():
+                log(f"FAIL: {conv}: tuned and un-tuned outputs differ")
+                return 1
+    log("tune-smoke PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
