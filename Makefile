@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench serve-smoke tune-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench serve-smoke tune-smoke obs-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -33,6 +33,13 @@ serve-smoke:
 # output byte-matches the NumPy oracle (empty-cache runs stay byte-identical).
 tune-smoke:
 	python3 tools/tune_smoke.py
+
+# Observability smoke (tools/obs_smoke.py): a traced run is crashed by a
+# fault plan, the flight-recorder JSONL must land and parse, `gol
+# trace-report` must render it, and a clean traced run must export
+# well-formed Chrome trace JSON.
+obs-smoke:
+	python3 tools/obs_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

@@ -39,6 +39,20 @@ def _honor_platform_env() -> None:
 TARGET_CELL_UPDATES_PER_SEC_PER_CHIP = 1e11  # BASELINE.md north star
 
 
+def _env_stamp(mesh: str | None = None) -> dict:
+    """Environment stamp for every emitted JSON artifact: jax version,
+    device kind, mesh shape — the same fields the tuner's plan fingerprints
+    bake in (gol_tpu/tune/plans.py), so a bench number can always be matched
+    to the software/hardware context that produced it."""
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "device_kind": jax.devices()[0].device_kind,
+        "mesh": mesh or "1x1",
+    }
+
+
 def resolve_kernel_name(requested: str | None, size: int, mesh) -> str:
     if requested:
         return requested
@@ -163,6 +177,7 @@ def _bench_halo(args) -> int:
                 "vs_baseline": None,
                 "deep_packed_exchange_p50_us": deep_p50,
                 "deep_exchange_feeds_generations": sp.TEMPORAL_GENS,
+                "env": _env_stamp(f"{topo.shape[0]}x{topo.shape[1]}"),
             }
         )
     )
@@ -254,6 +269,7 @@ def _bench_batch(args) -> int:
                 "boards": nboards,
                 "gen_limit": args.gen_limit,
                 "bucket": key.label(),
+                "env": _env_stamp(),
             }
         )
     )
@@ -380,6 +396,7 @@ def _bench_compare(args) -> int:
                 "detail": {k: v for k, v in sorted(results.items())},
                 "size": size,
                 "generations": [g1, g2],
+                "env": _env_stamp(),
             }
         )
     )
@@ -452,6 +469,7 @@ def _bench_tune(args) -> int:
         "strictly_faster_somewhere": any(s > 1.0 for s in speedups),
         "all_candidates_passed_gate": gates_ok,
         "gen_limit": gen_limit,
+        "env": _env_stamp(),
         "searches": records,
     }
     artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -759,6 +777,7 @@ def main(argv: list[str] | None = None) -> int:
                 "chips": n_chips,
                 # The post-compile (ladder-settled) kernel actually measured.
                 "kernel": kernel,
+                "env": _env_stamp(args.mesh),
             }
         )
     )

@@ -46,6 +46,7 @@ honor_platform_env()
 
 from gol_tpu import engine, oracle
 from gol_tpu.config import DEFAULT_HEIGHT, DEFAULT_WIDTH, GameConfig
+from gol_tpu.obs import trace as obs_trace
 from gol_tpu.io import sharded, text_grid
 from gol_tpu.variants import VARIANTS, Variant, get_variant
 
@@ -287,7 +288,8 @@ def _run(args) -> int:
     _warn_if_huge_byte_lane(width, height, mesh)
 
     t0 = time.perf_counter()
-    device_grid = _read_phase(variant, args.input_file, width, height, mesh)
+    with obs_trace.span("cli.read_phase", file=args.input_file):
+        device_grid = _read_phase(variant, args.input_file, width, height, mesh)
     read_ms = (time.perf_counter() - t0) * 1000
     if variant.io_timings:
         print(f"Reading file:\t{read_ms:.2f} msecs")
@@ -315,9 +317,10 @@ def _run(args) -> int:
             return final, int(gen)  # int() blocks until the loop finishes
 
     with _profile_trace(args.profile):
-        t0 = time.perf_counter()
-        final, generations = run_fn()
-        exec_ms = (time.perf_counter() - t0) * 1000
+        with obs_trace.span("cli.execution"):
+            t0 = time.perf_counter()
+            final, generations = run_fn()
+            exec_ms = (time.perf_counter() - t0) * 1000
 
     return _report_and_write(
         variant,
@@ -335,7 +338,8 @@ def _report_and_write(variant, generations, exec_ms, write_fn) -> int:
     print(f"Generations:\t{generations}")
     print(f"Execution time:\t{exec_ms:.2f} msecs")
     t0 = time.perf_counter()
-    write_fn()
+    with obs_trace.span("cli.write_phase"):
+        write_fn()
     write_ms = (time.perf_counter() - t0) * 1000
     if variant.io_timings:
         print(f"Writing file:\t{write_ms:.2f} msecs")
@@ -352,14 +356,15 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
     from gol_tpu.io import packed_io
 
     t0 = time.perf_counter()
-    if args.input_file.endswith(".zarr"):
-        # A TensorStore snapshot (gen_NNNNNN.zarr) resumes directly on the
-        # packed lane — the object-store counterpart of text resume.
-        from gol_tpu.io import ts_store
+    with obs_trace.span("cli.read_phase", file=args.input_file):
+        if args.input_file.endswith(".zarr"):
+            # A TensorStore snapshot (gen_NNNNNN.zarr) resumes directly on
+            # the packed lane — the object-store counterpart of text resume.
+            from gol_tpu.io import ts_store
 
-        words = ts_store.read_words(args.input_file, width, height, mesh)
-    else:
-        words = packed_io.read_packed(args.input_file, width, height, mesh)
+            words = ts_store.read_words(args.input_file, width, height, mesh)
+        else:
+            words = packed_io.read_packed(args.input_file, width, height, mesh)
     read_ms = (time.perf_counter() - t0) * 1000
     if variant.io_timings:
         print(f"Reading file:\t{read_ms:.2f} msecs")
@@ -384,9 +389,10 @@ def _run_packed_io(args, variant, config, width, height, output_path, mesh) -> i
             return final, int(gen)
 
     with _profile_trace(args.profile):
-        t0 = time.perf_counter()
-        final, generations = run_fn()
-        exec_ms = (time.perf_counter() - t0) * 1000
+        with obs_trace.span("cli.execution"):
+            t0 = time.perf_counter()
+            final, generations = run_fn()
+            exec_ms = (time.perf_counter() - t0) * 1000
 
     return _report_and_write(
         variant,
@@ -566,14 +572,42 @@ def _prepare_checkpointed(args, variant, config, mesh, state, height, width, *,
 
 def _profile_trace(profile_dir: str | None):
     """jax.profiler trace capture — the rich counterpart of the reference's
-    three coarse phase timers (SURVEY.md §5 tracing)."""
-    if not profile_dir:
-        import contextlib
+    three coarse phase timers (SURVEY.md §5 tracing).
 
-        return contextlib.nullcontext()
-    import jax
+    Rides obs.profiler.capture: start failures degrade to an unprofiled run
+    (a run that exits on generation 0 — empty input — must not die because
+    the profiler had nothing to capture), and a body that crashes
+    mid-capture stops the profiler and sweeps the torn trace directory
+    instead of leaving it looking like evidence."""
+    from gol_tpu.obs import profiler
 
-    return jax.profiler.trace(profile_dir)
+    return profiler.capture(profile_dir)
+
+
+def _arm_observability(trace_dir: str | None):
+    """``--trace DIR``: enable span tracing and the flight recorder.
+
+    Returns an export thunk ``main`` calls when the lane ends (clean,
+    error return, or crash unwind) — the Chrome trace JSON lands in DIR
+    (open in Perfetto / chrome://tracing). A crash additionally gets the
+    flight recorder's JSONL dump (same DIR, written at the injection/
+    excepthook moment, so it exists even when the export can't run), and
+    `gol trace-report` renders both artifact kinds."""
+    if not trace_dir:
+        return lambda: None
+    from gol_tpu.obs import recorder, trace
+
+    os.makedirs(trace_dir, exist_ok=True)
+    trace.enable()
+    recorder.install(trace_dir)
+
+    def export():
+        path = os.path.join(trace_dir, f"trace-{os.getpid()}.json")
+        trace.export_chrome(path)
+        print(f"trace -> {path}", file=sys.stderr)
+        return path
+
+    return export
 
 
 def _snapshot_loop(args, config, runner, state0, segments, write_snapshot,
@@ -1087,6 +1121,19 @@ def _batch(args) -> int:
     return 0
 
 
+def _trace_report(args) -> int:
+    """``gol trace-report``: render the summary of a trace artifact.
+
+    Accepts both formats the obs subsystem writes — the Chrome trace JSON a
+    ``--trace DIR`` run exports, and the flight-recorder JSONL a crash (or
+    SIGUSR1) dumps — so the same command answers "where did the time go"
+    and "what was it doing when it died"."""
+    from gol_tpu.obs import report
+
+    sys.stdout.write(report.render(args.trace_file))
+    return 0
+
+
 def _generate(args) -> int:
     if args.output:
         # Streamed: north-star-sized grids (65536^2 = 4 GB of text) generate
@@ -1143,7 +1190,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--profile",
         default=None,
         metavar="DIR",
-        help="capture a jax.profiler trace of the run into DIR",
+        help="capture a jax.profiler trace of the run into DIR (start/stop "
+        "guarded: a run with nothing to capture proceeds unprofiled, a "
+        "crashed run never leaves a torn trace directory)",
+    )
+    run.add_argument(
+        "--trace", default=None, metavar="DIR",
+        help="span tracing + flight recorder (gol_tpu/obs): phase/engine "
+        "spans export to DIR as Chrome trace JSON when the run ends; a "
+        "crash additionally dumps the last spans as flight-*.jsonl at the "
+        "moment of death; SIGUSR1 dumps live. Summarize either file with "
+        "`gol trace-report`",
     )
     run.add_argument(
         "--snapshot-every",
@@ -1288,6 +1345,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="persist XLA/Mosaic compiles in DIR (JAX persistent "
         "compilation cache): restarted servers skip recompilation",
     )
+    srv.add_argument(
+        "--trace", default=None, metavar="DIR",
+        help="span tracing + flight recorder: per-batch spans (one per "
+        "dispatched bucket batch) export to DIR as Chrome trace JSON on "
+        "shutdown; GET /debug/trace snapshots them live; crashes dump "
+        "flight-*.jsonl; SIGUSR1 dumps without stopping the server",
+    )
     srv.set_defaults(func=_serve)
 
     tun = sub.add_parser(
@@ -1342,7 +1406,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--compile-cache", default=None, metavar="DIR",
         help="persist XLA/Mosaic compiles in DIR while searching",
     )
+    tun.add_argument(
+        "--trace", default=None, metavar="DIR",
+        help="span tracing + flight recorder: per-trial events export to "
+        "DIR as Chrome trace JSON when the search ends (SIGUSR1 dumps a "
+        "long search's progress live)",
+    )
     tun.set_defaults(func=_tune)
+
+    rpt = sub.add_parser(
+        "trace-report",
+        help="summarize a trace file (Chrome trace JSON from --trace, or a "
+        "flight-recorder JSONL dump): per-phase p50/p95, span tree, gap "
+        "analysis",
+    )
+    rpt.add_argument("trace_file", help="trace-*.json or flight-*.jsonl")
+    rpt.set_defaults(func=_trace_report)
 
     sbm = sub.add_parser(
         "submit", help="submit jobs to a running gol serve and fetch results"
@@ -1399,15 +1478,29 @@ def main(argv: list[str] | None = None) -> int:
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in (
         "run", "generate", "show", "serve", "submit", "batch", "tune",
-        "-h", "--help"
+        "trace-report", "-h", "--help"
     ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)
+    # --trace DIR (run/serve/tune): span tracing + flight recorder armed
+    # before the lane starts; the Chrome trace exports when the lane ends
+    # (including error returns and crash unwinds — a failed run's trace is
+    # evidence). Arming happens INSIDE the try so a bad --trace path (a
+    # file, an unwritable parent) gets the CLI's `gol: <error>` contract.
+    export_trace = lambda: None  # noqa: E731 - replaced once arming succeeds
     try:
+        export_trace = _arm_observability(getattr(args, "trace", None))
         return args.func(args)
     except (ValueError, OSError) as e:
         print(f"gol: {e}", file=sys.stderr)
         return 1
+    finally:
+        try:
+            export_trace()
+        except OSError as e:
+            # A failed export (dir deleted mid-run, disk full) must not
+            # mask the lane's result or crash a successful run.
+            print(f"gol: trace export failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.config import Convention, DEFAULT_CONFIG, GameConfig
+from gol_tpu.obs import registry as obs_registry, trace as obs_trace
 from gol_tpu.resilience.retry import RetryPolicy
 from gol_tpu.ops import (
     Kernel,
@@ -635,9 +636,10 @@ def compile_runner(runner, *args):
 
     Plain jitted runners compile strictly; ladder runners demote on compile
     failure exactly as their first call would."""
-    if isinstance(runner, _KernelFallback):
-        return runner.compile_aot(*args)
-    return runner.lower(*args).compile()
+    with obs_trace.span("engine.compile"):
+        if isinstance(runner, _KernelFallback):
+            return runner.compile_aot(*args)
+        return runner.lower(*args).compile()
 
 
 def _apply_plan(tuned, kernel_obj, local_h, local_w, topology, packed_state):
@@ -918,10 +920,17 @@ def _iter_segments(runner, state, config: GameConfig, segment: int, completed: i
     gen, counter = resume_scalars(config, completed)
     while True:
         seg_end = gen + segment - (1 if config.convention == Convention.C else 0)
-        state, gen_a, counter_a, stopped_a = runner(
-            state, jnp.int32(gen), jnp.int32(counter), jnp.int32(seg_end)
-        )
-        gen, counter, stopped = int(gen_a), int(counter_a), bool(stopped_a)
+        with obs_trace.span("engine.segment", gen0=gen, seg_end=seg_end):
+            prev = gen
+            state, gen_a, counter_a, stopped_a = runner(
+                state, jnp.int32(gen), jnp.int32(counter), jnp.int32(seg_end)
+            )
+            # int() blocks until the segment finishes, so the span's duration
+            # is device time, not enqueue time.
+            gen, counter, stopped = int(gen_a), int(counter_a), bool(stopped_a)
+        reg = obs_registry.default()
+        reg.inc("engine_segments_total")
+        reg.inc("engine_generations_total", max(0, gen - prev))
         yield report(gen), state, stopped
         if stopped:
             return
@@ -993,8 +1002,15 @@ def simulate(
     validate_grid(shape[0], shape[1], topology_for(mesh))
     device_grid = grid if isinstance(grid, jax.Array) else put_grid(grid, mesh)
     runner = make_runner(shape, config, mesh, kernel)
-    final, gen = runner(device_grid)
-    return EngineResult(np.asarray(jax.device_get(final), dtype=np.uint8), int(gen))
+    with obs_trace.span("engine.simulate", shape=f"{shape[0]}x{shape[1]}",
+                        convention=config.convention):
+        final, gen = runner(device_grid)
+        generations = int(gen)  # blocks: the span measures the run, not enqueue
+    reg = obs_registry.default()
+    reg.inc("engine_runs_total")
+    reg.inc("engine_generations_total", generations)
+    return EngineResult(np.asarray(jax.device_get(final), dtype=np.uint8),
+                        generations)
 
 
 # ---------------------------------------------------------------------------
@@ -1340,16 +1356,22 @@ def simulate_batch(
         head.check_similarity, head.similarity_frequency, mode,
     )
     operand = _pack_board_words(stacked) if mode == "packed" else stacked
-    finals, gens, reasons = runner(
-        jnp.asarray(operand), jnp.asarray(h_arr), jnp.asarray(w_arr),
-        jnp.asarray(limits),
-    )
-    finals = np.asarray(jax.device_get(finals))
+    with obs_trace.span("engine.simulate_batch", boards=b, slots=total,
+                        canvas=f"{ph}x{pw}", mode=mode):
+        finals, gens, reasons = runner(
+            jnp.asarray(operand), jnp.asarray(h_arr), jnp.asarray(w_arr),
+            jnp.asarray(limits),
+        )
+        finals = np.asarray(jax.device_get(finals))
     if mode == "packed":
         finals = _unpack_board_words(finals)
     finals = np.asarray(finals, dtype=np.uint8)
     gens = np.asarray(jax.device_get(gens))
     reasons = np.asarray(jax.device_get(reasons))
+    reg = obs_registry.default()
+    reg.inc("engine_batches_total")
+    reg.inc("engine_boards_total", b)
+    reg.inc("engine_generations_total", int(gens[:b].sum()))
     return [
         BatchBoardResult(
             grid=finals[i, : heights[i], : widths[i]].copy(),

@@ -1,0 +1,27 @@
+"""gol_tpu.obs — the cross-cutting observability subsystem.
+
+The reference's entire story is three phase printfs and four numbers from
+rank 0 (src/game.c, include/timestamp.h). This package is the
+production-scale replacement, consumed by the engine, resilience, serve,
+tune, and the CLI:
+
+- ``obs.trace``    — span-based structured tracing (ring buffer, Chrome
+                     trace export; off by default, zero-allocation when
+                     disabled);
+- ``obs.registry`` — the one counter/gauge/histogram registry
+                     (serve/metrics.py is a façade over it; engine /
+                     checkpoint / retry / tuner / halo feed the process
+                     default);
+- ``obs.recorder`` — flight recorder: last-N-spans JSONL dumps on crash,
+                     fault-injection trigger, and SIGUSR1;
+- ``obs.profiler`` — device-fenced phase timing + guarded jax.profiler
+                     capture (the one implementation behind ``--profile``);
+- ``obs.report``   — ``gol trace-report`` rendering.
+
+Stdlib-only at import time (jax loads lazily inside ``profiler.capture``),
+so arming observability never reorders backend initialization.
+"""
+
+from gol_tpu.obs import profiler, recorder, registry, report, trace  # noqa: F401
+
+__all__ = ["profiler", "recorder", "registry", "report", "trace"]

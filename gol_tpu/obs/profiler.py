@@ -1,0 +1,143 @@
+"""Per-phase device timing and guarded ``jax.profiler`` capture.
+
+Two jobs, previously reimplemented separately by ``--profile DIR`` in the
+CLI and by tools/measure.py's xprof path:
+
+- ``device_phase(name)`` — a span (obs/trace.py) whose end is an explicit
+  device **fence**: JAX dispatch is async, so a bare ``perf_counter`` pair
+  around a dispatched call times the *enqueue*, not the work. The phase's
+  exit blocks on the values handed to ``fence()`` (``block_until_ready``
+  where available, scalar readback otherwise — the only dependable barrier
+  over remote-attach tunnels, tools/measure.py's hard-won rule) before the
+  span closes, so the recorded duration is the device time the reference's
+  phase printfs *meant* to measure.
+
+- ``capture(dir)`` — ``jax.profiler`` trace capture with guarded start AND
+  stop. The raw ``jax.profiler.trace`` context the CLI used let two
+  failure shapes leak to users: a start that throws (no device work yet,
+  profiler backend unavailable) killed an otherwise-fine run, and a body
+  that crashed mid-capture left a torn trace directory that looks like
+  evidence but loads as garbage. Here, a failing start logs and the run
+  proceeds unprofiled; a crashing body stops the profiler and sweeps the
+  partial capture away before re-raising. ``--profile DIR`` and the tuner
+  both ride this one implementation now.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import shutil
+
+from gol_tpu.obs import trace
+
+logger = logging.getLogger(__name__)
+
+
+def fence(*values) -> None:
+    """Block until every ``value``'s device computation is done.
+
+    Accepts jax arrays, numpy arrays, scalars, or (nested) tuples/lists —
+    anything a runner returns. Non-device values are already 'ready'.
+    """
+    for value in values:
+        if isinstance(value, (tuple, list)):
+            fence(*value)
+        elif hasattr(value, "block_until_ready"):
+            value.block_until_ready()
+
+
+@contextlib.contextmanager
+def device_phase(name: str, **attrs):
+    """``with device_phase("execution") as ph: ...; ph.fence(out)`` — a
+    traced span closed behind an explicit device fence. The yielded handle's
+    ``fence(*values)`` may be called any number of times (including zero,
+    for host-only phases); the LAST device sync before the span ends is what
+    the duration reflects."""
+
+    class _Phase:
+        fence = staticmethod(fence)
+
+    with trace.span(name, **attrs):
+        yield _Phase()
+
+
+@contextlib.contextmanager
+def capture(profile_dir: str | None):
+    """Guarded ``jax.profiler`` capture into ``profile_dir``.
+
+    No-op when ``profile_dir`` is falsy (callers pass their ``--profile``
+    flag through unconditionally). Yields True when capture actually
+    started. Guarantees:
+
+    - a failing ``start_trace`` (profiler backend unavailable, zero device
+      work, double-start) degrades to an unprofiled run with a loud log —
+      never a crashed one;
+    - stop runs exactly once, even when the profiled body raises;
+    - a body that raises mid-capture does not leave a torn trace directory
+      behind: the partial capture is stopped and swept, because a
+      half-written xplane that loads as an empty/garbage profile is worse
+      evidence than no directory at all.
+    """
+    if not profile_dir:
+        yield False
+        return
+    import jax
+
+    # Entries already present (an operator pointing several runs at one
+    # parent dir) are not ours to sweep on failure.
+    preexisting = set()
+    if os.path.isdir(profile_dir):
+        preexisting = set(os.listdir(profile_dir))
+    started = False
+    try:
+        jax.profiler.start_trace(profile_dir)
+        started = True
+    except Exception as err:  # noqa: BLE001 - profiling is best-effort
+        logger.warning(
+            "profiler capture into %s failed to start (%s: %s); "
+            "running unprofiled", profile_dir, type(err).__name__, err,
+        )
+    try:
+        yield started
+    except BaseException:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 - already on the error path
+                pass
+            _sweep_partial(profile_dir, preexisting)
+        raise
+    if started:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as err:  # noqa: BLE001 - capture is best-effort
+            logger.warning(
+                "profiler capture into %s failed to stop cleanly "
+                "(%s: %s); the trace may be incomplete",
+                profile_dir, type(err).__name__, err,
+            )
+            _sweep_partial(profile_dir, preexisting)
+
+
+def _sweep_partial(profile_dir: str, preexisting: set) -> None:
+    """Remove capture entries created by a failed capture (and the directory
+    itself when the failed capture was its only content)."""
+    try:
+        for name in os.listdir(profile_dir):
+            if name in preexisting:
+                continue
+            path = os.path.join(profile_dir, name)
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        if not preexisting and not os.listdir(profile_dir):
+            os.rmdir(profile_dir)
+        logger.warning("profiler: swept torn capture from %s", profile_dir)
+    except OSError:
+        pass

@@ -1,0 +1,166 @@
+"""General counter/gauge/histogram registry — the tree's ONE metrics core.
+
+PR 2 grew a metrics registry inside ``gol_tpu/serve/metrics.py``; by PR 3 the
+engine, the checkpoint protocol, the retry policy, and the tuner each had
+numbers worth counting and nowhere to put them. This module hoists the
+registry out of the serving package so every layer feeds the same machinery:
+
+- ``Registry`` — named counters, gauges, and bounded-reservoir histograms,
+  thread-safe, exportable as a JSON snapshot or Prometheus text.
+  ``gol_tpu/serve/metrics.Metrics`` is now a thin façade over it (same
+  classes, same output bytes — the serving contracts are pinned by
+  tests/test_serve.py).
+- ``default()`` — the process-global registry. Library layers record here:
+  engine run/board/generation counts, checkpoint save/restore outcomes,
+  retry attempts, tuner trials, and trace-time halo-exchange volume. The
+  flight recorder (obs/recorder.py) and ``GET /debug/trace`` snapshot it,
+  so a post-mortem dump carries the process's counters alongside its spans.
+- ``quantile`` / ``median`` — the single copy of the nearest-rank percentile
+  math. The serving histograms' p50/p95/p99 and tools/measure.py's
+  median-across-sessions both route through here (byte-stable: the code
+  moved, the rules did not — ``quantile`` keeps the serving round-based
+  nearest rank, ``median`` keeps the measurement protocol's upper median).
+
+Latency sources are ``time.perf_counter()`` exclusively; the wall clock is
+banned from this package by tests/test_lint.py (as it is from serve/ and
+tune/) — a clock that steps under NTP turns a p99 into fiction.
+
+Stdlib-only on purpose: ``resilience/retry.py`` (imported before the
+jax-heavy modules, including in subprocesses that must start fast) records
+retry attempts here at module load.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+# Quantiles exported for every histogram (the serving contract).
+QUANTILES = (0.5, 0.95, 0.99)
+
+_RESERVOIR = 2048  # samples kept per histogram (most recent)
+
+
+def quantile(samples, q: float) -> float | None:
+    """Nearest-rank quantile over ``samples`` (round-based, the serving
+    histograms' rule since PR 2 — moved here verbatim so /metrics output is
+    byte-stable). Returns None on an empty sample set."""
+    ordered = sorted(samples)
+    if not ordered:
+        return None
+    idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def median(samples) -> float:
+    """The measurement protocol's median: ``sorted(v)[len(v) // 2]`` — the
+    upper median on even counts, exactly what tools/measure.py has published
+    since r4 (artifact byte-stability pins the rule; ``quantile(v, 0.5)``
+    differs on counts ≡ 2 mod 4 because ``round`` banker's-rounds)."""
+    ordered = sorted(samples)
+    if not ordered:
+        raise ValueError("no samples")
+    return ordered[len(ordered) // 2]
+
+
+class Histogram:
+    __slots__ = ("samples", "count", "total")
+
+    def __init__(self):
+        self.samples = collections.deque(maxlen=_RESERVOIR)
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, value: float) -> None:
+        self.samples.append(float(value))
+        self.count += 1
+        self.total += float(value)
+
+    def quantile(self, q: float) -> float | None:
+        # Nearest-rank on the recent reservoir (the shared rule above).
+        return quantile(self.samples, q)
+
+    def summary(self) -> dict:
+        out = {"count": self.count, "sum": self.total}
+        for q in QUANTILES:
+            out[f"p{int(q * 100)}"] = self.quantile(q)
+        return out
+
+
+class Registry:
+    """Named counters, gauges, and histograms; thread-safe."""
+
+    def __init__(self, prefix: str = "gol"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def inc(self, name: str, amount: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._hists.setdefault(name, Histogram()).observe(value)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        """Point-in-time JSON-able view of everything."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary() for k, h in self._hists.items()},
+            }
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition format (quantiles as summary series)."""
+        snap = self.snapshot()
+        p = self.prefix
+        lines: list[str] = []
+        for name, value in sorted(snap["counters"].items()):
+            lines.append(f"# TYPE {p}_{name} counter")
+            lines.append(f"{p}_{name} {_fmt(value)}")
+        for name, value in sorted(snap["gauges"].items()):
+            lines.append(f"# TYPE {p}_{name} gauge")
+            lines.append(f"{p}_{name} {_fmt(value)}")
+        for name, summary in sorted(snap["histograms"].items()):
+            lines.append(f"# TYPE {p}_{name} summary")
+            for q in QUANTILES:
+                v = summary.get(f"p{int(q * 100)}")
+                if v is not None:
+                    lines.append(f'{p}_{name}{{quantile="{q}"}} {_fmt(v)}')
+            lines.append(f"{p}_{name}_sum {_fmt(summary['sum'])}")
+            lines.append(f"{p}_{name}_count {_fmt(summary['count'])}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    # Prometheus wants plain decimal/scientific; repr of a float is both.
+    return repr(float(v)) if isinstance(v, float) and not v.is_integer() else str(int(v))
+
+
+# The process-global registry. A plain module singleton (no lazy factory):
+# recording a counter must never be more than a dict update behind a lock,
+# and every layer — engine, resilience, tune, parallel — shares this one.
+_DEFAULT = Registry(prefix="gol")
+
+
+def default() -> Registry:
+    """The process-global registry library layers record into."""
+    return _DEFAULT
+
+
+def reset_default() -> None:
+    """Fresh global registry (tests; never called by library code)."""
+    global _DEFAULT
+    _DEFAULT = Registry(prefix="gol")

@@ -1,0 +1,195 @@
+"""``gol trace-report``: summarize a trace file on the terminal.
+
+Accepts both artifacts the obs subsystem writes:
+
+- Chrome trace JSON (``trace.export_chrome`` / ``--trace DIR`` exports):
+  an object with ``traceEvents`` of ``ph:"X"`` complete events;
+- flight-recorder JSONL (``obs/recorder.py`` dumps): header / span /
+  registry records, one JSON object per line.
+
+Three views, built from the same normalized span list:
+
+- **per-phase stats** — count, total, p50, p95 per span name (the
+  percentile math is the shared ``obs.registry.quantile``, the same rule
+  the serving histograms export);
+- **span tree** — the most recent top-level span per thread with its
+  nested children, indented by depth;
+- **gap analysis** — per thread, untraced wall time between consecutive
+  top-level spans (where a run spends time *nobody* instrumented — the
+  question phase printfs can never answer).
+"""
+
+from __future__ import annotations
+
+import json
+
+from gol_tpu.obs import registry
+
+
+def load_spans(path: str) -> tuple[list[dict], dict]:
+    """Normalize a trace file into (spans, metadata).
+
+    Each span: ``{"name", "start_us", "dur_us", "tid", "depth", "attrs"}``.
+    Format is sniffed from content, not the filename: a JSON object with
+    ``traceEvents`` is a Chrome trace; otherwise the file is read as
+    flight-recorder JSONL (torn lines dropped).
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        doc = None
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        spans = [
+            {
+                "name": e.get("name", "?"),
+                "start_us": float(e.get("ts", 0.0)),
+                "dur_us": float(e.get("dur", 0.0)),
+                "tid": e.get("tid", 0),
+                "depth": (e.get("args") or {}).get("depth", 0),
+                "attrs": {k: v for k, v in (e.get("args") or {}).items()
+                          if k != "depth"},
+            }
+            for e in doc["traceEvents"]
+            if e.get("ph") == "X"
+        ]
+        return spans, dict(doc.get("otherData") or {})
+    # Flight-recorder JSONL.
+    spans, meta = [], {}
+    for line in raw.split(b"\n"):
+        if not line:
+            continue
+        try:
+            rec = json.loads(line.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            continue
+        kind = rec.get("record")
+        if kind == "header":
+            meta = {k: v for k, v in rec.items() if k != "record"}
+        elif kind == "span":
+            spans.append({
+                "name": rec.get("name", "?"),
+                "start_us": float(rec.get("start_s", 0.0)) * 1e6,
+                "dur_us": float(rec.get("duration_s", 0.0)) * 1e6,
+                "tid": rec.get("tid", 0),
+                "depth": rec.get("depth", 0),
+                "attrs": rec.get("attrs") or {},
+            })
+        elif kind == "registry":
+            meta["registry"] = {k: v for k, v in rec.items() if k != "record"}
+    spans.sort(key=lambda s: s["start_us"])
+    return spans, meta
+
+
+def _fmt_ms(us: float) -> str:
+    return f"{us / 1000:.3f}"
+
+
+def phase_table(spans: list[dict]) -> list[str]:
+    by_name: dict[str, list[float]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s["dur_us"])
+    lines = ["phase                        count   total_ms      p50_ms      p95_ms",
+             "-" * 68]
+    for name in sorted(by_name, key=lambda n: -sum(by_name[n])):
+        durs = by_name[name]
+        lines.append(
+            f"{name:<28} {len(durs):>5} {_fmt_ms(sum(durs)):>10} "
+            f"{_fmt_ms(registry.quantile(durs, 0.5)):>11} "
+            f"{_fmt_ms(registry.quantile(durs, 0.95)):>11}"
+        )
+    return lines
+
+
+def span_tree(spans: list[dict], max_roots: int = 5) -> list[str]:
+    """The newest ``max_roots`` depth-0 spans per thread, with children
+    indented under them (a child = a deeper span starting within the
+    parent's [start, start+dur) window on the same thread)."""
+    lines = []
+    by_tid: dict[int, list[dict]] = {}
+    for s in spans:
+        by_tid.setdefault(s["tid"], []).append(s)
+    for tid, tspans in sorted(by_tid.items(), key=lambda kv: str(kv[0])):
+        tspans.sort(key=lambda s: s["start_us"])
+        roots = [s for s in tspans if s["depth"] == 0][-max_roots:]
+        if not roots:
+            continue
+        lines.append(f"thread {tid}:")
+        for root in roots:
+            end = root["start_us"] + root["dur_us"]
+            members = [
+                s for s in tspans
+                if root["start_us"] <= s["start_us"] < max(end, root["start_us"] + 1)
+                and s["depth"] >= 0 and (s is root or s["depth"] > 0)
+            ]
+            for s in members:
+                attrs = ""
+                if s["attrs"]:
+                    attrs = "  " + ", ".join(
+                        f"{k}={v}" for k, v in sorted(s["attrs"].items())
+                    )
+                lines.append(
+                    f"  {'  ' * s['depth']}{s['name']} "
+                    f"{_fmt_ms(s['dur_us'])} ms{attrs}"
+                )
+    return lines
+
+
+def gap_analysis(spans: list[dict]) -> list[str]:
+    """Per thread: total traced vs untraced time between top-level spans."""
+    lines = []
+    by_tid: dict[int, list[dict]] = {}
+    for s in spans:
+        if s["depth"] == 0:
+            by_tid.setdefault(s["tid"], []).append(s)
+    for tid, roots in sorted(by_tid.items(), key=lambda kv: str(kv[0])):
+        roots.sort(key=lambda s: s["start_us"])
+        traced = sum(s["dur_us"] for s in roots)
+        gaps = []
+        for prev, cur in zip(roots, roots[1:]):
+            gap = cur["start_us"] - (prev["start_us"] + prev["dur_us"])
+            if gap > 0:
+                gaps.append(gap)
+        span_wall = (
+            roots[-1]["start_us"] + roots[-1]["dur_us"] - roots[0]["start_us"]
+        )
+        biggest = max(gaps) if gaps else 0.0
+        lines.append(
+            f"thread {tid}: {len(roots)} top-level span(s), traced "
+            f"{_fmt_ms(traced)} ms of {_fmt_ms(span_wall)} ms wall; "
+            f"untraced gaps {_fmt_ms(sum(gaps))} ms "
+            f"(largest {_fmt_ms(biggest)} ms)"
+        )
+    return lines
+
+
+def render(path: str) -> str:
+    spans, meta = load_spans(path)
+    lines = [f"# trace report: {path}", ""]
+    if meta:
+        keys = ("reason", "pid", "anchor_unix_ns", "dropped_spans")
+        shown = {k: meta[k] for k in keys if k in meta}
+        if shown:
+            lines.append("meta: " + ", ".join(f"{k}={v}" for k, v in shown.items()))
+            lines.append("")
+    if not spans:
+        lines.append("(no spans recorded)")
+        return "\n".join(lines) + "\n"
+    lines.append(f"{len(spans)} span(s)")
+    lines.append("")
+    lines.append("## per-phase")
+    lines.extend(phase_table(spans))
+    lines.append("")
+    lines.append("## span tree (newest top-level spans)")
+    lines.extend(span_tree(spans))
+    lines.append("")
+    lines.append("## gaps (untraced time between top-level spans)")
+    lines.extend(gap_analysis(spans))
+    counters = (meta.get("registry") or {}).get("counters")
+    if counters:
+        lines.append("")
+        lines.append("## registry counters at dump time")
+        for name in sorted(counters):
+            lines.append(f"  {name} = {counters[name]}")
+    return "\n".join(lines) + "\n"

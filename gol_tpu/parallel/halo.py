@@ -25,8 +25,29 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from gol_tpu.obs import registry as obs_registry
 from gol_tpu.parallel.mesh import Topology, ROW_AXIS, COL_AXIS
+
+
+def _account_exchange(*operands) -> None:
+    """Record the per-exchange wire volume in the global obs registry.
+
+    This function runs at TRACE time (the ppermutes live inside compiled
+    programs; Python never sees the executed exchanges), so the honest
+    accounting is per *traced* exchange site: a counter of sites and a
+    gauge of bytes shipped per execution of the most recently traced one.
+    Shapes/dtypes are static under tracing, so the numbers are exact.
+    """
+    reg = obs_registry.default()
+    bytes_per = sum(
+        int(np.prod(op.shape)) * np.dtype(op.dtype).itemsize
+        for op in operands
+    )
+    reg.inc("halo_exchange_sites_traced_total")
+    reg.set_gauge("halo_exchange_bytes", bytes_per)
+    reg.inc("halo_exchange_traced_bytes_total", bytes_per)
 
 
 def ring_perms(size: int) -> tuple[list, list]:
@@ -47,6 +68,7 @@ def ghost_slices(
         # Wrap is local: my own far edge is my ghost (src/game_cuda.cu:52-74).
         return last, first
     forward, backward = ring_perms(size)
+    _account_exchange(last, first)
     # Sending my last slice "forward" delivers my predecessor's last slice
     # to me: the ghost before my first row/col.
     ghost_before = jax.lax.ppermute(last, axis_name, forward)
@@ -85,8 +107,10 @@ def exchange_columns(west_col, east_col, topology: Topology, transform=None):
         return east_col, west_col
     pack, unpack = transform if transform is not None else (lambda v: v, lambda v: v)
     forward, backward = ring_perms(cols)
-    ghost_west = unpack(jax.lax.ppermute(pack(east_col), COL_AXIS, forward))
-    ghost_east = unpack(jax.lax.ppermute(pack(west_col), COL_AXIS, backward))
+    east_wire, west_wire = pack(east_col), pack(west_col)
+    _account_exchange(east_wire, west_wire)  # post-pack: the actual wire bytes
+    ghost_west = unpack(jax.lax.ppermute(east_wire, COL_AXIS, forward))
+    ghost_east = unpack(jax.lax.ppermute(west_wire, COL_AXIS, backward))
     return ghost_west, ghost_east
 
 

@@ -43,6 +43,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from gol_tpu.obs import registry as obs_registry, trace as obs_trace
 from gol_tpu.resilience import REPLACED_SUFFIX, STAGING_SUFFIX, faults
 from gol_tpu.resilience.retry import DEFAULT_IO_RETRY, RetryPolicy
 
@@ -369,7 +370,25 @@ class CheckpointManager:
         payload first (fresh path), manifest committed atomically second, GC
         of older checkpoints last — a crash at ANY point leaves the previous
         checkpoint intact and discoverable.
+
+        Every outcome is counted in the global obs registry (saves /
+        failures), and the whole save is one trace span — so a flight-
+        recorder dump after a crash shows whether the process died inside a
+        checkpoint and which generation it was committing.
         """
+        reg = obs_registry.default()
+        with obs_trace.span("checkpoint.save", generation=int(generation)):
+            try:
+                path = self._save(state, generation, counter)
+            except BaseException:
+                # BaseException: InjectedCrash must be counted too — the
+                # recorder dump that follows should show the failed save.
+                reg.inc("checkpoint_save_failures_total")
+                raise
+        reg.inc("checkpoint_saves_total")
+        return path
+
+    def _save(self, state, generation: int, counter: int) -> str:
         faults.on_checkpoint_boundary(generation)
         import jax
 
@@ -686,17 +705,22 @@ class CheckpointManager:
         the newest checkpoint at or below the limit — any such checkpoint is
         an exact prefix of the shorter run — or starts fresh.
         """
-        for gen in self._global_candidates():
-            if max_generation is not None and gen > max_generation:
-                continue
-            loaded = self._load(gen)
-            if self._collective_is_valid(loaded):
-                logger.info("auto-resume: restored checkpoint at generation "
-                            "%d from %s", loaded.info.generation,
-                            loaded.info.path)
-                return loaded.state, loaded.info
-            if loaded is not None:
-                logger.warning(
-                    "checkpoint generation %d readable here but not verified "
-                    "on every process; falling back to an older one", gen)
+        reg = obs_registry.default()
+        with obs_trace.span("checkpoint.restore"):
+            for gen in self._global_candidates():
+                if max_generation is not None and gen > max_generation:
+                    continue
+                loaded = self._load(gen)
+                if self._collective_is_valid(loaded):
+                    logger.info("auto-resume: restored checkpoint at "
+                                "generation %d from %s",
+                                loaded.info.generation, loaded.info.path)
+                    reg.inc("checkpoint_restores_total")
+                    return loaded.state, loaded.info
+                reg.inc("checkpoint_restore_rejected_total")
+                if loaded is not None:
+                    logger.warning(
+                        "checkpoint generation %d readable here but not "
+                        "verified on every process; falling back to an "
+                        "older one", gen)
         return None

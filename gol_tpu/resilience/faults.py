@@ -201,6 +201,18 @@ def on_checkpoint_boundary(generation: int) -> None:
         return
     if generation >= plan.kill_at_gen:
         plan._killed = True
+        # Flight-recorder composition: a kill about to happen is exactly the
+        # moment the recorder exists for. The sigkill mode gets no Python
+        # unwinding (no excepthook), so the dump MUST happen here; the
+        # exception mode dumps here too so a harness that catches
+        # InjectedCrash still leaves post-mortem evidence. Unarmed, this is
+        # one None check (obs.recorder keeps no other state).
+        from gol_tpu.obs import recorder
+
+        recorder.trigger(
+            f"fault-injection: kill at checkpoint boundary, "
+            f"generation {generation} ({plan.kill_mode})"
+        )
         if plan.kill_mode == "sigkill":
             import signal
 

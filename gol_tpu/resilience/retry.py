@@ -9,7 +9,9 @@ silent slow loops — and pass it as the ``retryable`` predicate.
 
 The module is stdlib-only on purpose: ``gol_tpu.engine`` imports it at module
 load, before jax-heavy modules, and the fault-injection harness imports it in
-subprocesses that must start fast.
+subprocesses that must start fast. (``gol_tpu.obs.registry`` — where every
+taken retry is counted, so operators see transient-failure pressure building
+before it turns hard — is stdlib-only by the same rule.)
 """
 
 from __future__ import annotations
@@ -17,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 import time
 from typing import Callable
+
+from gol_tpu.obs import registry as _obs_registry
 
 # Substrings that mark an IO failure as plausibly transient: tensorstore /
 # kvstore surfaces absl status prose ("UNAVAILABLE", "DEADLINE_EXCEEDED",
@@ -104,6 +108,7 @@ class RetryPolicy:
                     and clock() - start + delay > self.deadline
                 ):
                     raise
+                _obs_registry.default().inc("retry_attempts_total")
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
                 if delay > 0:

@@ -28,6 +28,7 @@ import logging
 import numpy as np
 
 from gol_tpu import engine
+from gol_tpu.obs import trace as obs_trace
 from gol_tpu.serve.jobs import Job, JobResult
 
 logger = logging.getLogger(__name__)
@@ -147,12 +148,14 @@ def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
                 f"not {key.label()}"
             )
     total = pad_batch(len(jobs))
-    results = engine.simulate_batch(
-        [job.board for job in jobs],
-        [job.config for job in jobs],
-        padded_shape=(key.height, key.width),
-        pad_batch_to=total,
-    )
+    with obs_trace.span("batcher.run_batch", bucket=key.label(),
+                        jobs=len(jobs), slots=total):
+        results = engine.simulate_batch(
+            [job.board for job in jobs],
+            [job.config for job in jobs],
+            padded_shape=(key.height, key.width),
+            pad_batch_to=total,
+        )
     return [
         JobResult(grid=r.grid, generations=r.generations, exit_reason=r.exit_reason)
         for r in results

@@ -1,117 +1,27 @@
-"""Serving metrics registry: counters, gauges, latency histograms.
+"""Serving metrics: a thin façade over the shared obs registry.
 
-Stdlib-only and thread-safe (the accept path, worker threads, and the
-metrics endpoint all touch it concurrently). Exported two ways by the
-server: ``snapshot()`` as JSON and ``prometheus()`` as the text exposition
-format, so both a human with curl and a scraper get the same numbers.
+PR 2 built the counter/gauge/histogram registry here; PR 4 hoisted the
+implementation into ``gol_tpu/obs/registry.py`` so the engine, resilience,
+and tune layers can feed the same machinery. This module keeps the serving
+surface exactly as it was — ``Metrics`` (prefix ``gol_serve``), exported by
+the server as ``snapshot()`` JSON and ``prometheus()`` text — and both
+output contracts are byte-stable across the move (pinned test-for-test by
+tests/test_serve.py and tests/test_obs.py).
 
-Latency sources are ``time.perf_counter()`` exclusively — monotonic, never
-stepped by NTP. The wall clock is banned from this package's latency paths
-by tests/test_lint.py; a clock that jumps backward mid-sample turns a p99
-into fiction.
-
-Histograms keep a bounded reservoir of the most recent samples (simple,
-predictable memory; quantiles over "recent traffic" is what an operator
-watching a serving system wants anyway) plus exact running count/sum.
+Latency sources remain ``time.perf_counter()`` exclusively; the wall-clock
+ban of tests/test_lint.py covers this package and gol_tpu/obs alike.
 """
 
 from __future__ import annotations
 
-import collections
-import threading
+from gol_tpu.obs.registry import QUANTILES, Registry, _fmt  # noqa: F401
 
-# Quantiles exported for every histogram.
-QUANTILES = (0.5, 0.95, 0.99)
-
-_RESERVOIR = 2048  # samples kept per histogram (most recent)
+# Kept importable under its historical name (PR 2 tests and embedders).
+from gol_tpu.obs.registry import Histogram as _Histogram  # noqa: F401
 
 
-class _Histogram:
-    __slots__ = ("samples", "count", "total")
-
-    def __init__(self):
-        self.samples = collections.deque(maxlen=_RESERVOIR)
-        self.count = 0
-        self.total = 0.0
-
-    def observe(self, value: float) -> None:
-        self.samples.append(float(value))
-        self.count += 1
-        self.total += float(value)
-
-    def quantile(self, q: float) -> float | None:
-        if not self.samples:
-            return None
-        ordered = sorted(self.samples)
-        # Nearest-rank on the recent reservoir.
-        idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
-        return ordered[idx]
-
-    def summary(self) -> dict:
-        out = {"count": self.count, "sum": self.total}
-        for q in QUANTILES:
-            v = self.quantile(q)
-            out[f"p{int(q * 100)}"] = v
-        return out
-
-
-class Metrics:
-    """Registry of named counters, gauges, and histograms."""
+class Metrics(Registry):
+    """Registry of named counters, gauges, and histograms (serving prefix)."""
 
     def __init__(self, prefix: str = "gol_serve"):
-        self.prefix = prefix
-        self._lock = threading.Lock()
-        self._counters: dict[str, float] = {}
-        self._gauges: dict[str, float] = {}
-        self._hists: dict[str, _Histogram] = {}
-
-    def inc(self, name: str, amount: float = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + amount
-
-    def set_gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self._gauges[name] = float(value)
-
-    def observe(self, name: str, value: float) -> None:
-        with self._lock:
-            self._hists.setdefault(name, _Histogram()).observe(value)
-
-    def counter(self, name: str) -> float:
-        with self._lock:
-            return self._counters.get(name, 0)
-
-    def snapshot(self) -> dict:
-        """Point-in-time JSON-able view of everything."""
-        with self._lock:
-            return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
-                "histograms": {k: h.summary() for k, h in self._hists.items()},
-            }
-
-    def prometheus(self) -> str:
-        """Prometheus text exposition format (quantiles as summary series)."""
-        snap = self.snapshot()
-        p = self.prefix
-        lines: list[str] = []
-        for name, value in sorted(snap["counters"].items()):
-            lines.append(f"# TYPE {p}_{name} counter")
-            lines.append(f"{p}_{name} {_fmt(value)}")
-        for name, value in sorted(snap["gauges"].items()):
-            lines.append(f"# TYPE {p}_{name} gauge")
-            lines.append(f"{p}_{name} {_fmt(value)}")
-        for name, summary in sorted(snap["histograms"].items()):
-            lines.append(f"# TYPE {p}_{name} summary")
-            for q in QUANTILES:
-                v = summary.get(f"p{int(q * 100)}")
-                if v is not None:
-                    lines.append(f'{p}_{name}{{quantile="{q}"}} {_fmt(v)}')
-            lines.append(f"{p}_{name}_sum {_fmt(summary['sum'])}")
-            lines.append(f"{p}_{name}_count {_fmt(summary['count'])}")
-        return "\n".join(lines) + "\n"
-
-
-def _fmt(v: float) -> str:
-    # Prometheus wants plain decimal/scientific; repr of a float is both.
-    return repr(float(v)) if isinstance(v, float) and not v.is_integer() else str(int(v))
+        super().__init__(prefix=prefix)

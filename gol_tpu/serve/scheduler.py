@@ -36,6 +36,7 @@ import logging
 import threading
 import time
 
+from gol_tpu.obs import trace as obs_trace
 from gol_tpu.resilience.retry import RetryPolicy, is_transient_io
 from gol_tpu.serve import batcher
 from gol_tpu.serve.batcher import BucketKey, bucket_for, pad_batch
@@ -318,11 +319,17 @@ class Scheduler:
             )
 
         try:
-            results = self.retry.call(
-                lambda: self._run_batch(key, batch),
-                retryable=self.retryable,
-                on_retry=on_retry,
-            )
+            # The batch span: what a traced `gol serve` session exports and
+            # what `GET /debug/trace` shows mid-flight. One span per
+            # dispatched batch, labeled with its padding bucket — a session
+            # serving two bucket shapes shows two distinct batch lanes.
+            with obs_trace.span("serve.batch", bucket=key.label(),
+                                jobs=len(batch)):
+                results = self.retry.call(
+                    lambda: self._run_batch(key, batch),
+                    retryable=self.retryable,
+                    on_retry=on_retry,
+                )
         except Exception as err:  # noqa: BLE001 - every job must terminate
             finished = self._clock()
             logger.error(

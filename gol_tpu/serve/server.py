@@ -14,6 +14,10 @@ Endpoints (all JSON unless noted):
   claimed by a batch (dispatch is not interruptible), 404 if unknown.
 - ``GET /metrics``    — Prometheus text format; ``?format=json`` for the
   JSON snapshot.
+- ``GET /debug/trace``— observability snapshot (gol_tpu/obs): tracing
+  state, the retained span ring, and the process-global registry counters
+  (engine/checkpoint/retry/tuner/halo). Live and read-only — the HTTP
+  counterpart of a SIGUSR1 flight-recorder dump.
 - ``POST /drain``     — stop admission, flush the queue, wait for in-flight
   batches; responds when quiescent. Idempotent.
 - ``GET /healthz``    — liveness + queue stats.
@@ -33,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
 
 from gol_tpu.io import text_grid
+from gol_tpu.obs import registry as obs_registry, trace as obs_trace
 from gol_tpu.serve.jobs import DONE, FAILED, CANCELLED, JobJournal, new_job
 from gol_tpu.serve.metrics import Metrics
 from gol_tpu.serve.scheduler import Draining, QueueFull, Scheduler
@@ -302,6 +307,14 @@ def _make_handler(server: GolServer):
                         200, server.metrics.prometheus(),
                         content_type="text/plain; version=0.0.4",
                     )
+            elif path == "/debug/trace":
+                tracer = obs_trace.tracer()
+                self._reply(200, {
+                    "enabled": tracer.enabled,
+                    "meta": tracer.metadata(),
+                    "spans": tracer.snapshot(),
+                    "registry": obs_registry.default().snapshot(),
+                })
             elif path == "/healthz":
                 self._reply(200, {"ok": True, "stats": server.scheduler.stats()})
             else:

@@ -30,9 +30,23 @@ import time
 import numpy as np
 
 from gol_tpu.config import GameConfig
+from gol_tpu.obs import registry as obs_registry, trace as obs_trace
 from gol_tpu.tune import space
 
 logger = logging.getLogger(__name__)
+
+
+def _count_trial(trial: Trial) -> Trial:
+    """Record a finished trial in the global obs registry (and as a trace
+    event): a tuning session's progress is then visible over SIGUSR1 /
+    ``GET /debug/trace`` like every other long-running phase."""
+    reg = obs_registry.default()
+    reg.inc("tuner_trials_total")
+    if trial.gate != "ok":
+        reg.inc("tuner_gate_failures_total")
+    obs_trace.event("tune.trial", label=trial.label, gate=trial.gate,
+                    median_s=trial.median_s)
+    return trial
 
 # A grid this small is cheap to oracle-check, so the reference output itself
 # is verified against ground truth before any candidate is gated on it.
@@ -212,8 +226,10 @@ def run_engine_search(
                     "candidate %s failed to build/run (%s: %s); excluded",
                     cand.label(), type(err).__name__, err,
                 )
-                trials.append(Trial(cand.label(), cand, None, [],
-                                    f"error: {type(err).__name__}"))
+                trials.append(_count_trial(
+                    Trial(cand.label(), cand, None, [],
+                          f"error: {type(err).__name__}")
+                ))
                 continue
             if reference is None:
                 # First candidate IS the default: it becomes the reference,
@@ -236,16 +252,18 @@ def run_engine_search(
                 and out_gen == reference[1]
             )
             if not ok:
-                trials.append(Trial(cand.label(), cand, None, [], "mismatch"))
+                trials.append(_count_trial(
+                    Trial(cand.label(), cand, None, [], "mismatch")
+                ))
                 continue
 
             samples = timed_samples(
                 lambda: int(runner(operand)[1]), warmup=max(0, warmup - 1),
                 iters=iters,
             )
-            trials.append(
+            trials.append(_count_trial(
                 Trial(cand.label(), cand, trimmed_median(samples), samples, "ok")
-            )
+            ))
             logger.info("  %-28s %8.3f ms", cand.label(),
                         trials[-1].median_s * 1e3)
     finally:
@@ -345,13 +363,15 @@ def run_serve_search(
             return True
 
         if not dispatch(gate=True):  # compile + warm + gate in one pass
-            trials.append(Trial(cand.label(), cand, None, [], "mismatch"))
+            trials.append(_count_trial(
+                Trial(cand.label(), cand, None, [], "mismatch")
+            ))
             continue
         samples = timed_samples(dispatch, warmup=max(0, warmup - 1),
                                 iters=iters)
-        trials.append(
+        trials.append(_count_trial(
             Trial(cand.label(), cand, trimmed_median(samples), samples, "ok")
-        )
+        ))
         logger.info("  %-28s %8.3f ms", cand.label(),
                     trials[-1].median_s * 1e3)
 

@@ -5,12 +5,27 @@ contracts (src/game.c:201-203,241; src/game_mpi_collective.c:203,370,450,485;
 src/game_openmp.c:501; src/game_cuda.cu:294-297).
 """
 
+import os
+
 import numpy as np
 import pytest
 
 from gol_tpu import cli, oracle
 from gol_tpu.config import Convention, GameConfig
 from gol_tpu.io import text_grid
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """--trace runs arm the PROCESS-global tracer/recorder; leave every test
+    with observability back at its default-off state."""
+    yield
+    from gol_tpu.obs import recorder, registry, trace
+
+    trace.disable()
+    trace.clear()
+    recorder.uninstall()
+    registry.reset_default()
 
 
 @pytest.fixture
@@ -204,6 +219,108 @@ class TestCudaVariant:
         assert "Generations:\t0" in out
         assert "Reading file" not in out
         assert (tmp_path / "cuda_output.out").read_bytes() == text_grid.encode(lone)
+
+
+class TestProfileGuard:
+    """--profile DIR is start/stop-guarded (gol_tpu/obs/profiler.py): a run
+    with nothing to capture must not die, and a crashed run must not leave a
+    torn trace directory behind."""
+
+    def test_profile_with_gen0_empty_input_succeeds(self, tmp_path, capsys,
+                                                    monkeypatch):
+        # An all-dead grid exits on generation 0 — the case that used to
+        # start the profiler for a run with no device loop and leave a torn
+        # capture when start/stop misbehaved. With the profiler backend
+        # refusing to start (the observed failure shape), the run must
+        # complete unprofiled, rc 0.
+        import jax
+
+        def refuse(*a, **k):
+            raise RuntimeError("profiler had nothing to capture")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", refuse)
+        empty = np.zeros((8, 8), np.uint8)
+        p = tmp_path / "empty.txt"
+        text_grid.write_grid(str(p), empty)
+        prof = tmp_path / "prof"
+        assert run_cli(["8", "8", str(p), "--variant", "cuda",
+                        "--profile", str(prof),
+                        "--output", str(tmp_path / "o.out")]) == 0
+        out = capsys.readouterr().out
+        assert "Generations:\t0" in out
+        # No torn capture: the guard never created partial profiler output.
+        assert not prof.exists() or list(prof.iterdir()) == []
+
+    def test_profile_crashed_run_leaves_no_torn_capture(self, tmp_path,
+                                                        monkeypatch):
+        import jax
+
+        from gol_tpu.resilience import faults
+        from gol_tpu.resilience.faults import InjectedCrash
+
+        prof = tmp_path / "prof"
+
+        def fake_start(d, *a, **k):
+            os.makedirs(os.path.join(d, "plugins", "profile"), exist_ok=True)
+
+        monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+        monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+        g = text_grid.generate(16, 16, seed=9)
+        p = tmp_path / "g.txt"
+        text_grid.write_grid(str(p), g)
+        try:
+            with pytest.raises(InjectedCrash):
+                run_cli(["16", "16", str(p), "--variant", "tpu",
+                         "--gen-limit", "10",
+                         "--checkpoint-every", "1",
+                         "--checkpoint-dir", str(tmp_path / "ckpt"),
+                         "--fault-plan", "kill_at_gen=2",
+                         "--profile", str(prof),
+                         "--output", str(tmp_path / "o.out")])
+        finally:
+            faults.clear()
+        # The capture the crash interrupted was swept, not left torn.
+        assert not prof.exists() or list(prof.iterdir()) == []
+
+
+class TestTraceFlag:
+    def test_bad_trace_path_gets_cli_error_contract(self, tmp_path, capsys):
+        """--trace pointing at a FILE must produce the `gol: <error>` line
+        and rc 1 (review regression: arming ran outside the error handler
+        and leaked a raw traceback)."""
+        not_a_dir = tmp_path / "occupied"
+        not_a_dir.write_text("file, not a directory")
+        g = text_grid.generate(8, 8, seed=1)
+        p = tmp_path / "g.txt"
+        text_grid.write_grid(str(p), g)
+        assert run_cli(["8", "8", str(p), "--variant", "game",
+                        "--gen-limit", "2", "--trace", str(not_a_dir),
+                        "--output", str(tmp_path / "o.out")]) == 1
+        assert capsys.readouterr().err.startswith("gol: ")
+
+    def test_export_failure_does_not_mask_success(self, tmp_path, capsys,
+                                                  monkeypatch):
+        """A trace export that fails at the end (dir deleted mid-run, disk
+        full) warns on stderr but keeps the lane's rc 0."""
+        import shutil
+
+        from gol_tpu.obs import trace as obs_trace
+
+        real_export = obs_trace.export_chrome
+
+        def deleted_then_export(path):
+            shutil.rmtree(os.path.dirname(path))
+            return real_export(path)
+
+        monkeypatch.setattr(obs_trace, "export_chrome", deleted_then_export)
+        g = text_grid.generate(8, 8, seed=2)
+        p = tmp_path / "g.txt"
+        text_grid.write_grid(str(p), g)
+        assert run_cli(["8", "8", str(p), "--variant", "game",
+                        "--gen-limit", "2", "--trace", str(tmp_path / "tr"),
+                        "--output", str(tmp_path / "o.out")]) == 0
+        err = capsys.readouterr().err
+        assert "trace export failed" in err
 
 
 class TestGenerate:

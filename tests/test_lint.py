@@ -51,6 +51,23 @@ def test_no_wall_clock_in_serve_latency_paths():
     )
 
 
+def test_no_wall_clock_in_obs():
+    """Same rule for gol_tpu/obs/: span durations, histogram samples, and
+    report math are ``time.perf_counter()`` only — an observability layer
+    whose own numbers step under NTP would poison every consumer at once.
+    The ONE sanctioned wall-clock read is the tracer's per-process alignment
+    anchor, taken via ``time.time_ns()`` at ``trace.enable()`` — outside
+    this needle set on purpose, exported as metadata, and never part of any
+    duration or timestamp arithmetic (gol_tpu/obs/trace.py documents it)."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "obs", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/obs/ (use time.perf_counter() "
+            f"for every span/sample; the one alignment anchor is "
+            f"time.time_ns at trace.enable): {offenders}"
+        )
+
+
 def test_no_wall_clock_in_tune():
     """Same rule for gol_tpu/tune/, where the stakes are higher still: a
     wall-clock step during a timed trial silently corrupts the *persisted*

@@ -40,6 +40,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+# The one nearest-rank median (sorted[n // 2], the upper median on even
+# counts) — shared with the serving histograms' percentile math via
+# gol_tpu/obs/registry.py instead of re-derived here per call site. The
+# published artifacts are byte-stable: same rule, one definition.
+from gol_tpu.obs.registry import median
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "benchmarks")
 
@@ -76,7 +82,7 @@ def _device_time_per_pass(fn, words, n: int):
     import glob
     import tempfile
 
-    import jax
+    from gol_tpu.obs import profiler
 
     try:
         from xprof.convert import raw_to_tool_data
@@ -84,8 +90,13 @@ def _device_time_per_pass(fn, words, n: int):
         return None
     try:
         with tempfile.TemporaryDirectory() as td:
-            with jax.profiler.trace(td):
+            # The guarded capture (gol_tpu/obs/profiler.py): a profiler
+            # start failure degrades to "no device time", never a dead
+            # session — the same implementation behind the CLI's --profile.
+            with profiler.capture(td) as started:
                 _force(fn(words, n))
+            if not started:
+                return None
             planes = glob.glob(os.path.join(td, "**", "*.xplane.pb"),
                                recursive=True)
             if not planes:
@@ -171,7 +182,7 @@ def session(size: int, rev: int = 5, reps: int = 3, trace: bool = True) -> dict:
         log(f"  rep {rep}: " + ", ".join(
             f"{k}={rates[k][-1] / 1e12:.2f}T" for k in paths))
 
-    med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
+    med = {k: median(v) for k, v in rates.items()}
     out = {
         "size": size,
         "reps": reps,
@@ -239,8 +250,8 @@ def compare(size: int, rev: int = 5, sessions: int = 5) -> None:
             "sessions": results,
             "runs_rows_ratio": ratios_rows,
             "runs_2d_ratio": ratios_2d,
-            "rows_ratio_median": ratios_rows[len(ratios_rows) // 2],
-            "2d_ratio_median": ratios_2d[len(ratios_2d) // 2],
+            "rows_ratio_median": median(ratios_rows),
+            "2d_ratio_median": median(ratios_2d),
         },
     )
 
@@ -297,7 +308,7 @@ def podshard_session() -> dict:
             cells = 4096 * 65536  # both shards are the same cell count
             rates[k].append(cells * T / per_pass)
         log(f"  rep {rep}: " + ", ".join(f"{k}={rates[k][-1]/1e12:.2f}T" for k in runs))
-    med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
+    med = {k: median(v) for k, v in rates.items()}
     return {
         "cells_per_s": {k: [round(x) for x in v] for k, v in rates.items()},
         "ratio_rows_16x1": round(med["rows_16x1"] / med["single_ref"], 4),
@@ -325,8 +336,8 @@ def podshard(rev: int = 5, sessions: int = 5) -> None:
             "sessions": results,
             "ratio_16x1_runs": r16,
             "ratio_4x4_runs": r44,
-            "ratio_16x1_median": r16[len(r16) // 2],
-            "ratio_4x4_median": r44[len(r44) // 2],
+            "ratio_16x1_median": median(r16),
+            "ratio_4x4_median": median(r44),
         },
     )
 

@@ -1,0 +1,146 @@
+"""Observability smoke: traced run -> injected crash -> flight dump -> report.
+
+The `make obs-smoke` harness, exercising the gol_tpu/obs post-mortem story
+end-to-end against a real OS process:
+
+1. generate an input and run the CLI with ``--trace DIR`` plus a
+   checkpointing fault plan (``kill_at_gen``) — the run crashes mid-flight
+   exactly as the recovery harness's victims do;
+2. the crashed process must leave a flight-recorder dump
+   (``flight-<pid>-<seq>.jsonl``) in DIR whose every line parses as JSON,
+   with a header record naming the fault and at least one recorded span;
+3. ``gol trace-report`` must render that dump (per-phase table + span
+   tree + registry counters);
+4. a clean traced run of the same input must export Chrome trace JSON
+   (``trace-<pid>.json``) with well-formed ``ph:"X"`` events, and
+   ``gol trace-report`` must render that too.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/obs_smoke.py [--size 64] [--gen-limit 40]
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(*a):
+    print("obs-smoke:", *a, file=sys.stderr, flush=True)
+
+
+def fail(msg):
+    log("FAIL:", msg)
+    sys.exit(1)
+
+
+def _run_cli(args, cwd, check=True):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *args],
+        env=env, cwd=cwd, capture_output=True, text=True, timeout=600,
+    )
+    if check and proc.returncode != 0:
+        fail(f"gol {' '.join(args)} -> rc {proc.returncode}\n{proc.stderr[-2000:]}")
+    return proc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--gen-limit", type=int, default=40)
+    args = ap.parse_args(argv)
+
+    work = tempfile.mkdtemp(prefix="gol_obs_smoke_")
+    try:
+        inp = os.path.join(work, "input.txt")
+        trace_dir = os.path.join(work, "trace")
+        _run_cli(["generate", str(args.size), str(args.size),
+                  "--seed", "7", "-o", inp], cwd=work)
+
+        # 1-2: traced run crashed by the fault plan at a checkpoint boundary.
+        kill_at = max(2, args.gen_limit // 2)
+        crash = _run_cli(
+            [str(args.size), str(args.size), inp, "--variant", "tpu",
+             "--gen-limit", str(args.gen_limit),
+             "--checkpoint-every", "2",
+             "--checkpoint-dir", os.path.join(work, "ckpt"),
+             "--fault-plan", f"kill_at_gen={kill_at}",
+             "--trace", trace_dir,
+             "--output", os.path.join(work, "crash.out")],
+            cwd=work, check=False,
+        )
+        if crash.returncode == 0:
+            fail("fault-plan run exited 0; the injected crash never fired")
+        log(f"crashed as planned (rc {crash.returncode})")
+
+        dumps = sorted(glob.glob(os.path.join(trace_dir, "flight-*.jsonl")))
+        if not dumps:
+            fail(f"no flight-recorder dump in {trace_dir}: "
+                 f"{os.listdir(trace_dir) if os.path.isdir(trace_dir) else 'missing'}")
+        records = []
+        for line in open(dumps[0], "rb").read().split(b"\n"):
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                fail(f"unparseable flight-recorder line: {line[:120]!r}")
+        kinds = {r.get("record") for r in records}
+        if not {"header", "span", "registry"} <= kinds:
+            fail(f"flight dump missing record kinds: got {sorted(kinds)}")
+        header = next(r for r in records if r["record"] == "header")
+        if "fault" not in header["reason"] and "crash" not in header["reason"]:
+            fail(f"dump reason does not name the fault: {header['reason']!r}")
+        reg = next(r for r in records if r["record"] == "registry")
+        if reg.get("counters", {}).get("checkpoint_saves_total", 0) < 1:
+            fail(f"registry snapshot missing checkpoint saves: {reg}")
+        log(f"flight dump OK: {dumps[0]} "
+            f"({sum(1 for r in records if r['record'] == 'span')} spans)")
+
+        # 3: trace-report renders the flight dump.
+        report = _run_cli(["trace-report", dumps[0]], cwd=work)
+        if "per-phase" not in report.stdout or "span" not in report.stdout:
+            fail(f"trace-report output unexpected:\n{report.stdout[:800]}")
+        log("trace-report rendered the flight dump")
+
+        # 4: clean traced run exports Chrome trace JSON.
+        clean_dir = os.path.join(work, "trace_clean")
+        _run_cli(
+            [str(args.size), str(args.size), inp, "--variant", "tpu",
+             "--gen-limit", str(args.gen_limit), "--trace", clean_dir,
+             "--output", os.path.join(work, "clean.out")],
+            cwd=work,
+        )
+        traces = sorted(glob.glob(os.path.join(clean_dir, "trace-*.json")))
+        if not traces:
+            fail(f"no Chrome trace export in {clean_dir}")
+        doc = json.load(open(traces[0]))
+        events = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+        if not events:
+            fail(f"no ph:'X' events in {traces[0]}")
+        names = {e["name"] for e in events}
+        if "cli.execution" not in names:
+            fail(f"execution span missing from export: {sorted(names)}")
+        report = _run_cli(["trace-report", traces[0]], cwd=work)
+        if "cli.execution" not in report.stdout:
+            fail(f"trace-report did not render the export:\n{report.stdout[:800]}")
+        log(f"chrome export OK: {traces[0]} ({len(events)} events)")
+        log("PASS")
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
