@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench serve-smoke tune-smoke obs-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench serve-smoke tune-smoke obs-smoke pipeline-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -40,6 +40,14 @@ tune-smoke:
 # well-formed Chrome trace JSON.
 obs-smoke:
 	python3 tools/obs_smoke.py
+
+# Async-pipeline smoke (tools/pipeline_smoke.py): a checkpointed run with the
+# async writer is SIGKILLed mid-background-payload-write, auto-resume must be
+# byte-identical to an uninterrupted run (and sync/async payloads identical);
+# then a depth-2 pipelined serve session drains clean with every job DONE
+# exactly once.
+pipeline-smoke:
+	python3 tools/pipeline_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

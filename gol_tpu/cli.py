@@ -424,8 +424,8 @@ def _prepare_packed_segmented(args, config, mesh, words, height, width):
         config,
         runner,
         words,
-        lambda: engine.simulate_packed_segments(
-            words, (height, width), config, mesh, args.snapshot_every,
+        lambda state: engine.simulate_packed_segments(
+            state, (height, width), config, mesh, args.snapshot_every,
             completed=args.resume_gen,
         ),
         write,
@@ -455,7 +455,11 @@ def _prepare_resumed(args, config, mesh, state, height, width, *, packed, kernel
         else engine.make_segment_runner((height, width), config, mesh, kernel)
     )
     gen0, counter0 = engine.resume_scalars(config, args.resume_gen)
-    _, g, _, _ = runner(state, jnp.int32(gen0), jnp.int32(counter0), jnp.int32(0))
+    # Rebind, not discard: segment runners donate their state argument on
+    # donating backends (engine jit_donating), so the zero-step call CONSUMES
+    # `state` and hands back the identical carry in a fresh buffer.
+    state, g, _, _ = runner(state, jnp.int32(gen0), jnp.int32(counter0),
+                            jnp.int32(0))
     int(g)  # zero-step call: compile + program upload (the --warmup treatment)
 
     report = engine._REPORT[config.convention]
@@ -543,7 +547,11 @@ def _prepare_checkpointed(args, variant, config, mesh, state, height, width, *,
         else engine.make_segment_runner((height, width), config, mesh, args.kernel)
     )
     gen0, counter0 = engine.resume_scalars(config, completed)
-    _, g, _, _ = runner(state, jnp.int32(gen0), jnp.int32(counter0), jnp.int32(0))
+    # Rebind, not discard: segment runners donate their state argument on
+    # donating backends, so this zero-step call CONSUMES `state` and returns
+    # the identical carry in a fresh buffer (the donation-safe warm idiom).
+    state, g, _, _ = runner(state, jnp.int32(gen0), jnp.int32(counter0),
+                            jnp.int32(0))
     int(g)  # zero-step call: compile + program upload outside the timer
 
     segment = args.checkpoint_every or max(1, config.gen_limit)
@@ -556,16 +564,42 @@ def _prepare_checkpointed(args, variant, config, mesh, state, height, width, *,
             state, config, mesh, args.kernel, segment, completed=completed
         )
 
+    # The async writer (default): a boundary costs the device only the
+    # device->host snapshot — payload write + fsync run on a background
+    # thread while the next segment computes, and the manifest commits at
+    # the NEXT boundary after draining that write (gol_tpu/pipeline/writer:
+    # the iwrite/Wait-at-next-step discipline of src/game_mpi_async.c).
+    # --sync-checkpoints keeps the fully synchronous path for A/B; both
+    # produce bit-identical outputs and checkpoint payloads (test-pinned).
+    use_async = bool(args.checkpoint_every) and not args.sync_checkpoints
+
     def run_fn():
-        final, generations = state, completed
-        for generations, final, stopped in segments():
-            if args.checkpoint_every and not stopped:
-                # Early-exited states are final output, not mid-run state —
-                # a checkpoint of one would replay as mid-run on resume and
-                # change the reported count (the --resume-gen caveat).
-                _, counter = engine.resume_scalars(config, generations)
-                mgr.save(final, generations, counter)
-        return final, generations
+        writer = None
+        if use_async:
+            from gol_tpu.pipeline.writer import AsyncCheckpointWriter
+
+            writer = AsyncCheckpointWriter(mgr)
+        try:
+            final, generations = state, completed
+            for generations, final, stopped in segments():
+                if args.checkpoint_every and not stopped:
+                    # Early-exited states are final output, not mid-run
+                    # state — a checkpoint of one would replay as mid-run on
+                    # resume and change the reported count (the --resume-gen
+                    # caveat).
+                    _, counter = engine.resume_scalars(config, generations)
+                    if writer is not None:
+                        writer.save(final, generations, counter)
+                    else:
+                        mgr.save(final, generations, counter)
+            if writer is not None:
+                # The final boundary's deferred wait: commit the last
+                # pending checkpoint before the run reports success.
+                writer.drain()
+            return final, generations
+        finally:
+            if writer is not None:
+                writer.close()  # join-on-exit, also on the error path
 
     return run_fn
 
@@ -627,7 +661,9 @@ def _snapshot_loop(args, config, runner, state0, segments, write_snapshot,
     import jax.numpy as jnp
 
     gen0 = engine._GEN_START[config.convention]
-    _, g, _, _ = runner(state0, jnp.int32(gen0), jnp.int32(0), jnp.int32(0))
+    # Rebind, not discard: the runner donates its state argument on donating
+    # backends (a zero-step call returns the carry unchanged, fresh buffer).
+    state0, g, _, _ = runner(state0, jnp.int32(gen0), jnp.int32(0), jnp.int32(0))
     int(g)  # zero-step call: compile + program upload, no simulation
 
     outdir = args.snapshot_dir or "./snapshots"
@@ -635,7 +671,7 @@ def _snapshot_loop(args, config, runner, state0, segments, write_snapshot,
 
     def run_fn():
         final, generations = state0, 0
-        for generations, final, _stopped in segments():
+        for generations, final, _stopped in segments(state0):
             write_snapshot(
                 os.path.join(outdir, f"gen_{generations:06d}{suffix}"), final
             )
@@ -651,8 +687,8 @@ def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
         config,
         runner,
         device_grid,
-        lambda: engine.simulate_segments(
-            device_grid, config, mesh, args.kernel, args.snapshot_every,
+        lambda state: engine.simulate_segments(
+            state, config, mesh, args.kernel, args.snapshot_every,
             completed=args.resume_gen,
         ),
         lambda path, state: _write_phase(variant, path, state),
@@ -732,6 +768,7 @@ def _serve(args) -> int:
         max_batch=args.max_batch,
         flush_age=args.flush_age,
         max_inflight=args.max_inflight,
+        pipeline_depth=args.pipeline_depth,
     )
     stop = {"signaled": False}
 
@@ -1281,6 +1318,16 @@ def build_parser() -> argparse.ArgumentParser:
         "bit-exact with uninterrupted ones",
     )
     run.add_argument(
+        "--sync-checkpoints",
+        action="store_true",
+        help="write checkpoints synchronously (device idle during payload "
+        "write + fsync). Default is the async writer (gol_tpu/pipeline): a "
+        "boundary costs only a device->host snapshot, the payload writes on "
+        "a background thread under the next segment's compute, and the "
+        "manifest commits at the next boundary — bit-identical outputs and "
+        "payloads either way; this flag is the A/B lever",
+    )
+    run.add_argument(
         "--fault-plan",
         default=None,
         metavar="SPEC",
@@ -1335,6 +1382,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     srv.add_argument("--max-inflight", type=int, default=1,
                      help="concurrently running batches (worker threads)")
+    srv.add_argument(
+        "--pipeline-depth", type=int, default=1,
+        help="pipelined dispatch window: at N >= 2 the single synchronous "
+        "worker becomes a dispatcher/completer pair with N batches in "
+        "flight — the device computes batch k while the host stages k+1 "
+        "and journals k-1 (try 2). Default 1 keeps the classic worker; "
+        "exactly-once journal semantics, admission, drain, and retry are "
+        "identical at every depth",
+    )
     srv.add_argument(
         "--warm-plans", action="store_true",
         help="pre-compile the bucket programs of every serve shape recorded "

@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,7 @@ from gol_tpu.ops import (
     resolve_kernel,
     with_temporal_depth,
 )
+from gol_tpu.ops.jit_compat import jit_donating
 from gol_tpu.parallel import collectives
 from gol_tpu.parallel.mesh import (
     Topology,
@@ -802,6 +804,17 @@ def _build_runner(
             )
         else:
             fn = local_fn
+        if segmented:
+            # Donate the carried state: segment N's output buffer is written
+            # in place over segment N's input (the reference's double-buffer
+            # pointer swap, realized as an input/output alias), eliminating
+            # the per-segment copy. jit_compat gates this on backends that
+            # implement donation (CPU would warn per call and ignore it).
+            # Callers treat the state argument as CONSUMED — the zero-step
+            # warm calls rebind (`state, *_ = runner(state, ...)`), and the
+            # checkpoint lane snapshots to host BEFORE the next dispatch
+            # (pipeline/snapshot.py), so no donated buffer is ever re-read.
+            return jit_donating(fn, donate_argnums=(0,))
         return jax.jit(fn)
 
     if kernel != "auto" and not packed_state:
@@ -857,6 +870,13 @@ def make_segment_runner(
     snapshots, which the reference lacks entirely (SURVEY.md §5
     checkpoint/resume: its only resume path is that the output file is a
     valid input file).
+
+    DONATION CONTRACT (TPU/GPU): the runner donates its state argument
+    (ops/jit_compat.py) — every call CONSUMES the passed array and the
+    caller must rebind to the returned one (``state, *_ = runner(state,
+    ...)``; a zero-step call returns the carry unchanged, the warm idiom).
+    On CPU the runner is a plain jit and old references stay valid, so
+    misuse only surfaces on accelerators.
     """
     return _build_runner(shape, config, mesh, kernel,
                          segmented=True, packed_state=False)
@@ -890,7 +910,8 @@ def make_packed_segment_runner(
     seg_end) -> (words, gen, counter, stopped)``; composing the packed-I/O
     lane with snapshots keeps the output-is-valid-input resume property
     (src/game.c:25-40 vs :154-165) at scales where only the packed lane is
-    practical.
+    practical. ``make_segment_runner``'s donation contract applies: on
+    TPU/GPU every call consumes its word-state argument.
     """
     return _build_runner(shape, config, mesh, "packed",
                          segmented=True, packed_state=True)
@@ -957,6 +978,15 @@ def simulate_segments(
     ``config.gen_limit`` with the similarity phase realigned
     (``resume_scalars``) — yielded counts and exits match the uninterrupted
     run exactly.
+
+    DONATION CONTRACT (TPU/GPU): the segment runner donates its carried
+    state, so the passed-in device array and each yielded state are
+    CONSUMED when the generator advances past that yield. Read/copy a
+    yielded state (or write a snapshot from it) BEFORE resuming iteration,
+    and do not reuse ``grid`` afterwards — the checkpoint lane's host
+    snapshot (gol_tpu/pipeline/snapshot.py) exists for exactly this. On
+    CPU (no donation) stale references happen to stay valid; do not rely
+    on that.
     """
     shape = tuple(np.shape(grid))
     runner = make_segment_runner(shape, config, mesh, kernel)
@@ -977,7 +1007,9 @@ def simulate_packed_segments(
     ``shape`` is the logical (height, width); ``words`` its (height,
     width/32) uint32 array (from io/packed_io.read_packed). Yields the word
     state, which every consumer writes back through packed_io — the uint8
-    grid never exists.
+    grid never exists. The ``simulate_segments`` donation contract applies
+    verbatim: on TPU/GPU, ``words`` and each yielded state are consumed
+    when the generator advances.
     """
     runner = make_packed_segment_runner(shape, config, mesh)
     yield from _iter_segments(runner, words, config, segment, completed)
@@ -1287,34 +1319,62 @@ def make_batch_runner(
             boards, limits, freq, check_similarity, evolve, alive_of, equal
         )
 
-    return jax.jit(fn)
+    # Donate the board canvas: the final grids are written over the input
+    # slots (same shape/dtype), halving the program's peak canvas footprint.
+    # Every caller stages operands fresh per dispatch (stage_batch keeps the
+    # HOST copy for retries), so no donated buffer is ever reused.
+    return jit_donating(fn, donate_argnums=(0,))
 
 
-def simulate_batch(
+@dataclasses.dataclass
+class StagedBatch:
+    """Host-side operands of one batch, ready to dispatch.
+
+    The staging product of the pipelined serve path (gol_tpu/pipeline): all
+    CPU work — stacking, zero-padding, ``np.packbits`` — is done, nothing
+    has touched the device. The HOST operand arrays are retained here so an
+    idempotent retry can re-dispatch without re-staging (and because the
+    compiled program donates its device operand buffer)."""
+
+    runner: Any
+    operand: np.ndarray  # (total, PH, PW) uint8, or packed (total, PH, PW/32)
+    h_arr: np.ndarray
+    w_arr: np.ndarray
+    limits: np.ndarray
+    heights: list
+    widths: list
+    mode: str
+    padded_shape: tuple[int, int]
+    boards: int  # real board count (<= total)
+    total: int  # padded batch slots the program runs
+
+
+@dataclasses.dataclass
+class InflightBatch:
+    """One dispatched batch: device result futures + the staging it came
+    from. JAX's async dispatch returns immediately — the device computes
+    while the host goes on to stage the next batch; ``complete_batch``
+    blocks on readback."""
+
+    staged: StagedBatch
+    finals: Any  # device arrays (unresolved futures until fetched)
+    gens: Any
+    reasons: Any
+
+
+def stage_batch(
     boards,
     configs,
     padded_shape: tuple[int, int] | None = None,
     pad_batch_to: int | None = None,
-) -> list[BatchBoardResult]:
-    """Run many independent boards in ONE compiled program.
+) -> StagedBatch | None:
+    """Host staging for ``simulate_batch``: validate, stack, pad, pack.
 
-    ``boards`` is a sequence of (h, w) uint8 arrays; ``configs`` one
-    ``GameConfig`` shared by all boards or a sequence of per-board configs.
-    All configs must agree on convention/similarity settings (those are baked
-    into the compiled program); ``gen_limit`` may differ per board (it is a
-    dynamic operand). Boards are zero-padded into a shared ``padded_shape``
-    canvas (default: the max extent over the batch) and, when
-    ``pad_batch_to`` exceeds the board count, inert zero boards fill the
-    remaining batch slots so a handful of request sizes reuse one compiled
-    program.
-
-    Each returned (grid, generations, exit_reason) is bit-identical to a solo
-    ``simulate`` run of the same board (test-pinned for both conventions,
-    including boards that exit early inside a still-running batch).
-    """
+    Returns None for an empty board list. Pure host work — safe to run on a
+    pipeline thread while the device computes a previous batch."""
     boards = [np.ascontiguousarray(np.asarray(b, dtype=np.uint8)) for b in boards]
     if not boards:
-        return []
+        return None
     if isinstance(configs, GameConfig):
         configs = [configs] * len(boards)
     configs = list(configs)
@@ -1356,27 +1416,84 @@ def simulate_batch(
         head.check_similarity, head.similarity_frequency, mode,
     )
     operand = _pack_board_words(stacked) if mode == "packed" else stacked
-    with obs_trace.span("engine.simulate_batch", boards=b, slots=total,
-                        canvas=f"{ph}x{pw}", mode=mode):
-        finals, gens, reasons = runner(
-            jnp.asarray(operand), jnp.asarray(h_arr), jnp.asarray(w_arr),
-            jnp.asarray(limits),
-        )
-        finals = np.asarray(jax.device_get(finals))
-    if mode == "packed":
+    return StagedBatch(
+        runner=runner, operand=operand, h_arr=h_arr, w_arr=w_arr,
+        limits=limits, heights=heights, widths=widths, mode=mode,
+        padded_shape=padded_shape, boards=b, total=total,
+    )
+
+
+def dispatch_batch(staged: StagedBatch) -> InflightBatch:
+    """Dispatch a staged batch; returns WITHOUT blocking on the result.
+
+    The device operand is built fresh from the retained host arrays (the
+    compiled program donates it), so dispatching the same staging twice —
+    the retry path — is safe and idempotent."""
+    finals, gens, reasons = staged.runner(
+        jnp.asarray(staged.operand), jnp.asarray(staged.h_arr),
+        jnp.asarray(staged.w_arr), jnp.asarray(staged.limits),
+    )
+    return InflightBatch(staged=staged, finals=finals, gens=gens,
+                         reasons=reasons)
+
+
+def complete_batch(inflight: InflightBatch) -> list[BatchBoardResult]:
+    """Block on an in-flight batch's results and crop per-board slices."""
+    staged = inflight.staged
+    finals = np.asarray(jax.device_get(inflight.finals))
+    if staged.mode == "packed":
         finals = _unpack_board_words(finals)
     finals = np.asarray(finals, dtype=np.uint8)
-    gens = np.asarray(jax.device_get(gens))
-    reasons = np.asarray(jax.device_get(reasons))
+    gens = np.asarray(jax.device_get(inflight.gens))
+    reasons = np.asarray(jax.device_get(inflight.reasons))
+    b = staged.boards
     reg = obs_registry.default()
     reg.inc("engine_batches_total")
     reg.inc("engine_boards_total", b)
     reg.inc("engine_generations_total", int(gens[:b].sum()))
     return [
         BatchBoardResult(
-            grid=finals[i, : heights[i], : widths[i]].copy(),
+            grid=finals[i, : staged.heights[i], : staged.widths[i]].copy(),
             generations=int(gens[i]),
             exit_reason=EXIT_REASONS[int(reasons[i])],
         )
         for i in range(b)
     ]
+
+
+def simulate_batch(
+    boards,
+    configs,
+    padded_shape: tuple[int, int] | None = None,
+    pad_batch_to: int | None = None,
+) -> list[BatchBoardResult]:
+    """Run many independent boards in ONE compiled program.
+
+    ``boards`` is a sequence of (h, w) uint8 arrays; ``configs`` one
+    ``GameConfig`` shared by all boards or a sequence of per-board configs.
+    All configs must agree on convention/similarity settings (those are baked
+    into the compiled program); ``gen_limit`` may differ per board (it is a
+    dynamic operand). Boards are zero-padded into a shared ``padded_shape``
+    canvas (default: the max extent over the batch) and, when
+    ``pad_batch_to`` exceeds the board count, inert zero boards fill the
+    remaining batch slots so a handful of request sizes reuse one compiled
+    program.
+
+    Internally this is ``stage_batch`` -> ``dispatch_batch`` ->
+    ``complete_batch`` back to back; the pipelined serve scheduler
+    (gol_tpu/serve/scheduler.py at ``pipeline_depth`` >= 2) calls the three
+    stages from different threads so the device computes batch N while the
+    host stages N+1 and journals N-1.
+
+    Each returned (grid, generations, exit_reason) is bit-identical to a solo
+    ``simulate`` run of the same board (test-pinned for both conventions,
+    including boards that exit early inside a still-running batch).
+    """
+    staged = stage_batch(boards, configs, padded_shape, pad_batch_to)
+    if staged is None:
+        return []
+    ph, pw = staged.padded_shape
+    with obs_trace.span("engine.simulate_batch", boards=staged.boards,
+                        slots=staged.total, canvas=f"{ph}x{pw}",
+                        mode=staged.mode):
+        return complete_batch(dispatch_batch(staged))

@@ -69,14 +69,20 @@ def decode(data: bytes | np.ndarray, width: int, height: int) -> np.ndarray:
     return (cells[: height * width] == ONE).astype(np.uint8).reshape(height, width)
 
 
-def encode(grid: np.ndarray) -> bytes:
-    """Serialize a uint8 {0,1} grid to text-grid bytes (src/game.c:25-40)."""
+def _encode_matrix(grid: np.ndarray) -> np.ndarray:
+    """The on-disk ``height x (width+1)`` byte matrix of a grid — the ONE
+    place the row layout (digits + newline column) is built."""
     grid = np.asarray(grid, dtype=np.uint8)
     height, width = grid.shape
     out = np.empty((height, row_stride(width)), dtype=np.uint8)
     out[:, :width] = grid + ZERO
     out[:, width] = NEWLINE
-    return out.tobytes()
+    return out
+
+
+def encode(grid: np.ndarray) -> bytes:
+    """Serialize a uint8 {0,1} grid to text-grid bytes (src/game.c:25-40)."""
+    return _encode_matrix(grid).tobytes()
 
 
 def read_grid(path: str, width: int, height: int) -> np.ndarray:
@@ -87,9 +93,16 @@ def read_grid(path: str, width: int, height: int) -> np.ndarray:
 
 
 def write_grid(path: str, grid: np.ndarray) -> None:
-    """Write a whole grid file serially (the src/game.c:25-40 path)."""
+    """Write a whole grid file serially (the src/game.c:25-40 path).
+
+    Same bytes as ``f.write(encode(grid))`` but without materializing the
+    intermediate ``bytes`` copy — ``write`` accepts the encoded matrix's
+    buffer directly. At checkpoint scale (a 4096^2 payload is 16 MB) that
+    copy was a measurable slice of the async checkpoint writer's
+    background-thread work (gol_tpu/pipeline/writer.py).
+    """
     with open(path, "wb") as f:
-        f.write(encode(grid))
+        f.write(memoryview(_encode_matrix(grid)).cast("B"))
 
 
 def generate(

@@ -17,8 +17,11 @@ as one JSONL file
 
 Dump format (one JSON object per line, torn-tail tolerant like the job
 journal): a header record ``{"record": "header", ...}`` with the reason and
-the tracer anchors, one ``{"record": "span", ...}`` per retained span, and a
-final ``{"record": "registry", ...}`` carrying the counter snapshot.
+the tracer anchors, one ``{"record": "span", ...}`` per retained span, one
+``{"record": "state", "name": ..., ...}`` per registered state provider
+(live subsystem snapshots — e.g. the async checkpoint writer's queue, so a
+post-mortem shows whether a payload write was in flight), and a final
+``{"record": "registry", ...}`` carrying the counter snapshot.
 ``gol trace-report`` renders these files directly.
 
 File naming is wall-clock-free (the package-wide lint ban): ``flight-<pid>-
@@ -47,6 +50,25 @@ _prev_excepthook = None
 # keyed "first" off _dir would chain sys.excepthook to ITSELF, and the next
 # uncaught exception would recurse through the hook dumping files forever.
 _hooks_installed = False
+# Live-state providers: name -> zero-arg callable returning a JSON-able
+# dict, snapshotted into every dump (each guarded — a provider that raises
+# mid-crash is skipped, never allowed to abort the dump documenting the
+# crash). Subsystems with in-flight state the registry's scalars cannot
+# carry (the async checkpoint writer's pending generation) register here.
+_state_providers: dict[str, object] = {}
+
+
+def add_state_provider(name: str, fn) -> None:
+    """Register ``fn`` to contribute a ``{"record": "state"}`` line to every
+    dump. Last registration under a name wins (a fresh writer replaces a
+    stale one's entry)."""
+    with _lock:
+        _state_providers[name] = fn
+
+
+def remove_state_provider(name: str) -> None:
+    with _lock:
+        _state_providers.pop(name, None)
 
 
 def armed() -> bool:
@@ -113,6 +135,14 @@ def _dump(path: str, reason: str) -> str:
         f.write(json.dumps(header) + "\n")
         for span in t.snapshot():
             f.write(json.dumps({"record": "span", **span}) + "\n")
+        with _lock:
+            providers = dict(_state_providers)
+        for name, fn in providers.items():
+            try:
+                f.write(json.dumps(
+                    {"record": "state", "name": name, **fn()}) + "\n")
+            except Exception:  # noqa: BLE001 - a provider must not kill a dump
+                logger.debug("flight recorder: state provider %r failed", name)
         f.write(json.dumps({
             "record": "registry",
             **registry.default().snapshot(),

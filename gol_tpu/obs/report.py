@@ -78,6 +78,13 @@ def load_spans(path: str) -> tuple[list[dict], dict]:
             })
         elif kind == "registry":
             meta["registry"] = {k: v for k, v in rec.items() if k != "record"}
+        elif kind == "state":
+            # Live subsystem snapshots (e.g. the async checkpoint writer's
+            # queue): folded into the header block so "what was in flight
+            # when it died" renders next to the crash reason.
+            meta.setdefault("state", {})[rec.get("name", "?")] = {
+                k: v for k, v in rec.items() if k not in ("record", "name")
+            }
     spans.sort(key=lambda s: s["start_us"])
     return spans, meta
 
@@ -172,6 +179,12 @@ def render(path: str) -> str:
         shown = {k: meta[k] for k in keys if k in meta}
         if shown:
             lines.append("meta: " + ", ".join(f"{k}={v}" for k, v in shown.items()))
+            lines.append("")
+        for name, state in sorted((meta.get("state") or {}).items()):
+            lines.append(
+                f"state[{name}]: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(state.items()))
+            )
             lines.append("")
     if not spans:
         lines.append("(no spans recorded)")

@@ -1,0 +1,60 @@
+"""Buffer-donation shim: ``jax.jit(donate_argnums=...)`` across backends.
+
+The segmented engine carries its state through repeated compiled calls —
+segment N's output array IS segment N+1's input. Donating the input buffer
+lets XLA write the new state over the old one in place, eliminating the
+per-segment output allocation + copy the aliasing would otherwise cost
+(the double-buffer pointer swap of the reference driver loops,
+src/game.c:191-194, realized as an input/output alias instead of a second
+buffer). Same story for the serve batch runner's board canvas.
+
+Donation is a *backend* capability: TPU and GPU implement input/output
+aliasing; the CPU runtime ignores the annotation and warns on **every
+call** ("Some donated buffers were not usable") — noise with no win. This
+shim sits alongside the tree's other jax-compat shims
+(``parallel/mesh.shard_map``, ``ops/pallas_compat``) and makes the decision
+once, at runner-build time:
+
+- donating backend -> ``jax.jit(fn, donate_argnums=...)``;
+- anything else (CPU, unknown, or a jax too old to accept the kwarg) ->
+  plain ``jax.jit(fn)``.
+
+Callers must treat every donated argument as CONSUMED: rebind the variable
+to the call's output (zero-step warm calls return the carry unchanged, so
+``state, *_ = runner(state, ...)`` is the donation-safe warm idiom — see
+``cli._prepare_checkpointed``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Backends whose runtimes implement input/output buffer aliasing. The CPU
+# runtime accepts the annotation but ignores it with a per-call warning.
+_DONATING_BACKENDS = ("tpu", "gpu", "cuda", "rocm")
+
+
+def supports_donation() -> bool:
+    """True when the default backend honors ``donate_argnums``."""
+    try:
+        return jax.default_backend() in _DONATING_BACKENDS
+    except Exception:  # noqa: BLE001 - no backend at all: donation moot
+        return False
+
+
+def jit_donating(fn, donate_argnums=(0,)):
+    """``jax.jit`` with buffer donation where the backend implements it.
+
+    On non-donating backends (or a jax rejecting the kwarg) this is exactly
+    ``jax.jit(fn)`` — bit-identical results either way; donation only
+    changes buffer reuse, never values (pinned by the segment-equivalence
+    tests).
+    """
+    if not supports_donation():
+        return jax.jit(fn)
+    try:
+        return jax.jit(fn, donate_argnums=donate_argnums)
+    except TypeError:
+        # Ancient jax without the kwarg on this entry point: degrade to the
+        # copying form rather than failing the build.
+        return jax.jit(fn)

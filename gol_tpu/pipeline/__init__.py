@@ -1,0 +1,33 @@
+"""Async execution pipeline: overlap host work with device compute.
+
+The reference's whole ``async`` variant (src/game_mpi_async.c) exists to
+hide file I/O behind compute: ``MPI_File_iwrite_at`` is posted at a boundary
+and only waited on at the *next* boundary. This package is that discipline
+for the reproduction's two serial host taxes:
+
+- ``writer.AsyncCheckpointWriter`` — ``--checkpoint-every`` saves split into
+  a cheap foreground snapshot (device->host copy; ``snapshot.HostSnapshot``)
+  and a background payload write; the commit (and, on multihost, every
+  collective) waits at the NEXT boundary, exactly the iwrite/Wait-at-next-
+  step shape. The crash-consistency contract of resilience/checkpoint.py is
+  preserved verbatim: a checkpoint simply is not committed until its
+  deferred barrier lands, and auto-resume falls back to the last committed
+  one.
+- ``inflight.Handoff`` — the dispatcher->completer handoff behind the serve
+  scheduler's pipelined dispatch (``pipeline_depth`` >= 2): the device
+  computes batch N while the host stages N+1 and journals N-1.
+
+The third leg, buffer donation on the carried engine state, lives in
+``ops/jit_compat.py`` (it is a property of the runners, not of this
+package); the foreground snapshot here is what makes donation safe — the
+writer never touches a device buffer after ``save()`` returns.
+
+Wall-clock discipline: like serve/, obs/, and tune/, this package is
+``time.perf_counter()`` only (tests/test_lint.py bans ``time.time``).
+"""
+
+from gol_tpu.pipeline.inflight import Handoff
+from gol_tpu.pipeline.snapshot import HostSnapshot
+from gol_tpu.pipeline.writer import AsyncCheckpointWriter
+
+__all__ = ["AsyncCheckpointWriter", "Handoff", "HostSnapshot"]

@@ -1,0 +1,53 @@
+"""Closeable FIFO handoff between pipeline stages.
+
+The serve scheduler's pipelined dispatch is a two-thread pipeline: a
+dispatcher claims batches, stages host operands, and posts the async device
+dispatch; a completer blocks on readback, journals, and finalizes jobs —
+in COMPLETION order (the window accounting lives in the scheduler's own
+condition variable; this class is only the ordered conduit between the two
+stages). The same shape as the reference's iwrite-then-wait split, applied
+to batch dispatch instead of file I/O.
+
+Deliberately tiny: ``put`` never blocks (the scheduler bounds in-flight
+work BEFORE claiming, so the queue can never exceed the window depth);
+``get`` blocks until an item or close; ``close`` drains — consumers see
+every item already put, then ``None``. A ``queue.Queue`` + in-band None
+sentinel would cover the happy path, but here put-after-close is a LOUD
+error (a dispatcher bug must not silently enqueue work no completer will
+ever see) and ``None`` stays out of band — that contract is the class.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class Handoff:
+    """Unbounded closeable FIFO; ``get`` returns None once closed and empty."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items: collections.deque = collections.deque()
+        self._closed = False
+
+    def put(self, item) -> None:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("handoff is closed")
+            self._items.append(item)
+            self._cv.notify_all()
+
+    def get(self):
+        """Next item, blocking; None when closed and drained."""
+        with self._cv:
+            while not self._items and not self._closed:
+                self._cv.wait()
+            if self._items:
+                return self._items.popleft()
+            return None
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()

@@ -1,0 +1,95 @@
+"""Host mirror of a (possibly sharded) device array.
+
+The async checkpoint writer must keep NO reference to device buffers once
+``save()`` returns: the segment runners donate their carried state
+(ops/jit_compat.py), so the array handed to ``save`` is consumed by the
+very next segment dispatch. ``HostSnapshot`` is the foreground copy that
+makes this safe — and it exposes exactly the surface the payload writers
+already consume from a ``jax.Array``:
+
+- ``.shape`` / ``.dtype`` — geometry checks (packed_io, ts_store);
+- ``.addressable_shards`` with per-shard ``.index`` / ``.data`` — the shard
+  walk of ``io/sharded.write_sharded``, ``io/packed_io.write_packed``,
+  ``io/ts_store._write_shards``, and ``resilience.checkpoint.
+  _shard_checksums``, with ``.data`` now a host ndarray;
+- ``.sharding`` — ts_store reads ``sharding.mesh`` to pick chunk layout
+  (a Sharding is host metadata; holding it pins no device memory);
+- ``__array__`` — the gather fallback (``np.asarray`` in text_grid /
+  write_gathered).
+
+Because the shard decomposition is mirrored 1:1, every payload a writer
+produces from a snapshot is byte-identical to what it would have produced
+from the live device array (pinned by tests/test_pipeline.py), and the
+manifest's geometry-keyed CRC blocks come out identical too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class _HostShard:
+    """One shard's host copy: the two attributes every writer reads."""
+
+    index: tuple  # tuple of slices into the global array
+    data: np.ndarray
+
+
+class HostSnapshot:
+    """Device->host copy of an array, shard structure preserved.
+
+    Construction BLOCKS until every shard's bytes are on the host — the
+    donation-safety contract: after ``HostSnapshot(state)`` returns, the
+    caller may free/donate ``state``.
+    """
+
+    def __init__(self, state):
+        self.shape = tuple(int(d) for d in state.shape)
+        self.dtype = np.dtype(getattr(state, "dtype", None) or np.uint8)
+        # Sharding/mesh metadata only — never a device buffer.
+        self.sharding = getattr(state, "sharding", None)
+        shards = getattr(state, "addressable_shards", None)
+        if shards is None:  # plain ndarray (or anything array-like)
+            full = np.ascontiguousarray(np.asarray(state))
+            self.dtype = full.dtype
+            self.addressable_shards = [
+                _HostShard(index=tuple(slice(None) for _ in self.shape),
+                           data=full)
+            ]
+        else:
+            self.addressable_shards = [
+                _HostShard(index=shard.index,
+                           data=np.ascontiguousarray(np.asarray(shard.data)))
+                for shard in shards
+            ]
+
+    def __array__(self, dtype=None, copy=None):
+        """Assemble the full host array (the gather-writer fallback).
+
+        The common case — one shard spanning the whole array (single-device
+        runs) — returns that shard's buffer directly: the text codec calls
+        this once per checkpoint on the background writer thread, and an
+        avoidable full-grid copy there is exactly the class of cost this
+        package exists to remove."""
+        if len(self.addressable_shards) == 1:
+            only = self.addressable_shards[0]
+            if only.data.shape == self.shape:
+                out = (only.data if dtype is None
+                       else only.data.astype(dtype, copy=False))
+                # Honor an explicit copy request (NumPy 2 __array__
+                # protocol): the fast path otherwise hands out the internal
+                # shard buffer, which a caller must not mutate in place.
+                if copy and out is only.data:
+                    out = out.copy()
+                return out
+        full = np.zeros(self.shape, self.dtype)
+        for shard in self.addressable_shards:
+            full[shard.index] = shard.data
+        return full if dtype is None else full.astype(dtype, copy=False)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(s.data.nbytes) for s in self.addressable_shards)

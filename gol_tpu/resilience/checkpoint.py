@@ -171,14 +171,14 @@ def _shard_checksums(state) -> dict[str, int]:
     shards = getattr(state, "addressable_shards", None)
     if shards is None:  # plain ndarray
         block = np.ascontiguousarray(np.asarray(state))
-        return {_block_key(0, h, 0, w): zlib.crc32(block.tobytes())}
+        return {_block_key(0, h, 0, w): zlib.crc32(block)}
     sums = {}
     for shard in shards:
         rows, cols = shard.index[0], shard.index[1]
         r0, r1, _ = rows.indices(h)
         c0, c1, _ = cols.indices(w)
         block = np.ascontiguousarray(np.asarray(shard.data))
-        sums[_block_key(r0, r1, c0, c1)] = zlib.crc32(block.tobytes())
+        sums[_block_key(r0, r1, c0, c1)] = zlib.crc32(block)
     return sums
 
 
@@ -236,7 +236,7 @@ def _verify_checksums(state, checksums: dict[str, int]) -> tuple[bool, set[str]]
         host = np.asarray(state)
         for key, want in checksums.items():
             r0, r1, c0, c1 = _parse_key(key)
-            got = zlib.crc32(np.ascontiguousarray(host[r0:r1, c0:c1]).tobytes())
+            got = zlib.crc32(np.ascontiguousarray(host[r0:r1, c0:c1]))
             if got != int(want):
                 ok = False
             else:
@@ -270,7 +270,7 @@ def _verify_checksums(state, checksums: dict[str, int]) -> tuple[bool, set[str]]
         for i, (ir0, ir1, ic0, ic1), (sr0, sc0) in pieces:
             region[ir0 - r0 : ir1 - r0, ic0 - c0 : ic1 - c0] = \
                 hosted[i][ir0 - sr0 : ir1 - sr0, ic0 - sc0 : ic1 - sc0]
-        if zlib.crc32(np.ascontiguousarray(region).tobytes()) != int(want):
+        if zlib.crc32(np.ascontiguousarray(region)) != int(want):
             ok = False
         else:
             verified.add(key)
@@ -389,12 +389,31 @@ class CheckpointManager:
         return path
 
     def _save(self, state, generation: int, counter: int) -> str:
+        """The synchronous save: the four staged phases back to back.
+
+        The async writer (gol_tpu/pipeline/writer.py) drives the SAME four
+        phases but defers ``_commit_manifest`` to the next boundary, running
+        ``_write_payload`` on a background thread against a HostSnapshot —
+        which is why the phases are split out rather than inlined here."""
         faults.on_checkpoint_boundary(generation)
+        if self._already_committed(generation):
+            # A resumed run re-reached a boundary it had already committed;
+            # the engine is bit-exact, so the existing checkpoint IS this
+            # state — rewriting it would put a valid manifest over a payload
+            # mid-rewrite, the one window the ordering otherwise closes.
+            return self._manifest_path(generation)
+        self._sweep_stale(generation)
+        local_sums, write_err = self._write_payload(state, generation)
+        return self._commit_manifest(
+            tuple(state.shape), generation, counter, local_sums, write_err
+        )
+
+    def _already_committed(self, generation: int) -> bool:
+        """Whether a valid checkpoint for ``generation`` already exists."""
         import jax
 
-        multihost = jax.process_count() > 1
         manifest_path = self._manifest_path(generation)
-        if multihost:
+        if jax.process_count() > 1:
             # The skip must be a COLLECTIVE decision: a lone process skipping
             # (or sweeping the shared manifest) while peers rewrite would
             # desynchronize the barrier sequence below and deadlock the
@@ -404,25 +423,24 @@ class CheckpointManager:
             # every process reaches _collective_is_valid's one collective
             # regardless of what its local view of the shared FS says.
             # Unanimous yes -> all skip; otherwise all rewrite.
-            already = self._collective_is_valid(
+            return self._collective_is_valid(
                 self._load(generation)
                 if os.path.exists(manifest_path) else None)
-        else:
-            already = (
-                os.path.exists(manifest_path)
-                and self._load(generation) is not None
-            )
-        if already:
-            # A resumed run re-reached a boundary it had already committed;
-            # the engine is bit-exact, so the existing checkpoint IS this
-            # state — rewriting it would put a valid manifest over a payload
-            # mid-rewrite, the one window the ordering otherwise closes.
-            return manifest_path
-        payload_name = self._payload_name(generation)
-        payload_path = os.path.join(self.directory, payload_name)
+        return (
+            os.path.exists(manifest_path)
+            and self._load(generation) is not None
+        )
+
+    def _sweep_stale(self, generation: int) -> None:
+        """Clear invalid leftovers at this generation's paths before writing."""
+        import jax
+
+        multihost = jax.process_count() > 1
         if not multihost or jax.process_index() == 0:
-            _rmtree_or_file(manifest_path)  # invalid leftover, if any
-            _rmtree_or_file(payload_path)  # torn orphan from a crashed save
+            _rmtree_or_file(self._manifest_path(generation))  # invalid leftover
+            _rmtree_or_file(os.path.join(
+                self.directory, self._payload_name(generation)
+            ))  # torn orphan from a crashed save
         if multihost:
             # The lead's sweep of shared-FS leftovers must finish before any
             # peer starts writing shards into the payload path.
@@ -430,6 +448,22 @@ class CheckpointManager:
 
             multihost_utils.sync_global_devices(
                 f"gol_tpu.ckpt.clean:{self.directory}:{generation}")
+
+    def _write_payload(self, state, generation: int):
+        """Write the payload and checksum it: ``(local_sums, write_err)``.
+
+        Single-process failures raise; multihost failures are RETURNED so
+        the caller's commit phase can vote on them before any collective.
+        ``state`` may be a live device array or a ``pipeline.HostSnapshot``
+        — both expose the shard walk the codecs and ``_shard_checksums``
+        consume, producing byte-identical payloads and CRC blocks.
+        """
+        import jax
+
+        multihost = jax.process_count() > 1
+        payload_path = os.path.join(
+            self.directory, self._payload_name(generation)
+        )
         write_err: Exception | None = None
         local_sums: dict[str, int] = {}
         try:
@@ -448,6 +482,22 @@ class CheckpointManager:
             if not multihost:
                 raise
             write_err = e
+        return local_sums, write_err
+
+    def _commit_manifest(self, state_shape, generation: int, counter: int,
+                         local_sums: dict[str, int],
+                         write_err: Exception | None) -> str:
+        """Vote, merge checksums, commit the manifest atomically, GC.
+
+        The only phase that makes a checkpoint EXIST (a checkpoint exists
+        iff its manifest does) — the async writer defers exactly this call
+        to the next boundary, so its vote ordering and barriers always run
+        on the main thread, in program order."""
+        import jax
+
+        multihost = jax.process_count() > 1
+        manifest_path = self._manifest_path(generation)
+        payload_name = self._payload_name(generation)
         if multihost:
             # A process whose shard write (or checksum pass) failed must not
             # leave its peers parked in the allgather/commit barriers below
@@ -473,7 +523,7 @@ class CheckpointManager:
             "counter": int(counter),
             "height": int(self.height),
             "width": int(self.width),
-            "state_shape": [int(d) for d in state.shape],
+            "state_shape": [int(d) for d in state_shape],
             "payload": payload_name,
             "payload_format": self.codec.format,
             "run_fingerprint": self.run_fingerprint,

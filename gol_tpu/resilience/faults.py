@@ -19,6 +19,12 @@ Knobs (``--fault-plan`` spec / ``GOL_FAULTS`` env var, ``k=v`` comma list):
 - ``payload_write_fail=N`` fail the Nth checkpoint payload write mid-file
 - ``kill_at_gen=K``        crash at the first checkpoint boundary whose
                            generation count is >= K
+- ``kill_during_ckpt_write=N``  crash DURING the Nth checkpoint payload
+                           write (the payload is torn mid-file first) —
+                           with the async writer (gol_tpu/pipeline) this
+                           fires on the background writer thread, modeling
+                           a process dying with a write in flight; the
+                           last *committed* checkpoint must survive
 - ``kill_mode=exception|sigkill``  simulated crash (``InjectedCrash``, a
                            BaseException no library layer catches) or a real
                            ``SIGKILL`` (subprocess harness only)
@@ -61,6 +67,7 @@ class FaultPlan:
     ts_open_transient: int = 0
     payload_write_fail: int | None = None
     kill_at_gen: int | None = None
+    kill_during_ckpt_write: int | None = None
     kill_mode: str = "exception"  # "exception" | "sigkill"
 
     _ts_writes: int = dataclasses.field(default=0, repr=False)
@@ -74,7 +81,7 @@ class FaultPlan:
         injection never silently tests nothing."""
         plan = cls()
         ints = {"ts_write_fail", "ts_open_transient", "payload_write_fail",
-                "kill_at_gen"}
+                "kill_at_gen", "kill_during_ckpt_write"}
         strs = {"ts_write_error": ("hard", "transient"),
                 "kill_mode": ("exception", "sigkill")}
         for part in filter(None, (p.strip() for p in spec.split(","))):
@@ -179,11 +186,41 @@ def on_payload_write(path: str) -> None:
     """Probed right after a checkpoint payload write completes; a firing
     fault TEARS the written payload (mid-file truncation) before raising, so
     the harness proves restore() treats torn payloads as invisible garbage —
-    not merely that an error aborts the manifest commit."""
+    not merely that an error aborts the manifest commit.
+
+    ``kill_during_ckpt_write`` fires here too, but as a process CRASH
+    rather than an I/O error: with the async checkpoint writer this probe
+    runs on the background ``gol-ckpt-writer`` thread, so the kill models
+    exactly the window the deferred-commit discipline protects — a death
+    with a payload write in flight, its manifest never committed. The
+    payload is torn first (the write was "mid-file"), the flight recorder
+    dumps (sigkill gets no unwinding), then ``kill_mode`` decides SIGKILL
+    vs ``InjectedCrash`` (which the writer thread parks and the main thread
+    re-raises at its next drain — the deferred MPI_Wait status)."""
     plan = _active
     if plan is None:
         return
     plan._payload_writes += 1
+    if (
+        plan.kill_during_ckpt_write is not None
+        and plan._payload_writes == plan.kill_during_ckpt_write
+        and not plan._killed
+    ):
+        plan._killed = True
+        _tear(path)
+        from gol_tpu.obs import recorder
+
+        recorder.trigger(
+            f"fault-injection: kill during checkpoint payload write "
+            f"{path} ({plan.kill_mode})"
+        )
+        if plan.kill_mode == "sigkill":
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise InjectedCrash(
+            f"injected crash during checkpoint payload write {path}"
+        )
     if (
         plan.payload_write_fail is not None
         and plan._payload_writes == plan.payload_write_fail

@@ -128,6 +128,69 @@ def bucket_for(job: Job) -> BucketKey:
     )
 
 
+@dataclasses.dataclass
+class StagedServeBatch:
+    """One bucket batch staged on the host (validated, stacked, packed)."""
+
+    key: BucketKey
+    jobs: list
+    staged: engine.StagedBatch
+
+
+@dataclasses.dataclass
+class InflightServeBatch:
+    """One bucket batch dispatched to the device, results not yet fetched."""
+
+    key: BucketKey
+    jobs: list
+    inflight: engine.InflightBatch
+
+
+def stage(key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
+    """Host half of a dispatch: validate membership, stack, pad, pack.
+
+    Pure CPU work (the ``np.packbits`` staging for packed buckets lives
+    here), so the pipelined scheduler runs it while the device computes a
+    previous batch. Raises on empty/oversized batches and foreign jobs —
+    the same checks ``run_batch`` has always enforced."""
+    if not jobs:
+        raise ValueError("cannot stage an empty batch")
+    if len(jobs) > MAX_BATCH:
+        raise ValueError(f"batch of {len(jobs)} exceeds MAX_BATCH={MAX_BATCH}")
+    for job in jobs:
+        jk = bucket_for(job)
+        if jk != key:
+            raise ValueError(
+                f"job {job.id} belongs to bucket {jk.label()}, "
+                f"not {key.label()}"
+            )
+    staged = engine.stage_batch(
+        [job.board for job in jobs],
+        [job.config for job in jobs],
+        padded_shape=(key.height, key.width),
+        pad_batch_to=pad_batch(len(jobs)),
+    )
+    return StagedServeBatch(key=key, jobs=list(jobs), staged=staged)
+
+
+def dispatch(staged: StagedServeBatch) -> InflightServeBatch:
+    """Dispatch a staged batch; returns immediately (JAX async dispatch)."""
+    return InflightServeBatch(
+        key=staged.key, jobs=staged.jobs,
+        inflight=engine.dispatch_batch(staged.staged),
+    )
+
+
+def complete(inflight: InflightServeBatch) -> list[JobResult]:
+    """Block on an in-flight batch and crop per-job results (job order)."""
+    results = engine.complete_batch(inflight.inflight)
+    return [
+        JobResult(grid=r.grid, generations=r.generations,
+                  exit_reason=r.exit_reason)
+        for r in results
+    ]
+
+
 def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
     """Dispatch one bucket's batch through the batched engine.
 
@@ -135,6 +198,10 @@ def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
     ladder with inert zero boards), runs the cached compiled program, and
     crops each board's slice back out. Per-board results are bit-identical
     to solo runs (the engine contract); ordering matches ``jobs``.
+
+    This synchronous form rides ``engine.simulate_batch`` (itself the
+    staged split back to back, one thread); the pipelined scheduler calls
+    ``stage``/``dispatch``/``complete`` from its own threads instead.
     """
     if not jobs:
         return []

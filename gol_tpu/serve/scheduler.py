@@ -28,13 +28,32 @@ error recorded in journal and job state.
 Graceful drain: ``drain()`` stops admission, flushes every queued bucket,
 and returns when the last in-flight batch completes — the SIGTERM story for
 ``gol serve``.
+
+**Pipelined dispatch** (``pipeline_depth`` >= 2, ``gol serve
+--pipeline-depth``): the single synchronous worker — stage, compute,
+readback, journal strictly in series, host idle while the device computes
+and vice versa — is replaced by a two-thread pipeline over a bounded
+in-flight window: a *dispatcher* claims batches, stages host operands
+(``batcher.stage``: stacking + ``np.packbits``), and posts the async device
+dispatch without blocking; a *completer* blocks on readback, journals, and
+finalizes — so the device computes batch N while the host stages N+1 and
+journals N-1 (the iwrite/wait-at-next-boundary discipline of the
+reference's async variant, applied to batch dispatch;
+gol_tpu/pipeline/inflight.py is the handoff). Everything observable is
+preserved: exactly-once journal semantics, admission caps, drain, and
+per-batch retry (the retry wraps dispatch+complete of one batch — a
+failed completion re-dispatches from the retained host staging), and
+COMPLETION order, not submission order, drives ``inflight_batches``. At
+the default depth 1 the original worker loop runs, untouched.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
+from typing import Any
 
 from gol_tpu.obs import trace as obs_trace
 from gol_tpu.resilience.retry import RetryPolicy, is_transient_io
@@ -63,6 +82,24 @@ DEFAULT_DISPATCH_RETRY = RetryPolicy(attempts=3, base_delay=0.05,
                                      multiplier=4.0, max_delay=1.0)
 
 
+@dataclasses.dataclass
+class _Flight:
+    """One claimed batch moving through the dispatcher->completer pipeline.
+
+    ``inflight`` holds the async-dispatched device futures (None when the
+    split path is unavailable — an injected ``run_batch`` — or when staging
+    itself failed, recorded in ``error`` for the completer's retry policy
+    to classify)."""
+
+    key: BucketKey
+    batch: list
+    started: float
+    staged: Any = None  # retained host staging (retries re-dispatch from it)
+    inflight: Any = None
+    error: Exception | None = None
+    consumed: bool = False  # first completion attempt taken
+
+
 class Scheduler:
     """Owns the queue, the worker threads, and the job table."""
 
@@ -74,9 +111,11 @@ class Scheduler:
         max_batch: int = batcher.MAX_BATCH,
         flush_age: float = 0.05,
         max_inflight: int = 1,
+        pipeline_depth: int = 1,
         retry: RetryPolicy = DEFAULT_DISPATCH_RETRY,
         retryable=is_transient_io,
         run_batch=batcher.run_batch,
+        split_batch=None,
         clock=time.perf_counter,
     ):
         if max_queue_depth < 1:
@@ -87,15 +126,34 @@ class Scheduler:
             )
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}"
+            )
+        if pipeline_depth > 1 and max_inflight != 1:
+            raise ValueError(
+                "pipeline_depth > 1 replaces the worker pool with the "
+                "dispatcher/completer pipeline; leave max_inflight at 1"
+            )
         self.journal = journal
         self.metrics = metrics or Metrics()
         self.max_queue_depth = max_queue_depth
         self.max_batch = max_batch
         self.flush_age = flush_age
         self.max_inflight = max_inflight
+        self.pipeline_depth = pipeline_depth
         self.retry = retry
         self.retryable = retryable
         self._run_batch = run_batch
+        # The staged dispatch path (stage -> async dispatch -> complete).
+        # Auto-wired to the batcher's split only when run_batch is the
+        # default batcher entry: an injected run_batch (tests, alternative
+        # engines) has no split, so the completer runs it whole — pipeline
+        # semantics hold, only the stage/compute overlap is lost.
+        if split_batch is None and run_batch is batcher.run_batch:
+            split_batch = (batcher.stage, batcher.dispatch, batcher.complete)
+        self._split = split_batch
+        self._window = None  # dispatcher->completer handoff (pipelined mode)
         self._clock = clock
         self._cv = threading.Condition()
         self._jobs: dict[str, Job] = {}
@@ -113,6 +171,21 @@ class Scheduler:
             if self._threads:
                 return
             self._stopped = False
+            if self.pipeline_depth > 1:
+                # Pipelined dispatch: one dispatcher (claim + stage + async
+                # dispatch) and one completer (readback + journal), with at
+                # most pipeline_depth batches between claim and completion.
+                from gol_tpu.pipeline.inflight import Handoff
+
+                self._window = Handoff()
+                for name, target in (
+                    ("gol-serve-dispatch", self._dispatch_loop),
+                    ("gol-serve-complete", self._complete_loop),
+                ):
+                    t = threading.Thread(target=target, name=name, daemon=True)
+                    t.start()
+                    self._threads.append(t)
+                return
             # One worker per allowed in-flight batch: the thread count IS
             # the max-in-flight-batches admission knob.
             for i in range(self.max_inflight):
@@ -238,18 +311,21 @@ class Scheduler:
                 due = min(due, j.accepted_at + j.deadline_s)
         return due
 
+    def _bucket_ready(self, pending: list[Job], now: float) -> bool:
+        """The ONE dispatch-readiness predicate (size / age+deadline /
+        drain), shared by claiming and by the pipelined dispatcher's
+        stall classification so the two can never disagree."""
+        return (
+            self._draining
+            or len(pending) >= self.max_batch
+            or self._bucket_due_at(pending) <= now
+        )
+
     def _claim_locked(self, now: float):
         """Pick the most urgent ready bucket and take a batch from it."""
         best = None
         for key, pending in self._buckets.items():
-            if not pending:
-                continue
-            ready = (
-                self._draining
-                or len(pending) >= self.max_batch
-                or self._bucket_due_at(pending) <= now
-            )
-            if not ready:
+            if not pending or not self._bucket_ready(pending, now):
                 continue
             urgency = min(j.dispatch_key() for j in pending)
             if best is None or urgency < best[0]:
@@ -300,8 +376,7 @@ class Scheduler:
                     self.metrics.set_gauge("inflight_batches", self._inflight)
                     self._cv.notify_all()
 
-    def _execute(self, key: BucketKey, batch: list[Job]) -> None:
-        started = self._clock()
+    def _begin_batch(self, batch: list[Job], started: float) -> None:
         for job in batch:
             job.started_at = started
             job.transition(RUNNING)
@@ -309,6 +384,7 @@ class Scheduler:
                 "queue_latency_seconds", started - job.accepted_at
             )
 
+    def _on_retry(self, key: BucketKey, batch: list[Job]):
         def on_retry(attempt, err, delay):
             self.metrics.inc("batch_retries_total")
             logger.warning(
@@ -318,31 +394,23 @@ class Scheduler:
                 type(err).__name__, err,
             )
 
-        try:
-            # The batch span: what a traced `gol serve` session exports and
-            # what `GET /debug/trace` shows mid-flight. One span per
-            # dispatched batch, labeled with its padding bucket — a session
-            # serving two bucket shapes shows two distinct batch lanes.
-            with obs_trace.span("serve.batch", bucket=key.label(),
-                                jobs=len(batch)):
-                results = self.retry.call(
-                    lambda: self._run_batch(key, batch),
-                    retryable=self.retryable,
-                    on_retry=on_retry,
-                )
-        except Exception as err:  # noqa: BLE001 - every job must terminate
-            finished = self._clock()
-            logger.error(
-                "batch %s (%d jobs) failed: %s: %s",
-                key.label(), len(batch), type(err).__name__, err,
-            )
-            for job in batch:
-                job.finished_at = finished
-                job.error = f"{type(err).__name__}: {err}"
-                job.transition(FAILED)
-                self.metrics.inc("jobs_failed_total")
-                self._journal_terminal(JobJournal.record_failed, job)
-            return
+        return on_retry
+
+    def _fail_batch(self, key: BucketKey, batch: list[Job], err) -> None:
+        finished = self._clock()
+        logger.error(
+            "batch %s (%d jobs) failed: %s: %s",
+            key.label(), len(batch), type(err).__name__, err,
+        )
+        for job in batch:
+            job.finished_at = finished
+            job.error = f"{type(err).__name__}: {err}"
+            job.transition(FAILED)
+            self.metrics.inc("jobs_failed_total")
+            self._journal_terminal(JobJournal.record_failed, job)
+
+    def _finish_batch(self, key: BucketKey, batch: list[Job], results,
+                      started: float) -> None:
         finished = self._clock()
         elapsed = max(finished - started, 1e-9)
         # The same rung run_batch padded to: occupancy is boards over the
@@ -359,6 +427,145 @@ class Scheduler:
             job.transition(DONE)
             self.metrics.inc("jobs_completed_total")
             self._journal_terminal(JobJournal.record_done, job)
+
+    def _execute(self, key: BucketKey, batch: list[Job]) -> None:
+        started = self._clock()
+        self._begin_batch(batch, started)
+        try:
+            # The batch span: what a traced `gol serve` session exports and
+            # what `GET /debug/trace` shows mid-flight. One span per
+            # dispatched batch, labeled with its padding bucket — a session
+            # serving two bucket shapes shows two distinct batch lanes.
+            with obs_trace.span("serve.batch", bucket=key.label(),
+                                jobs=len(batch)):
+                results = self.retry.call(
+                    lambda: self._run_batch(key, batch),
+                    retryable=self.retryable,
+                    on_retry=self._on_retry(key, batch),
+                )
+        except Exception as err:  # noqa: BLE001 - every job must terminate
+            self._fail_batch(key, batch, err)
+            return
+        self._finish_batch(key, batch, results, started)
+
+    # -- the pipelined dispatcher/completer pair ---------------------------
+
+    def _ready_bucket_exists(self, now: float) -> bool:
+        """Whether some bucket is dispatch-ready (the claim predicate,
+        without claiming) — used only to classify a full-window wait as a
+        pipeline stall."""
+        return any(
+            pending and self._bucket_ready(pending, now)
+            for pending in self._buckets.values()
+        )
+
+    def _dispatch_loop(self) -> None:
+        """Claim -> stage -> async dispatch; never blocks on device results.
+
+        Claims only while fewer than ``pipeline_depth`` batches are between
+        claim and completion (the bounded in-flight window); a wait forced
+        by a full window with work ready counts as ``pipeline_stalls_total``
+        (the signal that depth, not load, is the limiter)."""
+        window = self._window
+        while True:
+            with self._cv:
+                claimed = None
+                stalled = False
+                while not self._stopped:
+                    now = self._clock()
+                    if self._inflight >= self.pipeline_depth:
+                        # Window full: only a completion (or stop) can make
+                        # progress — wait for its notify, NOT for a bucket
+                        # due time (a past-due bucket would turn the timed
+                        # wait into a hot spin against the completer's lock).
+                        if not stalled and self._ready_bucket_exists(now):
+                            stalled = True
+                            self.metrics.inc("pipeline_stalls_total")
+                        self._cv.wait()
+                        continue
+                    claimed = self._claim_locked(now)
+                    if claimed is not None:
+                        break
+                    due = self._next_due()
+                    wait = None if due is None else max(0.0, due - self._clock())
+                    self._cv.wait(timeout=wait)
+                if claimed is None:
+                    break  # stopped
+            key, batch = claimed
+            window.put(self._launch(key, batch))
+        # Completion order is the window order; the sentinel follows every
+        # already-posted flight, so the completer drains then exits.
+        window.close()
+
+    def _launch(self, key: BucketKey, batch: list[Job]) -> _Flight:
+        started = self._clock()
+        self._begin_batch(batch, started)
+        flight = _Flight(key=key, batch=batch, started=started)
+        if self._split is None:
+            return flight  # completer runs self._run_batch whole
+        stage_fn, dispatch_fn, _ = self._split
+        try:
+            with obs_trace.span("pipeline.stage", bucket=key.label(),
+                                jobs=len(batch)):
+                flight.staged = stage_fn(key, batch)
+            flight.inflight = dispatch_fn(flight.staged)
+        except Exception as err:  # noqa: BLE001 - completer owns terminality
+            # Carried to the completer so ONE code path (its retry policy)
+            # classifies every failure: a transient dispatch error retries
+            # the whole batch there; a hard one fails the jobs there.
+            flight.error = err
+        return flight
+
+    def _complete_loop(self) -> None:
+        """Readback + journal, in completion (window) order."""
+        window = self._window
+        while True:
+            flight = window.get()
+            if flight is None:
+                return  # dispatcher closed the window after its last put
+            try:
+                self._complete_flight(flight)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self.metrics.set_gauge("inflight_batches", self._inflight)
+                    self._cv.notify_all()
+
+    def _complete_flight(self, flight: _Flight) -> None:
+        key, batch = flight.key, flight.batch
+        complete_fn = self._split[2] if self._split is not None else None
+
+        def attempt():
+            # First attempt consumes the pipelined dispatch; retries re-run
+            # dispatch + complete of THIS batch from the retained host
+            # staging (no re-stacking/packbits) — GoL runs are pure
+            # functions of the input, so a re-run is idempotent (the same
+            # contract the depth-1 worker's retry relies on). When there is
+            # no staging to retain (injected run_batch, or the failure was
+            # in stage() itself), the retry re-runs the whole batch.
+            if not flight.consumed:
+                flight.consumed = True
+                if flight.error is not None:
+                    raise flight.error
+                if flight.inflight is not None:
+                    return complete_fn(flight.inflight)
+            if self._split is not None and flight.staged is not None:
+                _, dispatch_fn, _ = self._split
+                return complete_fn(dispatch_fn(flight.staged))
+            return self._run_batch(key, batch)
+
+        try:
+            with obs_trace.span("serve.batch", bucket=key.label(),
+                                jobs=len(batch)):
+                results = self.retry.call(
+                    attempt,
+                    retryable=self.retryable,
+                    on_retry=self._on_retry(key, batch),
+                )
+        except Exception as err:  # noqa: BLE001 - every job must terminate
+            self._fail_batch(key, batch, err)
+            return
+        self._finish_batch(key, batch, results, flight.started)
 
     def _journal_terminal(self, record_fn, job: Job) -> None:
         """Append a terminal record, surviving journal I/O failure.

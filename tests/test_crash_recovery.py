@@ -313,6 +313,86 @@ def test_packed_io_hard_shard_write_failure_mid_checkpoint(tmp_path, capsys):
     assert out.read_bytes() == out_ref.read_bytes()
 
 
+def test_kill_during_async_write_then_auto_resume(tmp_path, grid16,
+                                                  reference, capsys):
+    """SIGKILL-equivalent crash while the ASYNC writer (the default
+    checkpoint lane since the pipeline PR) is mid-payload-write: the torn
+    payload must never become a visible checkpoint (its manifest commits
+    only at the next boundary's deferred wait, which the crash precedes),
+    the previous boundary's checkpoint — committed by THIS boundary's wait
+    — survives, and auto-resume is byte-identical. This is the gen-limit
+    exit path; the similarity-exit path is the test below."""
+    ref_bytes, ref_gens = reference
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    # Payload write #2 is generation 6's: at that moment the deferred wait
+    # at boundary 6 has already committed generation 3.
+    with pytest.raises(InjectedCrash):
+        cli.main(_args(grid16, out, ckdir,
+                       "--fault-plan", "kill_during_ckpt_write=2"))
+    _assert_prior_state_readable(str(ckdir))
+    manifests = sorted(
+        n for n in os.listdir(ckdir) if n.endswith(".manifest.json"))
+    assert manifests == ["ckpt-00000003.manifest.json"]
+    assert not out.exists()
+
+    rc, cap = _run(capsys, _args(grid16, out, ckdir, "--auto-resume"))
+    assert rc == 0
+    assert out.read_bytes() == ref_bytes
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_kill_during_async_write_similarity_exit_path(tmp_path, capsys):
+    """Same mid-async-write kill on a run that ends in a similarity early
+    exit (generation 23): the resumed run must report the identical exit
+    generation and output — the other exit path of the acceptance."""
+    infile = tmp_path / "sim.txt"
+    text_grid.write_grid(str(infile), text_grid.generate(16, 16, seed=26,
+                                                         density=0.25))
+    base = ["16", "16", str(infile), "--variant", "game", "--gen-limit", "40"]
+    out_ref = tmp_path / "ref.out"
+    rc, cap = _run(capsys, [*base, "--output", str(out_ref)])
+    assert rc == 0
+    ref_gens = _gens_line(cap.out)
+    assert ref_gens and ref_gens[0].split("\t")[1] == "23"  # scenario sanity
+
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    ck = ["--checkpoint-every", "5", "--checkpoint-dir", str(ckdir),
+          "--output", str(out)]
+    # Payload write #3 is generation 15's; boundaries 5 and 10 committed.
+    with pytest.raises(InjectedCrash):
+        cli.main([*base, *ck, "--fault-plan", "kill_during_ckpt_write=3"])
+    _assert_prior_state_readable(str(ckdir))
+    names = os.listdir(ckdir)
+    assert "ckpt-00000010.manifest.json" in names
+    assert "ckpt-00000015.manifest.json" not in names
+
+    rc, cap = _run(capsys, [*base, *ck, "--auto-resume"])
+    assert rc == 0
+    assert out.read_bytes() == out_ref.read_bytes()
+    assert _gens_line(cap.out) == ref_gens
+
+
+def test_kill_during_sync_write_matches_async_semantics(tmp_path, grid16,
+                                                        reference, capsys):
+    """The same fault on the --sync-checkpoints lane: the kill fires inside
+    the foreground save, the in-progress checkpoint never commits, and
+    resume is byte-identical — the two writers share one crash contract."""
+    ref_bytes, ref_gens = reference
+    ckdir, out = tmp_path / "ck", tmp_path / "out.out"
+    with pytest.raises(InjectedCrash):
+        cli.main(_args(grid16, out, ckdir, "--sync-checkpoints",
+                       "--fault-plan", "kill_during_ckpt_write=2"))
+    _assert_prior_state_readable(str(ckdir))
+    names = os.listdir(ckdir)
+    assert "ckpt-00000003.manifest.json" in names
+    assert "ckpt-00000006.manifest.json" not in names
+
+    rc, cap = _run(capsys, _args(grid16, out, ckdir, "--auto-resume"))
+    assert rc == 0
+    assert out.read_bytes() == ref_bytes
+    assert _gens_line(cap.out) == ref_gens
+
+
 def test_transient_faults_heal_without_aborting(tmp_path, grid16, reference,
                                                 capsys):
     """Transient injected IO failures are retried under the unified policy:

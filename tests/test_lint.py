@@ -68,6 +68,21 @@ def test_no_wall_clock_in_obs():
         )
 
 
+def test_no_wall_clock_in_pipeline():
+    """Same rule for gol_tpu/pipeline/: the async writer's hidden-time and
+    stall accounting (``checkpoint_write_hidden_seconds``,
+    ``pipeline_stalls_total``) and every handoff wait are
+    ``time.perf_counter()`` only — a stepped wall clock would turn
+    "how much I/O did compute hide" into a negative number."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "pipeline", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/pipeline/ (use "
+            f"time.perf_counter() for every overlap/stall measurement): "
+            f"{offenders}"
+        )
+
+
 def test_no_wall_clock_in_tune():
     """Same rule for gol_tpu/tune/, where the stakes are higher still: a
     wall-clock step during a timed trial silently corrupts the *persisted*

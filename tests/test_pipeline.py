@@ -1,0 +1,516 @@
+"""Async execution pipeline (ISSUE 5): overlap host work with device compute.
+
+Covers the three legs of gol_tpu/pipeline:
+
+- the async checkpoint writer: byte-compatibility with the sync path
+  (outputs AND payloads), deferred-commit crash semantics, error
+  propagation one boundary late, thread hygiene on both exit paths;
+- the pipelined serve dispatch (``pipeline_depth`` >= 2): exactly-once
+  results, retry, failure terminality, drain, thread hygiene;
+- the engine's staged batch split and the donation compat shim.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from gol_tpu import cli, engine
+from gol_tpu.config import GameConfig
+from gol_tpu.io import text_grid
+from gol_tpu.obs import recorder, registry as obs_registry
+from gol_tpu.pipeline.inflight import Handoff
+from gol_tpu.pipeline.snapshot import HostSnapshot
+from gol_tpu.pipeline.writer import AsyncCheckpointWriter
+from gol_tpu.resilience import faults
+from gol_tpu.resilience.checkpoint import CheckpointManager, PayloadCodec
+from gol_tpu.resilience.faults import InjectedCrash
+from gol_tpu.serve import batcher
+from gol_tpu.serve.jobs import DONE, FAILED, JobJournal, new_job
+from gol_tpu.serve.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _pipeline_threads():
+    """Threads this PR's machinery creates (writer + serve pipeline)."""
+    return [
+        t.name for t in threading.enumerate()
+        if t.name.startswith(("gol-ckpt-writer", "gol-serve-"))
+    ]
+
+
+GEN_LIMIT = 12
+EVERY = 3
+
+
+def _run(capsys, args):
+    capsys.readouterr()
+    rc = cli.main(args)
+    return rc, capsys.readouterr()
+
+
+def _args(infile, out, ckdir, *extra):
+    return [
+        "16", "16", str(infile), "--variant", "game",
+        "--gen-limit", str(GEN_LIMIT),
+        "--checkpoint-every", str(EVERY),
+        "--checkpoint-dir", str(ckdir),
+        "--output", str(out),
+        *extra,
+    ]
+
+
+@pytest.fixture
+def grid16(tmp_path):
+    p = tmp_path / "in.txt"
+    text_grid.write_grid(str(p), text_grid.generate(16, 16, seed=77))
+    return str(p)
+
+
+class TestAsyncWriterCLI:
+    def test_async_and_sync_byte_identical(self, tmp_path, grid16, capsys):
+        """The acceptance pin: async (default) and --sync-checkpoints runs
+        produce bit-identical final grids AND checkpoint payloads."""
+        ref = tmp_path / "ref.out"
+        rc, cap = _run(capsys, [
+            "16", "16", grid16, "--variant", "game",
+            "--gen-limit", str(GEN_LIMIT), "--output", str(ref)])
+        assert rc == 0
+        ref_gens = [l for l in cap.out.splitlines() if l.startswith("Generations")]
+
+        outs, dirs, gens = {}, {}, {}
+        for mode, extra in (("async", ()), ("sync", ("--sync-checkpoints",))):
+            out = tmp_path / f"{mode}.out"
+            ck = tmp_path / f"ck-{mode}"
+            rc, cap = _run(capsys, _args(
+                grid16, out, ck, "--checkpoint-keep", "8", *extra))
+            assert rc == 0
+            outs[mode] = out.read_bytes()
+            dirs[mode] = ck
+            gens[mode] = [l for l in cap.out.splitlines()
+                          if l.startswith("Generations")]
+        assert outs["async"] == outs["sync"] == ref.read_bytes()
+        assert gens["async"] == gens["sync"] == ref_gens
+        payloads = sorted(
+            n for n in os.listdir(dirs["sync"]) if n.endswith(".out"))
+        assert payloads  # the run actually checkpointed
+        assert payloads == sorted(
+            n for n in os.listdir(dirs["async"]) if n.endswith(".out"))
+        for name in payloads:
+            assert (dirs["async"] / name).read_bytes() == \
+                (dirs["sync"] / name).read_bytes()
+
+    def test_no_thread_leak_clean_run(self, tmp_path, grid16, capsys):
+        rc, _ = _run(capsys, _args(grid16, tmp_path / "o.out", tmp_path / "ck"))
+        assert rc == 0
+        assert _pipeline_threads() == []
+
+    def test_no_thread_leak_error_path(self, tmp_path, grid16):
+        """join-on-exit also when the run crashes mid-loop (the writer's
+        close() runs in the segment loop's finally)."""
+        with pytest.raises(InjectedCrash):
+            cli.main(_args(grid16, tmp_path / "o.out", tmp_path / "ck",
+                           "--fault-plan", "kill_at_gen=6"))
+        assert _pipeline_threads() == []
+
+    def test_background_write_failure_surfaces_one_boundary_late(
+        self, tmp_path, grid16, capsys
+    ):
+        """An injected hard write fault in the background writer aborts the
+        run (rc 1, the CLI error contract) with the torn checkpoint
+        invisible and the previous one committed — the deferred MPI_Wait
+        status of the reference's async variant."""
+        ck = tmp_path / "ck"
+        rc, cap = _run(capsys, _args(
+            grid16, tmp_path / "o.out", ck, "--fault-plan",
+            "payload_write_fail=2"))
+        assert rc == 1
+        assert "injected" in cap.err
+        names = os.listdir(ck)
+        assert "ckpt-00000003.manifest.json" in names
+        assert "ckpt-00000006.manifest.json" not in names
+        assert _pipeline_threads() == []
+
+    def test_writer_queue_metrics_and_hidden_time(self, tmp_path, grid16,
+                                                  capsys):
+        obs_registry.reset_default()
+        rc, _ = _run(capsys, _args(grid16, tmp_path / "o.out", tmp_path / "ck"))
+        assert rc == 0
+        reg = obs_registry.default()
+        assert reg.counter("checkpoint_saves_total") == 3  # gens 3, 6, 9
+        assert reg.counter("checkpoint_write_hidden_seconds") >= 0
+        snap = reg.snapshot()
+        assert snap["gauges"].get("ckpt_writer_queue_depth") == 0
+
+
+class TestAsyncWriterUnit:
+    def _mgr(self, tmp_path, n=16, **kwargs):
+        return CheckpointManager(
+            str(tmp_path / "ck"), height=n, width=n,
+            codec=PayloadCodec(
+                format="text-grid", suffix=".out",
+                write=lambda p, s: text_grid.write_grid(
+                    p, np.asarray(s, dtype=np.uint8)),
+                read=lambda p: text_grid.read_grid(p, n, n),
+            ),
+            **kwargs,
+        )
+
+    def test_commit_is_deferred_to_drain(self, tmp_path):
+        """After save() returns, the checkpoint must NOT exist yet (its
+        manifest commits at the next boundary/drain) — the write-ahead
+        contract is literally 'not committed until the deferred wait'."""
+        mgr = self._mgr(tmp_path)
+        writer = AsyncCheckpointWriter(mgr)
+        try:
+            state = text_grid.generate(16, 16, seed=1)
+            writer.save(state, 3, 0)
+            # The payload write may or may not have finished; the MANIFEST
+            # must not exist until drain() commits it.
+            assert not os.path.exists(
+                str(tmp_path / "ck" / "ckpt-00000003.manifest.json"))
+            writer.drain()
+            assert os.path.exists(
+                str(tmp_path / "ck" / "ckpt-00000003.manifest.json"))
+            restored = mgr.restore()
+            assert restored is not None
+            got, info = restored
+            assert info.generation == 3
+            assert np.array_equal(np.asarray(got, dtype=np.uint8), state)
+        finally:
+            writer.close()
+        assert _pipeline_threads() == []
+
+    def test_flight_recorder_dump_carries_writer_state(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        writer = AsyncCheckpointWriter(mgr)
+        recorder.install(str(tmp_path / "flight"))
+        try:
+            writer.save(text_grid.generate(16, 16, seed=2), 3, 0)
+            path = recorder.trigger("test")
+            records = recorder.read_dump(path)
+            states = [r for r in records if r.get("record") == "state"]
+            assert any(r.get("name") == "checkpoint_writer" for r in states)
+            (state,) = [r for r in states if r["name"] == "checkpoint_writer"]
+            assert state["pending_generation"] in (None, 3)
+            writer.drain()
+        finally:
+            writer.close()
+            recorder.uninstall()
+        # close() unregisters the provider: later dumps drop the entry.
+        path = recorder.trigger("after-close")
+        assert path is None  # unarmed now
+
+    def test_double_save_skips_already_committed(self, tmp_path):
+        """A resumed run re-reaching a committed boundary must not rewrite
+        it (the sync path's `already` rule, preserved across the split)."""
+        obs_registry.reset_default()
+        mgr = self._mgr(tmp_path)
+        state = text_grid.generate(16, 16, seed=3)
+        writer = AsyncCheckpointWriter(mgr)
+        try:
+            writer.save(state, 3, 0)
+            writer.drain()
+            manifest = tmp_path / "ck" / "ckpt-00000003.manifest.json"
+            before = manifest.read_bytes()
+            writer.save(state, 3, 0)  # same boundary again
+            writer.drain()
+            assert manifest.read_bytes() == before
+            # The skip counts as a completed save, like the sync lane's
+            # unconditional wrapper increment — A/B metrics parity.
+            reg = obs_registry.default()
+            assert reg.counter("checkpoint_saves_total") == 2
+        finally:
+            writer.close()
+
+
+class TestHostSnapshot:
+    def test_payloads_and_checksums_match_device_writes(self, tmp_path):
+        """A HostSnapshot must be indistinguishable from the live array to
+        the payload writers and the CRC pass (the byte-compat keystone)."""
+        import jax.numpy as jnp
+
+        from gol_tpu.resilience.checkpoint import _shard_checksums
+
+        grid = text_grid.generate(32, 32, seed=4)
+        device = jnp.asarray(grid)
+        snap = HostSnapshot(device)
+        assert snap.shape == (32, 32)
+        a, b = tmp_path / "a.out", tmp_path / "b.out"
+        text_grid.write_grid(str(a), np.asarray(device, dtype=np.uint8))
+        text_grid.write_grid(str(b), np.asarray(snap, dtype=np.uint8))
+        assert a.read_bytes() == b.read_bytes()
+        assert _shard_checksums(device) == _shard_checksums(snap)
+
+    def test_sharded_array_mirrors_shards(self):
+        import jax
+
+        from gol_tpu.parallel.mesh import grid_sharding, make_mesh
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >1 device")
+        mesh = make_mesh(2, 1)
+        grid = text_grid.generate(32, 32, seed=5)
+        device = jax.device_put(grid, grid_sharding(mesh))
+        snap = HostSnapshot(device)
+        assert len(snap.addressable_shards) == len(
+            list(device.addressable_shards))
+        assert np.array_equal(np.asarray(snap), grid)
+        from gol_tpu.resilience.checkpoint import _shard_checksums
+
+        assert _shard_checksums(device) == _shard_checksums(snap)
+
+
+class TestEngineBatchSplit:
+    @pytest.mark.parametrize("convention", ["c", "cuda"])
+    def test_staged_split_equals_simulate_batch(self, convention):
+        boards = [text_grid.generate(24, 24, seed=s) for s in (1, 2, 3)]
+        cfg = GameConfig(gen_limit=16, convention=convention)
+        want = engine.simulate_batch(boards, cfg, padded_shape=(32, 32),
+                                     pad_batch_to=4)
+        staged = engine.stage_batch(boards, cfg, padded_shape=(32, 32),
+                                    pad_batch_to=4)
+        got = engine.complete_batch(engine.dispatch_batch(staged))
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert np.array_equal(g.grid, w.grid)
+            assert g.generations == w.generations
+            assert g.exit_reason == w.exit_reason
+
+    def test_redispatch_same_staging_is_idempotent(self):
+        """The retry contract: dispatching one staging twice gives the same
+        results (host operands are retained; the device buffer is rebuilt)."""
+        boards = [text_grid.generate(32, 32, seed=9)]
+        staged = engine.stage_batch(boards, GameConfig(gen_limit=8))
+        first = engine.complete_batch(engine.dispatch_batch(staged))
+        second = engine.complete_batch(engine.dispatch_batch(staged))
+        assert np.array_equal(first[0].grid, second[0].grid)
+        assert first[0].generations == second[0].generations
+
+    def test_empty_stage_is_none(self):
+        assert engine.stage_batch([], GameConfig()) is None
+
+
+class TestDonationShim:
+    def test_cpu_backend_gets_plain_jit(self, monkeypatch):
+        from gol_tpu.ops import jit_compat
+
+        monkeypatch.setattr(jit_compat, "supports_donation", lambda: False)
+        fn = jit_compat.jit_donating(lambda x: x + 1)
+        assert int(fn(np.int32(1))) == 2
+
+    def test_donating_backend_requests_donation(self, monkeypatch):
+        from gol_tpu.ops import jit_compat
+
+        captured = {}
+
+        def fake_jit(fn, donate_argnums=None):
+            captured["donate"] = donate_argnums
+            return fn
+
+        monkeypatch.setattr(jit_compat, "supports_donation", lambda: True)
+        monkeypatch.setattr(jit_compat.jax, "jit", fake_jit)
+        jit_compat.jit_donating(lambda x: x, donate_argnums=(0,))
+        assert captured["donate"] == (0,)
+
+    def test_segment_runner_values_unchanged(self):
+        """Donation (or its absence) never changes values: the segmented
+        loop still equals the unsegmented one."""
+        grid = text_grid.generate(16, 16, seed=11)
+        cfg = GameConfig(gen_limit=10)
+        solo = engine.simulate(grid, cfg)
+        last = None
+        for gens, state, stopped in engine.simulate_segments(grid, cfg, None,
+                                                             "auto", 3):
+            last = (gens, np.asarray(state, dtype=np.uint8))
+        assert last[0] == solo.generations
+        assert np.array_equal(last[1], solo.grid)
+
+
+class TestHandoff:
+    def test_fifo_and_close(self):
+        h = Handoff()
+        h.put(1)
+        h.put(2)
+        assert h.get() == 1
+        h.close()
+        assert h.get() == 2  # close drains before the sentinel
+        assert h.get() is None
+        with pytest.raises(RuntimeError):
+            h.put(3)
+
+    def test_get_blocks_until_put(self):
+        h = Handoff()
+        got = []
+
+        def consumer():
+            got.append(h.get())
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        h.put("x")
+        t.join(timeout=5)
+        assert got == ["x"]
+
+
+class TestPipelinedScheduler:
+    def test_depth_validation(self):
+        with pytest.raises(ValueError):
+            Scheduler(pipeline_depth=0)
+        with pytest.raises(ValueError):
+            Scheduler(pipeline_depth=2, max_inflight=2)
+
+    def test_depth2_end_to_end_exactly_once(self, tmp_path):
+        journal = JobJournal(str(tmp_path / "j"))
+        sched = Scheduler(journal=journal, flush_age=0.01, max_batch=4,
+                          pipeline_depth=2)
+        jobs = []
+        for i in range(10):
+            side = 32 if i % 2 == 0 else 30  # two buckets
+            board = text_grid.generate(side, side, seed=600 + i)
+            job = new_job(side, side, board, gen_limit=12)
+            jobs.append((job, board))
+            sched.submit(job)
+        sched.start()
+        assert sched.drain(timeout=120)
+        sched.stop(drain=False)
+        assert _pipeline_threads() == []
+        for job, board in jobs:
+            assert job.state == DONE
+            solo = engine.simulate(board, GameConfig(gen_limit=12))
+            assert np.array_equal(job.result.grid, solo.grid)
+            assert job.result.generations == solo.generations
+        replay = journal.replay()
+        journal.close()
+        assert not replay.pending
+        assert set(replay.results) == {job.id for job, _ in jobs}
+        assert sched.metrics.counter("jobs_completed_total") == 10
+        assert sched.stats()["inflight_batches"] == 0
+
+    def test_depth2_transient_error_retries(self):
+        calls = {"n": 0}
+
+        def flaky(key, batch):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("connection reset by peer")
+            return batcher.run_batch(key, batch)
+
+        sched = Scheduler(flush_age=0.0, pipeline_depth=2, run_batch=flaky)
+        job = new_job(32, 32, text_grid.generate(32, 32, seed=13), gen_limit=5)
+        sched.submit(job)
+        sched.start()
+        assert sched.drain(timeout=60)
+        sched.stop(drain=False)
+        assert job.state == DONE
+        assert calls["n"] == 2
+        assert sched.metrics.counter("batch_retries_total") == 1
+
+    def test_depth2_retry_redispatches_from_retained_staging(self):
+        """A transient completion failure retries dispatch+complete from
+        the flight's RETAINED host staging: stage() runs once, dispatch()
+        twice — the documented no-re-staging retry contract."""
+        calls = {"stage": 0, "dispatch": 0, "complete": 0}
+
+        def stage(key, batch):
+            calls["stage"] += 1
+            return batcher.stage(key, batch)
+
+        def dispatch(staged):
+            calls["dispatch"] += 1
+            return batcher.dispatch(staged)
+
+        def complete(inflight):
+            calls["complete"] += 1
+            if calls["complete"] == 1:
+                raise OSError("connection reset by peer")
+            return batcher.complete(inflight)
+
+        sched = Scheduler(flush_age=0.0, pipeline_depth=2,
+                          split_batch=(stage, dispatch, complete))
+        job = new_job(32, 32, text_grid.generate(32, 32, seed=21), gen_limit=5)
+        sched.submit(job)
+        sched.start()
+        assert sched.drain(timeout=60)
+        sched.stop(drain=False)
+        assert job.state == DONE
+        assert calls == {"stage": 1, "dispatch": 2, "complete": 2}
+        assert sched.metrics.counter("batch_retries_total") == 1
+
+    def test_depth2_persistent_error_fails_jobs(self, tmp_path):
+        def broken(key, batch):
+            raise RuntimeError("device on fire")
+
+        journal = JobJournal(str(tmp_path / "j"))
+        sched = Scheduler(journal=journal, flush_age=0.0, pipeline_depth=2,
+                          run_batch=broken)
+        job = new_job(32, 32, text_grid.generate(32, 32, seed=14), gen_limit=5)
+        sched.submit(job)
+        sched.start()
+        assert sched.drain(timeout=60)
+        sched.stop(drain=False)
+        assert job.state == FAILED
+        assert "device on fire" in job.error
+        replay = journal.replay()
+        journal.close()
+        assert job.id in replay.failed
+        assert _pipeline_threads() == []
+
+    def test_depth2_dispatch_stage_error_fails_jobs(self):
+        """A failure inside the pipelined stage/dispatch is carried to the
+        completer and classified by the SAME retry policy (here: hard)."""
+        def bad_stage(key, batch):
+            raise RuntimeError("stage exploded")
+
+        sched = Scheduler(
+            flush_age=0.0, pipeline_depth=2,
+            split_batch=(bad_stage, batcher.dispatch, batcher.complete),
+            run_batch=lambda key, batch: (_ for _ in ()).throw(
+                RuntimeError("stage exploded")),
+        )
+        job = new_job(32, 32, text_grid.generate(32, 32, seed=15), gen_limit=5)
+        sched.submit(job)
+        sched.start()
+        assert sched.drain(timeout=60)
+        sched.stop(drain=False)
+        assert job.state == FAILED
+
+    def test_depth1_unchanged_default(self):
+        """Absent the new knob the scheduler is the classic worker pool —
+        no pipeline threads, no window (the observable-behavior pin)."""
+        sched = Scheduler()
+        assert sched.pipeline_depth == 1
+        sched.start()
+        names = [t.name for t in sched._threads]
+        assert names == ["gol-serve-worker-0"]
+        assert sched._window is None
+        sched.stop(drain=False)
+
+
+class TestKillDuringCkptWrite:
+    """The new fault: SIGKILL/crash while the background writer is
+    mid-payload-write. (The CLI-level byte-identical auto-resume proof for
+    both exit paths lives in tests/test_crash_recovery.py; the real-SIGKILL
+    subprocess version is tools/pipeline_smoke.py.)"""
+
+    def test_parse_and_fire(self, tmp_path):
+        plan = faults.FaultPlan.parse("kill_during_ckpt_write=1")
+        faults.install(plan)
+        p = tmp_path / "payload.out"
+        p.write_bytes(b"x" * 100)
+        with pytest.raises(InjectedCrash):
+            faults.on_payload_write(str(p))
+        assert p.stat().st_size == 50  # torn mid-file first
+        # one-shot: later writes proceed
+        faults.on_payload_write(str(p))
+
+    def test_unknown_key_still_loud(self):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.parse("kill_during_ckpt_writ=1")

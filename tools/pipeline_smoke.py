@@ -1,0 +1,287 @@
+"""Async-pipeline crash/restart smoke: kill mid-async-write, resume, verify.
+
+The `make pipeline-smoke` harness, exercising both halves of
+gol_tpu/pipeline against real OS processes:
+
+1. **Checkpoint half** — a checkpointed run with the async writer (the
+   default lane) is SIGKILLed while the background writer thread is
+   mid-payload-write (``GOL_FAULTS=kill_during_ckpt_write=2,
+   kill_mode=sigkill`` — no Python unwinding, like a power cut). The
+   checkpoint committed by the *previous* boundary's deferred wait must
+   survive; ``--auto-resume`` must complete the run to an output file
+   byte-identical to an uninterrupted run's, reporting the same generation
+   count. Then the same input is re-run with ``--sync-checkpoints`` to pin
+   async/sync byte-compatibility end to end.
+
+2. **Serve half** — a ``gol serve --pipeline-depth 2`` session takes jobs
+   across two padding buckets, finishes them all, drains clean via POST
+   /drain + SIGTERM, and the journal must show every accepted job DONE
+   exactly once (the pipelined dispatcher/completer preserves the
+   exactly-once ledger).
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/pipeline_smoke.py [--jobs 24] [--gen-limit 200]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("GOL_FAULTS", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _gol(args, extra_env=None, check=True):
+    proc = subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *args],
+        env=_env(extra_env), cwd=ROOT, capture_output=True, text=True,
+    )
+    if check and proc.returncode != 0:
+        raise RuntimeError(
+            f"gol {' '.join(args)} rc={proc.returncode}:\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+    return proc
+
+
+def checkpoint_half(workdir: str) -> bool:
+    infile = os.path.join(workdir, "in.txt")
+    _gol(["generate", "64", "64", "--seed", "29", "-o", infile])
+    gen_limit, every = 24, 6
+
+    ref = os.path.join(workdir, "ref.out")
+    ref_run = _gol(["run", "64", "64", infile, "--variant", "game",
+                    "--gen-limit", str(gen_limit), "--output", ref])
+    ref_gens = [l for l in ref_run.stdout.splitlines()
+                if l.startswith("Generations")]
+
+    ck = os.path.join(workdir, "ck")
+    out = os.path.join(workdir, "out.out")
+    base = ["run", "64", "64", infile, "--variant", "game",
+            "--gen-limit", str(gen_limit), "--checkpoint-every", str(every),
+            "--checkpoint-dir", ck, "--output", out]
+
+    # SIGKILL while the background writer is mid-payload-write #2 (the
+    # generation-12 payload): by then the deferred wait at boundary 12 has
+    # committed generation 6, and 12 must never become visible.
+    crash = _gol(base, extra_env={
+        "GOL_FAULTS": "kill_during_ckpt_write=2,kill_mode=sigkill",
+    }, check=False)
+    if crash.returncode != -signal.SIGKILL:
+        print(f"pipeline-smoke: expected SIGKILL death, rc={crash.returncode}\n"
+              f"{crash.stdout}\n{crash.stderr}")
+        return False
+    if os.path.exists(out):
+        print("pipeline-smoke: killed run left a final output file")
+        return False
+    names = sorted(os.listdir(ck))
+    if "ckpt-00000006.manifest.json" not in names:
+        print(f"pipeline-smoke: committed checkpoint 6 missing after kill: {names}")
+        return False
+    if "ckpt-00000012.manifest.json" in names:
+        print(f"pipeline-smoke: torn checkpoint 12 became visible: {names}")
+        return False
+    for name in names:  # no committed manifest may dangle
+        if name.endswith(".manifest.json"):
+            with open(os.path.join(ck, name)) as f:
+                payload = json.load(f)["payload"]
+            if not os.path.exists(os.path.join(ck, payload)):
+                print(f"pipeline-smoke: manifest {name} dangles ({payload})")
+                return False
+
+    resumed = _gol([*base, "--auto-resume"])
+    res_gens = [l for l in resumed.stdout.splitlines()
+                if l.startswith("Generations")]
+    if open(out, "rb").read() != open(ref, "rb").read() or res_gens != ref_gens:
+        print("pipeline-smoke: auto-resumed output diverges from the "
+              "uninterrupted run")
+        return False
+
+    # A/B: the sync writer must produce byte-identical output AND payloads.
+    ck_sync = os.path.join(workdir, "ck-sync")
+    out_sync = os.path.join(workdir, "out-sync.out")
+    _gol(["run", "64", "64", infile, "--variant", "game",
+          "--gen-limit", str(gen_limit), "--checkpoint-every", str(every),
+          "--checkpoint-dir", ck_sync, "--output", out_sync,
+          "--sync-checkpoints", "--checkpoint-keep", "8"])
+    ck_async = os.path.join(workdir, "ck-async")
+    out_async = os.path.join(workdir, "out-async.out")
+    _gol(["run", "64", "64", infile, "--variant", "game",
+          "--gen-limit", str(gen_limit), "--checkpoint-every", str(every),
+          "--checkpoint-dir", ck_async, "--output", out_async,
+          "--checkpoint-keep", "8"])
+    if open(out_sync, "rb").read() != open(out_async, "rb").read():
+        print("pipeline-smoke: sync/async final outputs differ")
+        return False
+    for name in sorted(os.listdir(ck_sync)):
+        if name.endswith(".out"):
+            a = open(os.path.join(ck_sync, name), "rb").read()
+            b = open(os.path.join(ck_async, name), "rb").read()
+            if a != b:
+                print(f"pipeline-smoke: payload {name} differs sync vs async")
+                return False
+    print("pipeline-smoke: checkpoint half OK — mid-write SIGKILL resumed "
+          "byte-identically; sync/async payloads identical")
+    return True
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def serve_half(workdir: str, jobs: int, gen_limit: int) -> bool:
+    from gol_tpu.io import text_grid  # noqa: E402 - after sys.path insert
+
+    journal_dir = os.path.join(workdir, "journal")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu", "serve", "--port", str(port),
+         "--journal-dir", journal_dir, "--flush-age", "0.05",
+         "--pipeline-depth", "2"],
+        env=_env(), cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.perf_counter() + 120
+        while True:
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                print(f"pipeline-smoke: server died on boot rc="
+                      f"{proc.returncode}:\n{out[-3000:]}")
+                return False
+            try:
+                status, _ = _http("GET", f"{base}/healthz", timeout=2)
+                if status == 200:
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            if time.perf_counter() > deadline:
+                print("pipeline-smoke: server never became healthy")
+                return False
+            time.sleep(0.1)
+
+        accepted = set()
+        for i in range(jobs):
+            side = 32 if i % 2 == 0 else 30  # packed + masked buckets
+            board = text_grid.generate(side, side, seed=2000 + i)
+            status, payload = _http("POST", f"{base}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": gen_limit,
+            })
+            if status != 202:
+                print(f"pipeline-smoke: submit {i} rejected {status}: {payload}")
+                return False
+            accepted.add(payload["id"])
+
+        pending = set(accepted)
+        deadline = time.perf_counter() + 300
+        while pending and time.perf_counter() < deadline:
+            for job_id in list(pending):
+                status, payload = _http("GET", f"{base}/jobs/{job_id}")
+                if status != 200 or payload["state"] in ("failed", "cancelled"):
+                    print(f"pipeline-smoke: job {job_id} -> {status} {payload}")
+                    return False
+                if payload["state"] == "done":
+                    pending.discard(job_id)
+            if pending:
+                time.sleep(0.1)
+        if pending:
+            print(f"pipeline-smoke: {len(pending)} job(s) never completed")
+            return False
+
+        status, payload = _http("POST", f"{base}/drain", {}, timeout=60)
+        if status != 200 or not payload.get("drained"):
+            print(f"pipeline-smoke: drain failed {status}: {payload}")
+            return False
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            print("pipeline-smoke: server ignored SIGTERM")
+            proc.kill()
+            return False
+
+        # Exactly-once ledger: every accepted id has exactly one done record.
+        done: dict = {}
+        with open(os.path.join(journal_dir, "journal.jsonl"), "rb") as f:
+            for line in f.read().split(b"\n"):
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "done":
+                    done[rec["id"]] = done.get(rec["id"], 0) + 1
+        lost = accepted - set(done)
+        dup = {k: v for k, v in done.items() if v != 1}
+        extra = set(done) - accepted
+        if lost or dup or extra:
+            print(f"pipeline-smoke: lost={lost} dup={dup} unknown={extra}")
+            return False
+        print(f"pipeline-smoke: serve half OK — {len(accepted)} jobs through "
+              f"a depth-2 pipeline, drained clean, every job DONE exactly once")
+        return True
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=24)
+    parser.add_argument("--gen-limit", type=int, default=200)
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-pipeline-smoke-")
+    ok = False
+    try:
+        ok = checkpoint_half(workdir) and serve_half(
+            workdir, args.jobs, args.gen_limit
+        )
+        print(f"pipeline-smoke: {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    finally:
+        if ok:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"pipeline-smoke: artifacts kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
