@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench serve-smoke tune-smoke obs-smoke pipeline-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -40,6 +40,13 @@ tune-smoke:
 # well-formed Chrome trace JSON.
 obs-smoke:
 	python3 tools/obs_smoke.py
+
+# Resident mega-batch smoke (tools/megabatch_smoke.py): a `gol serve
+# --resident-ring` session is SIGKILLed mid-ring, a restart replays the
+# journal to every job DONE exactly once, and the resident results are
+# byte-identical to a classic depth-1 server's.
+megabatch-smoke:
+	python3 tools/megabatch_smoke.py
 
 # Async-pipeline smoke (tools/pipeline_smoke.py): a checkpointed run with the
 # async writer is SIGKILLed mid-background-payload-write, auto-resume must be

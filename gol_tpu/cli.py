@@ -769,6 +769,7 @@ def _serve(args) -> int:
         flush_age=args.flush_age,
         max_inflight=args.max_inflight,
         pipeline_depth=args.pipeline_depth,
+        resident_ring=args.resident_ring,
     )
     stop = {"signaled": False}
 
@@ -1390,6 +1391,18 @@ def build_parser() -> argparse.ArgumentParser:
         "and journals k-1 (try 2). Default 1 keeps the classic worker; "
         "exactly-once journal semantics, admission, drain, and retry are "
         "identical at every depth",
+    )
+    srv.add_argument(
+        "--resident-ring", type=int, default=0, metavar="R",
+        help="device-resident mega-batch lanes: each padding bucket gets a "
+        "ring of R slots bound to ONE compiled drain program — the "
+        "dispatcher refills slots (async device_put) while a drain "
+        "computes, up to R batches dispatch as one program with every "
+        "slot's output aliased over its input, and the per-batch Python "
+        "dispatch tax disappears from the hot path. Needs "
+        "--pipeline-depth >= 2 (>= 2R keeps the device stream fed); "
+        "0 (default) keeps the per-batch lanes. Results are byte-identical "
+        "either way",
     )
     srv.add_argument(
         "--warm-plans", action="store_true",

@@ -1099,10 +1099,33 @@ def _evolve_batch_masked(cur, heights, widths):
     return jax.vmap(one)(cur, heights, widths)
 
 
-def _batch_simulate_c(state0, limits, freq, check_sim, evolve, alive_of, equal):
+def _temporal_body(substep, depth: int):
+    """The while-loop body of a batched simulator at temporal depth T.
+
+    ``substep`` is one generation of the per-generation form, with per-board
+    freeze masking already applied (stopped boards are fixed under it — the
+    masking holds their grid and scalars). Depth 1 returns ``substep``
+    itself, so the traced program is byte-for-byte the pre-temporal one
+    (test-pinned). Depth T > 1 runs T masked sub-generations per while
+    iteration via a fori_loop — the batched analog of the solo engine's
+    ``ops.with_temporal_depth`` — which is bit-exact at ANY depth because
+    every sub-generation applies the same masking the per-generation loop
+    does; only the while cond (the batch's one cross-board reduction per
+    iteration) fires T times less often.
+    """
+    if depth == 1:
+        return substep
+    return lambda state: jax.lax.fori_loop(
+        0, depth, lambda _i, s: substep(s), state
+    )
+
+
+def _batch_simulate_c(state0, limits, freq, check_sim, evolve, alive_of, equal,
+                      depth: int = 1):
     """Batched C-convention loop: per-board replica of ``_simulate_c``'s
     per-generation form, masked so stopped boards freeze (oracle._run_c is
-    the semantics contract; exactness vs solo runs is test-pinned)."""
+    the semantics contract; exactness vs solo runs is test-pinned).
+    ``depth`` generations run per while iteration (``_temporal_body``)."""
     b = limits.shape[0]
     expand = (b,) + (1,) * (state0.ndim - 1)
 
@@ -1113,7 +1136,7 @@ def _batch_simulate_c(state0, limits, freq, check_sim, evolve, alive_of, equal):
     def cond(state):
         return jnp.any(run_mask(state))
 
-    def body(state):
+    def substep(state):
         cur, gen, counter, alive, similar = state
         run = run_mask(state)
         new = evolve(cur)
@@ -1147,6 +1170,7 @@ def _batch_simulate_c(state0, limits, freq, check_sim, evolve, alive_of, equal):
         similar = jnp.where(run, sim_n, similar)
         return (cur, gen, counter, alive, similar)
 
+    body = _temporal_body(substep, depth)
     zeros = jnp.zeros((b,), jnp.int32)
     state = (state0, zeros + 1, zeros, alive_of(state0), jnp.zeros((b,), bool))
     final, gen, _counter, alive, similar = jax.lax.while_loop(cond, body, state)
@@ -1158,10 +1182,12 @@ def _batch_simulate_c(state0, limits, freq, check_sim, evolve, alive_of, equal):
     return final, gen - 1, reason  # reported count is gen-1 (src/game.c:202)
 
 
-def _batch_simulate_cuda(state0, limits, freq, check_sim, evolve, alive_of, equal):
+def _batch_simulate_cuda(state0, limits, freq, check_sim, evolve, alive_of,
+                         equal, depth: int = 1):
     """Batched CUDA-convention loop (per-board ``_simulate_cuda`` semantics:
     0-based exclusive bound, emptiness tested on the NEW grid, break before
-    the swap so an empty exit keeps the last non-empty generation)."""
+    the swap so an empty exit keeps the last non-empty generation).
+    ``depth`` generations run per while iteration (``_temporal_body``)."""
     b = limits.shape[0]
     expand = (b,) + (1,) * (state0.ndim - 1)
 
@@ -1172,7 +1198,7 @@ def _batch_simulate_cuda(state0, limits, freq, check_sim, evolve, alive_of, equa
     def cond(state):
         return jnp.any(run_mask(state))
 
-    def body(state):
+    def substep(state):
         cur, gen, counter, stop, reason = state
         run = run_mask(state)
         new = evolve(cur)
@@ -1207,6 +1233,7 @@ def _batch_simulate_cuda(state0, limits, freq, check_sim, evolve, alive_of, equa
         stop = stop | newly
         return (cur, gen, counter, stop, reason)
 
+    body = _temporal_body(substep, depth)
     zeros = jnp.zeros((b,), jnp.int32)
     state = (
         state0, zeros, zeros, jnp.zeros((b,), bool),
@@ -1220,6 +1247,49 @@ _BATCH_SIMULATORS = {
     Convention.C: _batch_simulate_c,
     Convention.CUDA: _batch_simulate_cuda,
 }
+
+
+def _validate_batch_params(padded_shape, batch: int, mode: str,
+                           convention: str, temporal_depth: int) -> None:
+    """The ONE validation surface of the batched/ring runner factories —
+    a program the batch lane rejects must be impossible to build as a
+    ring, and vice versa."""
+    if batch < 1:
+        raise ValueError(f"batch must be >= 1, got {batch}")
+    if mode not in BATCH_MODES:
+        raise ValueError(f"unknown batch mode {mode!r}; one of {BATCH_MODES}")
+    if mode == "packed" and padded_shape[1] % 32 != 0:
+        raise ValueError(
+            f"packed batch mode needs width % 32 == 0, got {padded_shape[1]}"
+        )
+    if convention not in _BATCH_SIMULATORS:
+        raise ValueError(f"unknown convention: {convention!r}")
+    if not 1 <= temporal_depth <= 64:
+        raise ValueError(
+            f"temporal_depth must be in [1, 64], got {temporal_depth}"
+        )
+
+
+def _batch_evolve(mode: str, heights, widths):
+    """The per-mode one-generation step over a (B, ...) board stack —
+    shared by the per-batch runner and the resident ring runner so both
+    compile the identical evolve (byte-identity across lanes follows from
+    every op being integer/bitwise)."""
+    from gol_tpu.ops import packed_math, stencil_lax
+
+    if mode == "packed":
+        return jax.vmap(packed_math.evolve_torus_words)
+    if mode == "byte":
+        return jax.vmap(stencil_lax.evolve_torus)
+    return lambda cur: _evolve_batch_masked(cur, heights, widths)
+
+
+def _batch_alive_of(s):
+    return jnp.any(s != 0, axis=tuple(range(1, s.ndim)))
+
+
+def _batch_equal(a, b):
+    return jnp.all(a == b, axis=tuple(range(1, a.ndim)))
 
 
 def resolve_batch_mode(
@@ -1274,6 +1344,7 @@ def make_batch_runner(
     check_similarity: bool = True,
     similarity_frequency: int = DEFAULT_CONFIG.similarity_frequency,
     mode: str = "masked",
+    temporal_depth: int = 1,
 ):
     """Compile a B-board runner: ``(boards, heights, widths, limits) ->
     (finals, generations, exit_reasons)``.
@@ -1289,34 +1360,25 @@ def make_batch_runner(
     --gen-limit share the compiled program (unlike the solo runners, where
     the limit is baked into the trace).
 
+    ``temporal_depth`` is the batched analog of the solo engine's
+    deep-halo grouping (``ops.with_temporal_depth``): T masked generations
+    run per while iteration, bit-exact at any T (``_temporal_body``) — a
+    pure performance knob, searched by ``gol tune --serve-board``.
+
     Single-device by design: serving batches many small boards per chip;
     sharding one small board over a mesh is the opposite trade.
     """
     ph, pw = padded_shape
-    if batch < 1:
-        raise ValueError(f"batch must be >= 1, got {batch}")
-    if mode not in BATCH_MODES:
-        raise ValueError(f"unknown batch mode {mode!r}; one of {BATCH_MODES}")
-    if mode == "packed" and pw % 32 != 0:
-        raise ValueError(f"packed batch mode needs width % 32 == 0, got {pw}")
-    if convention not in _BATCH_SIMULATORS:
-        raise ValueError(f"unknown convention: {convention!r}")
+    _validate_batch_params(padded_shape, batch, mode, convention,
+                           temporal_depth)
     simulate_fn = _BATCH_SIMULATORS[convention]
     freq = jnp.int32(similarity_frequency)
 
-    from gol_tpu.ops import packed_math, stencil_lax
-
     def fn(boards, heights, widths, limits):
-        if mode == "packed":
-            evolve = jax.vmap(packed_math.evolve_torus_words)
-        elif mode == "byte":
-            evolve = jax.vmap(stencil_lax.evolve_torus)
-        else:
-            evolve = lambda cur: _evolve_batch_masked(cur, heights, widths)
-        alive_of = lambda s: jnp.any(s != 0, axis=tuple(range(1, s.ndim)))
-        equal = lambda a, b: jnp.all(a == b, axis=tuple(range(1, a.ndim)))
         return simulate_fn(
-            boards, limits, freq, check_similarity, evolve, alive_of, equal
+            boards, limits, freq, check_similarity,
+            _batch_evolve(mode, heights, widths),
+            _batch_alive_of, _batch_equal, depth=temporal_depth,
         )
 
     # Donate the board canvas: the final grids are written over the input
@@ -1347,6 +1409,13 @@ class StagedBatch:
     padded_shape: tuple[int, int]
     boards: int  # real board count (<= total)
     total: int  # padded batch slots the program runs
+    # Loop parameters the compiled program baked in — carried so a resident
+    # ring (stage_ring) can build the matching R-slot program without
+    # re-deriving them from the configs.
+    convention: str = Convention.C
+    check_similarity: bool = True
+    similarity_frequency: int = DEFAULT_CONFIG.similarity_frequency
+    temporal_depth: int = 1
 
 
 @dataclasses.dataclass
@@ -1367,11 +1436,15 @@ def stage_batch(
     configs,
     padded_shape: tuple[int, int] | None = None,
     pad_batch_to: int | None = None,
+    temporal_depth: int = 1,
 ) -> StagedBatch | None:
     """Host staging for ``simulate_batch``: validate, stack, pad, pack.
 
     Returns None for an empty board list. Pure host work — safe to run on a
-    pipeline thread while the device computes a previous batch."""
+    pipeline thread while the device computes a previous batch. Packing
+    happens exactly once per staging (``engine_stage_packs_total`` counts
+    the ``np.packbits`` passes; the retry paths re-dispatch from the
+    retained staging, so the counter proves zero re-packs on retry)."""
     boards = [np.ascontiguousarray(np.asarray(b, dtype=np.uint8)) for b in boards]
     if not boards:
         return None
@@ -1414,12 +1487,21 @@ def stage_batch(
     runner = make_batch_runner(
         padded_shape, total, head.convention,
         head.check_similarity, head.similarity_frequency, mode,
+        temporal_depth,
     )
-    operand = _pack_board_words(stacked) if mode == "packed" else stacked
+    if mode == "packed":
+        operand = _pack_board_words(stacked)
+        obs_registry.default().inc("engine_stage_packs_total")
+    else:
+        operand = stacked
     return StagedBatch(
         runner=runner, operand=operand, h_arr=h_arr, w_arr=w_arr,
         limits=limits, heights=heights, widths=widths, mode=mode,
         padded_shape=padded_shape, boards=b, total=total,
+        convention=head.convention,
+        check_similarity=head.check_similarity,
+        similarity_frequency=head.similarity_frequency,
+        temporal_depth=temporal_depth,
     )
 
 
@@ -1437,15 +1519,16 @@ def dispatch_batch(staged: StagedBatch) -> InflightBatch:
                          reasons=reasons)
 
 
-def complete_batch(inflight: InflightBatch) -> list[BatchBoardResult]:
-    """Block on an in-flight batch's results and crop per-board slices."""
-    staged = inflight.staged
-    finals = np.asarray(jax.device_get(inflight.finals))
+def _collect_board_results(staged: StagedBatch, finals, gens, reasons
+                           ) -> list[BatchBoardResult]:
+    """Crop one batch's fetched device results back into per-board slices
+    (shared by ``complete_batch`` and ``complete_ring``)."""
+    finals = np.asarray(finals)
     if staged.mode == "packed":
         finals = _unpack_board_words(finals)
     finals = np.asarray(finals, dtype=np.uint8)
-    gens = np.asarray(jax.device_get(inflight.gens))
-    reasons = np.asarray(jax.device_get(inflight.reasons))
+    gens = np.asarray(gens)
+    reasons = np.asarray(reasons)
     b = staged.boards
     reg = obs_registry.default()
     reg.inc("engine_batches_total")
@@ -1461,11 +1544,22 @@ def complete_batch(inflight: InflightBatch) -> list[BatchBoardResult]:
     ]
 
 
+def complete_batch(inflight: InflightBatch) -> list[BatchBoardResult]:
+    """Block on an in-flight batch's results and crop per-board slices."""
+    return _collect_board_results(
+        inflight.staged,
+        jax.device_get(inflight.finals),
+        jax.device_get(inflight.gens),
+        jax.device_get(inflight.reasons),
+    )
+
+
 def simulate_batch(
     boards,
     configs,
     padded_shape: tuple[int, int] | None = None,
     pad_batch_to: int | None = None,
+    temporal_depth: int = 1,
 ) -> list[BatchBoardResult]:
     """Run many independent boards in ONE compiled program.
 
@@ -1489,7 +1583,8 @@ def simulate_batch(
     ``simulate`` run of the same board (test-pinned for both conventions,
     including boards that exit early inside a still-running batch).
     """
-    staged = stage_batch(boards, configs, padded_shape, pad_batch_to)
+    staged = stage_batch(boards, configs, padded_shape, pad_batch_to,
+                         temporal_depth)
     if staged is None:
         return []
     ph, pw = staged.padded_shape
@@ -1497,3 +1592,175 @@ def simulate_batch(
                         slots=staged.total, canvas=f"{ph}x{pw}",
                         mode=staged.mode):
         return complete_batch(dispatch_batch(staged))
+
+
+# ---------------------------------------------------------------------------
+# Resident ring engine (the gol_tpu/serve/resident.py compute entry).
+#
+# The batch runner above still pays one Python jit dispatch — claim, operand
+# transfer, program launch, scalar sync — per batch; at serving batch sizes
+# that host tax is the gap between the marginal kernel rate and the
+# end-to-end rate. The ring runner folds R staged batches into ONE compiled
+# program: R slots, each running the full batched while_loop, every slot's
+# output aliased over its input buffer (donation across the ring — the
+# reference's double-buffer swap, R times over). The host refills slots with
+# async device_put while an earlier drain computes and dispatches the next
+# drain behind it on the device stream, so the device never waits on
+# per-batch Python — the persistent, pre-planned dispatch the stencil
+# communication literature argues for, realized as XLA programs. Unfilled
+# slots carry zero boards with generation limit 0: their while loops exit
+# before the first iteration, so a partially filled drain costs its filled
+# slots only.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=128)
+def make_ring_runner(
+    padded_shape: tuple[int, int],
+    batch: int,
+    ring: int,
+    convention: str = Convention.C,
+    check_similarity: bool = True,
+    similarity_frequency: int = DEFAULT_CONFIG.similarity_frequency,
+    mode: str = "masked",
+    temporal_depth: int = 1,
+):
+    """Compile an R-slot resident drain: ``(slot_0..slot_{R-1}, heights,
+    widths, limits) -> ((final_0..final_{R-1}), generations, exit_reasons)``.
+
+    Each slot is one batch-runner operand ((B, PH, PW) uint8, or the packed
+    (B, PH, PW/32) uint32 words); ``heights``/``widths``/``limits`` are
+    (R, B) int32. Every slot argument is DONATED — slot i's final boards
+    are written in place over its input buffer, eliminating the per-batch
+    output allocation ring-wide. Per-slot results are bit-identical to the
+    per-batch runner's (same evolve, same loop, integer ops only) — pinned
+    by tests/test_megabatch.py.
+    """
+    if ring < 1:
+        raise ValueError(f"ring must be >= 1, got {ring}")
+    _validate_batch_params(padded_shape, batch, mode, convention,
+                           temporal_depth)
+    simulate_fn = _BATCH_SIMULATORS[convention]
+    freq = jnp.int32(similarity_frequency)
+
+    def fn(*operands):
+        slots = operands[:ring]
+        heights, widths, limits = operands[ring:]
+        finals, gens, reasons = [], [], []
+        for r in range(ring):
+            f, g, why = simulate_fn(
+                slots[r], limits[r], freq, check_similarity,
+                _batch_evolve(mode, heights[r], widths[r]),
+                _batch_alive_of, _batch_equal, depth=temporal_depth,
+            )
+            finals.append(f)
+            gens.append(g)
+            reasons.append(why)
+        return tuple(finals), jnp.stack(gens), jnp.stack(reasons)
+
+    return jit_donating(fn, donate_argnums=tuple(range(ring)))
+
+
+@dataclasses.dataclass
+class StagedRing:
+    """Up to ``ring`` staged batches bound to one resident drain program."""
+
+    runner: Any
+    staged: list  # StagedBatch per FILLED slot, in slot order
+    ring: int
+
+
+@dataclasses.dataclass
+class InflightRing:
+    """One dispatched ring drain: device futures for every slot."""
+
+    staged_ring: StagedRing
+    finals: Any  # tuple of R device arrays (futures)
+    gens: Any  # (R, B)
+    reasons: Any  # (R, B)
+
+
+def stage_ring(staged_batches: list, ring: int) -> StagedRing:
+    """Bind staged batches (same bucket geometry) to the R-slot program."""
+    if not staged_batches:
+        raise ValueError("cannot stage an empty ring")
+    if len(staged_batches) > ring:
+        raise ValueError(
+            f"{len(staged_batches)} staged batches exceed the ring of {ring}"
+        )
+    head = staged_batches[0]
+    for s in staged_batches[1:]:
+        if (
+            s.padded_shape != head.padded_shape
+            or s.total != head.total
+            or s.mode != head.mode
+            or s.convention != head.convention
+            or s.check_similarity != head.check_similarity
+            or s.similarity_frequency != head.similarity_frequency
+            or s.temporal_depth != head.temporal_depth
+        ):
+            raise ValueError(
+                "staged batches in one ring must share the bucket geometry "
+                "(canvas, batch rung, mode, convention, similarity, depth)"
+            )
+    runner = make_ring_runner(
+        head.padded_shape, head.total, ring, head.convention,
+        head.check_similarity, head.similarity_frequency, head.mode,
+        head.temporal_depth,
+    )
+    return StagedRing(runner=runner, staged=list(staged_batches), ring=ring)
+
+
+def _zero_slot(head: StagedBatch):
+    """An inert slot operand: zero boards (with limit 0 they never run)."""
+    return jnp.zeros(head.operand.shape, head.operand.dtype)
+
+
+def dispatch_ring(sr: StagedRing, device_slots: list | None = None
+                  ) -> InflightRing:
+    """Dispatch a staged ring; returns WITHOUT blocking on any result.
+
+    ``device_slots`` are per-slot device arrays a caller already uploaded
+    (the resident lane's refill-while-the-loop-runs path: ``device_put`` at
+    submit time overlaps the transfer with the previous drain's compute);
+    absent, the retained host operands transfer here — which is also the
+    idempotent retry path, since the donated device buffers of a failed
+    drain are consumed but the host staging is retained."""
+    head = sr.staged[0]
+    filled = len(sr.staged)
+    slots = []
+    for i in range(sr.ring):
+        if i < filled:
+            dev = device_slots[i] if device_slots is not None else None
+            slots.append(dev if dev is not None
+                         else jnp.asarray(sr.staged[i].operand))
+        else:
+            slots.append(_zero_slot(head))
+    total = head.total
+    h = np.ones((sr.ring, total), np.int32)
+    w = np.ones((sr.ring, total), np.int32)
+    limits = np.zeros((sr.ring, total), np.int32)
+    for i, s in enumerate(sr.staged):
+        h[i] = s.h_arr
+        w[i] = s.w_arr
+        limits[i] = s.limits
+    finals, gens, reasons = sr.runner(
+        *slots, jnp.asarray(h), jnp.asarray(w), jnp.asarray(limits)
+    )
+    return InflightRing(staged_ring=sr, finals=finals, gens=gens,
+                        reasons=reasons)
+
+
+def complete_ring(inflight: InflightRing) -> list[list[BatchBoardResult]]:
+    """Block on a drain's results; one ``BatchBoardResult`` list per filled
+    slot, in slot order (each list bit-identical to ``complete_batch`` of
+    the same staged batch)."""
+    sr = inflight.staged_ring
+    gens = np.asarray(jax.device_get(inflight.gens))
+    reasons = np.asarray(jax.device_get(inflight.reasons))
+    out = []
+    for i, staged in enumerate(sr.staged):
+        out.append(_collect_board_results(
+            staged, jax.device_get(inflight.finals[i]), gens[i], reasons[i],
+        ))
+    return out

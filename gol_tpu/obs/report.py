@@ -199,10 +199,32 @@ def render(path: str) -> str:
     lines.append("")
     lines.append("## gaps (untraced time between top-level spans)")
     lines.extend(gap_analysis(spans))
-    counters = (meta.get("registry") or {}).get("counters")
+    reg = meta.get("registry") or {}
+    counters = reg.get("counters")
     if counters:
         lines.append("")
         lines.append("## registry counters at dump time")
         for name in sorted(counters):
             lines.append(f"  {name} = {counters[name]}")
+    gauges = reg.get("gauges")
+    if gauges:
+        lines.append("")
+        lines.append("## registry gauges at dump time")
+        for name in sorted(gauges):
+            lines.append(f"  {name} = {gauges[name]}")
+    hists = reg.get("histograms")
+    if hists:
+        # The serving latency/gap distributions (dispatch_gap_seconds,
+        # queue/run latency): the same nearest-rank summaries /metrics
+        # exports, rendered so a flight dump answers "was the device
+        # idling between drains" on its own.
+        lines.append("")
+        lines.append("## registry histograms at dump time")
+        for name in sorted(hists):
+            s = hists[name] or {}
+            stats = ", ".join(
+                f"{k}={s[k]}" for k in ("count", "sum", "p50", "p95", "p99")
+                if k in s
+            )
+            lines.append(f"  {name}: {stats}")
     return "\n".join(lines) + "\n"

@@ -47,6 +47,10 @@ class Handoff:
                 return self._items.popleft()
             return None
 
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
     def close(self) -> None:
         with self._cv:
             self._closed = True

@@ -169,6 +169,7 @@ def stage(key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
         [job.config for job in jobs],
         padded_shape=(key.height, key.width),
         pad_batch_to=pad_batch(len(jobs)),
+        temporal_depth=_plan().temporal_depth,
     )
     return StagedServeBatch(key=key, jobs=list(jobs), staged=staged)
 
@@ -222,6 +223,7 @@ def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
             [job.config for job in jobs],
             padded_shape=(key.height, key.width),
             pad_batch_to=total,
+            temporal_depth=_plan().temporal_depth,
         )
     return [
         JobResult(grid=r.grid, generations=r.generations, exit_reason=r.exit_reason)
@@ -249,6 +251,7 @@ def warm(key: BucketKey, batch: int = MAX_BATCH) -> None:
         key.check_similarity,
         key.similarity_frequency,
         key.kernel,
+        _plan().temporal_depth,
     )
     if key.kernel == "packed":
         boards = np.zeros((total, key.height, key.width // 32), np.uint32)

@@ -1,0 +1,288 @@
+"""Device-resident ring lanes: the serve hot path's mega-batch engine.
+
+The pipelined scheduler (gol_tpu/serve/scheduler.py, ``pipeline_depth >=
+2``) overlaps host staging with device compute, but still pays one Python
+jit dispatch — operand transfer, program launch, scalar sync — per batch.
+This module removes that per-batch tax: each padding bucket gets a
+**ResidentLane**, a ring of R slots bound to ONE compiled drain program
+(``engine.make_ring_runner``). The dispatcher stages batches into slots —
+each slot's operand is ``device_put`` at submit time, so the host-to-device
+transfer runs while an earlier drain computes — and a drain of up to R
+batches dispatches as a single program, every slot's output aliased over
+its input buffer (donation across the ring).
+
+This is the reference's ``src/game_mpi_async.c`` iwrite/Wait discipline
+pushed one level further down: where PR 5's pipeline posted one async
+*dispatch* per batch and waited at the next boundary, the resident lane
+posts one async *drain* per R batches and the in-XLA fori over slots is
+the wait-free inner loop. The ``pipeline/inflight.Handoff`` window still
+carries the per-batch flights between the scheduler's threads; the lane
+sits underneath it, deciding when staged slots become a drain:
+
+- **ring full** — R slots staged: dispatch now (the steady-state path);
+- **rung change** — a staged batch padded to a different batch-size rung
+  cannot share the compiled program: flush the open slots first;
+- **completion demand** — the completer reached a flight whose slot is
+  staged but not dispatched: flush immediately (waiting could deadlock —
+  the dispatcher may have nothing more to stage). Under backlog the
+  completer is busy finalizing earlier drains while slots accumulate, so
+  this path naturally fires with a fuller ring the heavier the load.
+
+Observability (the obs default registry, so ``GET /debug/trace``, the
+flight recorder, and ``gol trace-report`` all see it):
+
+- ``serve.resident_loop`` span per drain readback (bucket, filled, ring);
+- ``dispatch_gap_seconds`` histogram — host-observed device idle between a
+  drain finishing and the next dispatch (0 when the next drain was already
+  queued behind it, the closed-gap case);
+- ``ring_slot_occupancy`` gauge — filled/ring at each dispatch;
+- a ``resident_rings`` flight-recorder state provider (per-lane open slot
+  and unresolved-drain counts), so a crash dump shows what was mid-ring.
+
+Exactly-once is untouched: the lane never journals — the scheduler's
+completer journals per batch from drain results, and a SIGKILL mid-ring
+replays the unfinished jobs from the journal exactly as the classic lanes
+do (test-pinned, tests/test_megabatch.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+
+from gol_tpu import engine
+from gol_tpu.obs import (
+    recorder as obs_recorder,
+    registry as obs_registry,
+    trace as obs_trace,
+)
+from gol_tpu.serve import batcher
+from gol_tpu.serve.batcher import BucketKey, StagedServeBatch
+from gol_tpu.serve.jobs import Job, JobResult
+
+STATE_PROVIDER = "resident_rings"
+
+
+class RingTicket:
+    """One staged batch's claim on a ring slot (the lane's flight handle)."""
+
+    __slots__ = ("key", "jobs", "staged", "lane", "drain", "slot")
+
+    def __init__(self, sstaged: StagedServeBatch, lane: "ResidentLane"):
+        self.key = sstaged.key
+        self.jobs = sstaged.jobs
+        self.staged = sstaged.staged  # engine.StagedBatch (retained host side)
+        self.lane = lane
+        self.drain: _Drain | None = None  # set when the slot's drain dispatches
+        self.slot = -1
+
+
+class _Drain:
+    """One dispatched ring program; resolved (readback) exactly once."""
+
+    def __init__(self, lane: "ResidentLane", tickets: list[RingTicket],
+                 inflight: engine.InflightRing):
+        self._lane = lane
+        self._tickets = tickets
+        self._inflight = inflight
+        self._lock = threading.Lock()
+        self._results = None
+        self._error: Exception | None = None
+
+    def resolve(self, slot: int):
+        """Per-slot results; the first caller blocks on the device readback
+        (under the drain's own lock), later callers get the cached lists."""
+        with self._lock:
+            if self._results is None and self._error is None:
+                try:
+                    with obs_trace.span(
+                        "serve.resident_loop",
+                        bucket=self._lane.key.label(),
+                        filled=len(self._tickets), ring=self._lane.ring,
+                    ):
+                        self._results = engine.complete_ring(self._inflight)
+                except Exception as err:  # noqa: BLE001 - carried per ticket
+                    self._error = err
+                finally:
+                    self._lane._drain_finished()
+            if self._error is not None:
+                # Every ticket of a failed drain surfaces the same error; the
+                # scheduler's retry policy classifies it per batch and
+                # re-dispatches from that batch's retained staging.
+                raise self._error
+            return self._results[slot]
+
+
+class ResidentLane:
+    """One bucket's ring: staged slots, at most one open (undispatched) set."""
+
+    def __init__(self, key: BucketKey, ring: int, clock=time.perf_counter):
+        self.key = key
+        self.ring = ring
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._open: list[RingTicket] = []
+        self._device_slots: list = []
+        self._open_rung: int | None = None
+        self._unresolved = 0  # dispatched drains not yet read back
+        self._last_drain_end: float | None = None
+        self.drains_total = 0
+
+    def submit(self, sstaged: StagedServeBatch) -> RingTicket:
+        """Stage a batch into the open ring.
+
+        The drain policy is self-clocking (the iwrite half of the
+        discipline): with no drain in flight the slot dispatches
+        immediately — an idle device must never wait for a fuller ring —
+        while a busy device lets slots accumulate until the ring fills or
+        the in-flight drain resolves (``_drain_finished``), whichever comes
+        first. Under light load this degenerates to per-batch dispatch;
+        under backlog drains approach ring size on their own."""
+        ticket = RingTicket(sstaged, self)
+        eng = sstaged.staged
+        with self._cv:
+            if self._open and self._open_rung != eng.total:
+                # A different batch-size rung cannot share the compiled
+                # program — flush the open slots ahead of it.
+                self._flush_locked()
+            ticket.slot = len(self._open)
+            self._open.append(ticket)
+            self._open_rung = eng.total
+            # Refill the slot on device NOW: jax's async transfer runs while
+            # the previous drain's program computes.
+            self._device_slots.append(jnp.asarray(eng.operand))
+            if len(self._open) >= self.ring or self._unresolved == 0:
+                self._flush_locked()
+        return ticket
+
+    def complete(self, ticket: RingTicket) -> list[engine.BatchBoardResult]:
+        """Block on the ticket's slot results (the deferred Wait)."""
+        with self._cv:
+            if ticket.drain is None:
+                # Safety net: with the eager policy this only happens when
+                # the ticket's slots were staged behind a still-unresolved
+                # drain and that drain's resolution will come from THIS
+                # call chain — dispatch now rather than deadlock.
+                self._flush_locked()
+        assert ticket.drain is not None
+        return ticket.drain.resolve(ticket.slot)
+
+    def _flush_locked(self) -> None:
+        if not self._open:
+            return
+        tickets, self._open = self._open, []
+        slots, self._device_slots = self._device_slots, []
+        self._open_rung = None
+        # Compile-for-filled: a drain of k < R slots runs the k-slot program
+        # (one compiled program per filled count, at most `ring` of them per
+        # bucket rung) instead of an R-slot program dragging R-k inert
+        # zero-board slots through dispatch — measured ~40% overhead on
+        # 1-filled drains of a 4-ring.
+        staged_ring = engine.stage_ring([t.staged for t in tickets],
+                                        len(tickets))
+        reg = obs_registry.default()
+        now = self._clock()
+        if self._last_drain_end is None or self._unresolved > 0:
+            # Another drain is (or was just) occupying the device stream —
+            # this dispatch queues behind it, so the device sees no gap.
+            gap = 0.0
+        else:
+            gap = max(0.0, now - self._last_drain_end)
+        reg.observe("dispatch_gap_seconds", gap)
+        reg.set_gauge("ring_slot_occupancy", len(tickets) / self.ring)
+        inflight = engine.dispatch_ring(staged_ring, device_slots=slots)
+        drain = _Drain(self, tickets, inflight)
+        self._unresolved += 1
+        self.drains_total += 1
+        for t in tickets:
+            t.drain = drain
+
+    def _drain_finished(self) -> None:
+        with self._cv:
+            self._unresolved -= 1
+            self._last_drain_end = self._clock()
+            # The wait-at-next-boundary moment: the device just went (or is
+            # about to go) idle — dispatch the slots that accumulated while
+            # the drain ran BEFORE the completer journals its results, so
+            # the next drain computes under the journal fsyncs.
+            if self._open:
+                self._flush_locked()
+
+    def state(self) -> dict:
+        with self._cv:
+            return {
+                "open": len(self._open),
+                "ring": self.ring,
+                "unresolved_drains": self._unresolved,
+                "drains_total": self.drains_total,
+            }
+
+
+class ResidentEngine:
+    """The (stage, dispatch, complete) split the pipelined scheduler mounts
+    when ``resident_ring > 1`` — same contract as the per-batch batcher
+    split, with ``dispatch`` feeding a per-bucket ring instead of posting
+    one device program per batch."""
+
+    def __init__(self, ring: int, clock=time.perf_counter):
+        if ring < 2:
+            raise ValueError(f"resident ring must be >= 2, got {ring}")
+        self.ring = ring
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._lanes: dict[BucketKey, ResidentLane] = {}
+        self.reopen()
+
+    # -- the split ---------------------------------------------------------
+
+    def stage(self, key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
+        return batcher.stage(key, jobs)
+
+    def dispatch(self, sstaged: StagedServeBatch) -> RingTicket:
+        return self._lane(sstaged.key).submit(sstaged)
+
+    def complete(self, ticket: RingTicket) -> list[JobResult]:
+        results = ticket.lane.complete(ticket)
+        return [
+            JobResult(grid=r.grid, generations=r.generations,
+                      exit_reason=r.exit_reason)
+            for r in results
+        ]
+
+    def split(self):
+        return (self.stage, self.dispatch, self.complete)
+
+    # -- lifecycle / introspection ----------------------------------------
+
+    def _lane(self, key: BucketKey) -> ResidentLane:
+        with self._lock:
+            lane = self._lanes.get(key)
+            if lane is None:
+                lane = self._lanes[key] = ResidentLane(
+                    key, self.ring, self._clock
+                )
+            return lane
+
+    def state(self) -> dict:
+        """Flat per-lane snapshot (the flight-recorder state provider)."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        out = {}
+        for lane in lanes:
+            for k, v in lane.state().items():
+                out[f"{lane.key.label()}.{k}"] = v
+        return out
+
+    def reopen(self) -> None:
+        """(Re-)register the flight-recorder state provider."""
+        obs_recorder.add_state_provider(STATE_PROVIDER, self.state)
+
+    def close(self) -> None:
+        """Drop the state provider and forget the lanes (ring hygiene: no
+        threads to join — all lane work runs on the scheduler's own
+        dispatcher/completer threads)."""
+        obs_recorder.remove_state_provider(STATE_PROVIDER)
+        with self._lock:
+            self._lanes.clear()

@@ -112,6 +112,7 @@ class Scheduler:
         flush_age: float = 0.05,
         max_inflight: int = 1,
         pipeline_depth: int = 1,
+        resident_ring: int = 0,
         retry: RetryPolicy = DEFAULT_DISPATCH_RETRY,
         retryable=is_transient_io,
         run_batch=batcher.run_batch,
@@ -135,6 +136,23 @@ class Scheduler:
                 "pipeline_depth > 1 replaces the worker pool with the "
                 "dispatcher/completer pipeline; leave max_inflight at 1"
             )
+        if resident_ring < 0 or resident_ring == 1:
+            raise ValueError(
+                f"resident_ring must be 0 (off) or >= 2, got {resident_ring}"
+            )
+        if resident_ring > 1 and pipeline_depth < 2:
+            raise ValueError(
+                "the resident ring rides the dispatcher/completer pipeline; "
+                "set pipeline_depth >= 2 (>= 2x the ring keeps the device "
+                "stream fed)"
+            )
+        if resident_ring > 1 and (
+            run_batch is not batcher.run_batch or split_batch is not None
+        ):
+            raise ValueError(
+                "resident_ring requires the default batcher engine; an "
+                "injected run_batch/split_batch has no ring lane"
+            )
         self.journal = journal
         self.metrics = metrics or Metrics()
         self.max_queue_depth = max_queue_depth
@@ -149,11 +167,30 @@ class Scheduler:
         # Auto-wired to the batcher's split only when run_batch is the
         # default batcher entry: an injected run_batch (tests, alternative
         # engines) has no split, so the completer runs it whole — pipeline
-        # semantics hold, only the stage/compute overlap is lost.
-        if split_batch is None and run_batch is batcher.run_batch:
+        # semantics hold, only the stage/compute overlap is lost. With
+        # resident_ring on, the split's dispatch/complete ride the
+        # per-bucket ring lanes (gol_tpu/serve/resident.py) instead of
+        # posting one device program per batch.
+        self.resident_ring = resident_ring
+        self._resident = None
+        if resident_ring > 1:
+            from gol_tpu.serve.resident import ResidentEngine
+
+            self._resident = ResidentEngine(resident_ring, clock=clock)
+            split_batch = self._resident.split()
+        elif split_batch is None and run_batch is batcher.run_batch:
             split_batch = (batcher.stage, batcher.dispatch, batcher.complete)
         self._split = split_batch
         self._window = None  # dispatcher->completer handoff (pipelined mode)
+        # Resident mode detaches terminal journaling from the completer's
+        # critical path: record appends ride a dedicated writer thread (the
+        # journal fsync was the last per-batch host cost serializing with
+        # readbacks). The durability contract is unchanged — a done record
+        # was always allowed to be lost to a crash (the re-run is
+        # idempotent); stop() drains the queue before returning, so a clean
+        # shutdown loses nothing.
+        self._journal_window = None
+        self._journal_thread = None
         self._clock = clock
         self._cv = threading.Condition()
         self._jobs: dict[str, Job] = {}
@@ -171,12 +208,21 @@ class Scheduler:
             if self._threads:
                 return
             self._stopped = False
+            if self._resident is not None:
+                self._resident.reopen()  # state provider (no-op first time)
             if self.pipeline_depth > 1:
                 # Pipelined dispatch: one dispatcher (claim + stage + async
                 # dispatch) and one completer (readback + journal), with at
                 # most pipeline_depth batches between claim and completion.
                 from gol_tpu.pipeline.inflight import Handoff
 
+                if self._resident is not None and self.journal is not None:
+                    self._journal_window = Handoff()
+                    self._journal_thread = threading.Thread(
+                        target=self._journal_loop, name="gol-serve-journal",
+                        daemon=True,
+                    )
+                    self._journal_thread.start()
                 self._window = Handoff()
                 for name, target in (
                     ("gol-serve-dispatch", self._dispatch_loop),
@@ -203,6 +249,26 @@ class Scheduler:
             threads, self._threads = self._threads, []
         for t in threads:
             t.join(timeout=5)
+        if self._journal_window is not None:
+            # After the completer is gone nothing enqueues: close the
+            # window and let the writer drain every pending record — even
+            # a drain=False stop flushes the journal before returning.
+            # (If a completer join above timed out, its late enqueue races
+            # the close — _journal_terminal falls back to an inline append
+            # in that case, so the record still lands.)
+            self._journal_window.close()
+            self._journal_thread.join(timeout=30)
+            if self._journal_thread.is_alive():
+                logger.warning(
+                    "gol-serve-journal did not drain within 30s; pending "
+                    "done records may be lost (restart re-runs those jobs)"
+                )
+            self._journal_window = None
+            self._journal_thread = None
+        if self._resident is not None:
+            # After the threads are gone: drop the recorder state provider
+            # and the lanes (ring hygiene; start() re-registers).
+            self._resident.close()
         return drained
 
     def drain(self, timeout: float | None = None) -> bool:
@@ -426,11 +492,38 @@ class Scheduler:
             job.result = result
             job.transition(DONE)
             self.metrics.inc("jobs_completed_total")
-            self._journal_terminal(JobJournal.record_done, job)
+        # One journal append + fsync for the whole batch's done records
+        # (identical lines to per-job appends — replay is oblivious): the
+        # per-record fsync was the last per-*job* serial host cost on the
+        # hot path. Durability contract unchanged: a crash before the
+        # append re-runs the batch idempotently after replay, exactly like
+        # a single lost record.
+        self._journal_terminal(JobJournal.record_done_many, batch)
 
     def _execute(self, key: BucketKey, batch: list[Job]) -> None:
         started = self._clock()
         self._begin_batch(batch, started)
+        staged = None
+
+        def attempt():
+            # Stage ONCE, retry dispatch+complete from the retained host
+            # staging: re-staging on retry would re-run the whole stack +
+            # np.packbits pass for operands that are already retained (and
+            # bit-identical — staging is deterministic). The
+            # engine_stage_packs_total counter pins zero re-packs on the
+            # retry path. A failure inside stage() itself leaves ``staged``
+            # unset, so the next attempt re-stages — the only case where
+            # staging can legitimately run twice.
+            nonlocal staged
+            if self._split is None:
+                return self._run_batch(key, batch)
+            stage_fn, dispatch_fn, complete_fn = self._split
+            if staged is None:
+                with obs_trace.span("pipeline.stage", bucket=key.label(),
+                                    jobs=len(batch)):
+                    staged = stage_fn(key, batch)
+            return complete_fn(dispatch_fn(staged))
+
         try:
             # The batch span: what a traced `gol serve` session exports and
             # what `GET /debug/trace` shows mid-flight. One span per
@@ -439,7 +532,7 @@ class Scheduler:
             with obs_trace.span("serve.batch", bucket=key.label(),
                                 jobs=len(batch)):
                 results = self.retry.call(
-                    lambda: self._run_batch(key, batch),
+                    attempt,
                     retryable=self.retryable,
                     on_retry=self._on_retry(key, batch),
                 )
@@ -567,32 +660,69 @@ class Scheduler:
             return
         self._finish_batch(key, batch, results, flight.started)
 
-    def _journal_terminal(self, record_fn, job: Job) -> None:
-        """Append a terminal record, surviving journal I/O failure.
+    def _journal_terminal(self, record_fn, job_or_batch) -> None:
+        """Append terminal record(s), surviving journal I/O failure.
 
         A failing fsync/write (ENOSPC, EIO) here must never escape: it would
         kill the worker thread, strand the rest of the batch in RUNNING, and
         stop all dispatch. The in-memory state stays authoritative for this
         process; the cost of a dropped terminal record is a re-run after a
         restart (idempotent), logged loudly and counted so operators see the
-        journal degrading before that."""
+        journal degrading before that.
+
+        In resident mode the append rides the ``gol-serve-journal`` writer
+        thread so the completer's readbacks overlap the fsyncs; everywhere
+        else (the classic worker and the plain pipeline — PR-5 behavior,
+        test-pinned) it runs inline."""
         if self.journal is None:
             return
+        window = self._journal_window  # snapshot: stop() may null the field
+        if window is not None:
+            try:
+                window.put((record_fn, job_or_batch))
+            except RuntimeError:
+                # stop() closed the window after a join timeout while this
+                # completion was still in flight — append inline rather
+                # than drop the record (or kill the completer).
+                self._journal_append(record_fn, job_or_batch)
+                return
+            self.metrics.set_gauge("journal_queue_depth", len(window))
+            return
+        self._journal_append(record_fn, job_or_batch)
+
+    def _journal_append(self, record_fn, job_or_batch) -> None:
         try:
-            record_fn(self.journal, job)
+            record_fn(self.journal, job_or_batch)
         except OSError as err:
             self.metrics.inc("journal_errors_total")
+            jobs = (job_or_batch if isinstance(job_or_batch, list)
+                    else [job_or_batch])
             logger.error(
-                "journal append failed for job %s (%s) — state is held "
-                "in-memory only; a restart will re-run it: %s: %s",
-                job.id, job.state, type(err).__name__, err,
+                "journal append failed for job(s) %s (%s) — state is held "
+                "in-memory only; a restart will re-run them: %s: %s",
+                ",".join(j.id for j in jobs), jobs[0].state,
+                type(err).__name__, err,
             )
+
+    def _journal_loop(self) -> None:
+        """The resident lanes' journal writer: drains (record_fn, jobs)
+        items until the window closes, then exits — stop() joins it, so a
+        clean shutdown (drained or not) flushes every pending record. The
+        window is captured once: a stop() that times out waiting and nulls
+        the field cannot make a still-draining writer drop queued items."""
+        window = self._journal_window
+        while True:
+            item = window.get()
+            if item is None:
+                return
+            self._journal_append(*item)
+            self.metrics.set_gauge("journal_queue_depth", len(window))
 
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict:
         with self._cv:
-            return {
+            out = {
                 "queued": self._queued,
                 "inflight_batches": self._inflight,
                 "buckets": {
@@ -601,6 +731,9 @@ class Scheduler:
                 "draining": self._draining,
                 "jobs": len(self._jobs),
             }
+        if self._resident is not None:
+            out["resident_rings"] = self._resident.state()
+        return out
 
 
 # Re-exported for callers that only import the scheduler module.

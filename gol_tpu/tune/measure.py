@@ -349,6 +349,7 @@ def run_serve_search(
                 results = engine.simulate_batch(
                     chunk, config, padded_shape=(ph, pw),
                     pad_batch_to=batcher.pad_batch(len(chunk), plan=cand),
+                    temporal_depth=cand.temporal_depth,
                 )
                 if gate:
                     for board, result in zip(chunk, results):

@@ -50,6 +50,14 @@ BATCH_LADDERS = (
     (1, 4, 16, 64),
     (1, 8, 64),
 )
+# Batched temporal depth: generations per while iteration of the batch/ring
+# programs (engine.make_batch_runner temporal_depth — bit-exact at any
+# depth, so purely a measured axis). Crossed with the quanta but not the
+# ladders: depth amortizes the per-iteration cross-board sync, which
+# interacts with the canvas (quantum) and not with how request counts
+# round — the full 3-way cross would triple search time for candidates
+# that cannot differ.
+SERVE_TEMPORAL_DEPTHS = (1, 2, 4, 8)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,25 +100,40 @@ class EnginePlan:
 
 @dataclasses.dataclass(frozen=True)
 class ServePlan:
-    """Serve-batcher geometry: one plan covers the whole fleet's buckets."""
+    """Serve-batcher geometry: one plan covers the whole fleet's buckets.
+
+    ``temporal_depth`` is the batched engine's generations-per-while-
+    iteration (bit-exact at any value — engine._temporal_body), applied to
+    every bucket program the batcher builds; depth 1 is the pre-tune
+    behavior, byte-identically."""
 
     pad_quantum: int = 32
     batch_ladder: tuple[int, ...] = BATCH_LADDERS[0]
+    temporal_depth: int = 1
 
     def label(self) -> str:
-        return f"q{self.pad_quantum}/ladder{'-'.join(map(str, self.batch_ladder))}"
+        label = f"q{self.pad_quantum}/ladder{'-'.join(map(str, self.batch_ladder))}"
+        if self.temporal_depth != 1:
+            label += f"/T{self.temporal_depth}"
+        return label
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "pad_quantum": self.pad_quantum,
             "batch_ladder": list(self.batch_ladder),
         }
+        # Only when tuned off the default: older caches (and their pinned
+        # goldens) stay byte-stable.
+        if self.temporal_depth != 1:
+            out["temporal_depth"] = self.temporal_depth
+        return out
 
     @classmethod
     def from_dict(cls, data: dict) -> "ServePlan":
         return cls(
             pad_quantum=int(data["pad_quantum"]),
             batch_ladder=tuple(int(x) for x in data["batch_ladder"]),
+            temporal_depth=int(data.get("temporal_depth", 1)),
         )
 
 
@@ -132,6 +155,10 @@ def valid_serve_plan(plan: ServePlan, max_batch: int) -> bool:
         and ladder[0] == 1
         and ladder[-1] == max_batch
         and all(a < b for a, b in zip(ladder, ladder[1:]))
+        # Any depth is bit-exact, but the engine caps the axis (and a
+        # hand-edited 10^6 would hang every program in useless no-op
+        # sub-steps after the batch converges).
+        and 1 <= plan.temporal_depth <= 64
     )
 
 
@@ -251,11 +278,19 @@ def engine_candidates(ctx: TuneContext, quick: bool = False) -> list[EnginePlan]
 
 
 def serve_candidates(max_batch: int = 64) -> list[ServePlan]:
-    """Every serve-geometry plan, default first."""
+    """Every serve plan, default first: the geometry axes (quantum x
+    ladder, at depth 1) plus the batched temporal-depth axis (depth x
+    quantum, at the default ladder — see SERVE_TEMPORAL_DEPTHS for why the
+    ladder is not crossed)."""
     candidates = [DEFAULT_SERVE_PLAN]
     for quantum in PAD_QUANTA:
         for ladder in BATCH_LADDERS:
             cand = ServePlan(pad_quantum=quantum, batch_ladder=ladder)
+            if valid_serve_plan(cand, max_batch) and cand not in candidates:
+                candidates.append(cand)
+    for quantum in PAD_QUANTA:
+        for depth in SERVE_TEMPORAL_DEPTHS:
+            cand = ServePlan(pad_quantum=quantum, temporal_depth=depth)
             if valid_serve_plan(cand, max_batch) and cand not in candidates:
                 candidates.append(cand)
     return candidates

@@ -94,3 +94,18 @@ def test_no_wall_clock_in_tune():
             f"wall-clock {needle} in gol_tpu/tune/ (use time.perf_counter() "
             f"for every trial timing): {offenders}"
         )
+
+
+def test_no_wall_clock_in_engine():
+    """Same rule for the engine module itself, which PR 6 made part of the
+    serve hot path (the batched/ring runners and their staging live there):
+    the dispatch-gap and occupancy numbers built on top of it are only
+    meaningful over a monotonic clock. The serve/ rule already covers
+    gol_tpu/serve/resident.py recursively; this pins the engine side."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT, needle)
+        offenders = [o for o in offenders if o.startswith("engine.py")]
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/engine.py (use "
+            f"time.perf_counter() on every serving path): {offenders}"
+        )

@@ -1,0 +1,525 @@
+"""Resident mega-batch engine (ISSUE 6): the on-device serving loop.
+
+Covers the three legs of the resident lane and its satellites:
+
+- **batched temporal depth** — ``engine.make_batch_runner(temporal_depth=T)``
+  byte-identical to the per-generation form for mixed-fate batches (dynamic
+  per-board gen limits, empty/similar/gen_limit exits), both conventions,
+  every depth in the tuned axis {1, 2, 4, 8};
+- **the ring runner** — ``make_ring_runner``/``stage_ring``/``dispatch_ring``
+  /``complete_ring`` bit-identical to the per-batch runner slot for slot,
+  including partially filled rings and the donation-safe retry re-dispatch;
+- **the resident serve lane** — ``Scheduler(resident_ring=R)`` results
+  byte-identical to the classic depth-1 worker, exactly-once under SIGKILL
+  mid-ring (real subprocess kill + journal replay), ring/thread hygiene
+  after drain, and the no-re-pack retry contract
+  (``engine_stage_packs_total``).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu import engine
+from gol_tpu.config import GameConfig
+from gol_tpu.io import text_grid
+from gol_tpu.obs import recorder as obs_recorder, registry as obs_registry
+from gol_tpu.serve import batcher
+from gol_tpu.serve.jobs import DONE, JobJournal, new_job
+from gol_tpu.serve.resident import STATE_PROVIDER, ResidentEngine
+from gol_tpu.serve.scheduler import Scheduler
+
+CONVENTIONS = ["c", "cuda"]
+
+
+def _mixed_fate_boards():
+    """Boards covering every exit reason inside one batch."""
+    dies = np.zeros((32, 32), np.uint8)
+    dies[4, 4] = 1  # lone cell: empty exit
+    still = np.zeros((32, 32), np.uint8)
+    still[3:5, 3:5] = 1  # block still life: similarity exit
+    soup = text_grid.generate(32, 32, seed=7)  # runs to the limit
+    soup2 = text_grid.generate(32, 32, seed=8)
+    return [dies, still, soup, soup2]
+
+
+def _solo(board, config):
+    return engine.simulate(board, config)
+
+
+def _assert_matches_solo(results, boards, configs):
+    reasons = set()
+    for r, board, config in zip(results, boards, configs):
+        want = _solo(board, config)
+        assert np.array_equal(r.grid, want.grid)
+        assert r.generations == want.generations
+        reasons.add(r.exit_reason)
+    return reasons
+
+
+def _wait(predicate, timeout=60.0, interval=0.01):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _serve_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("gol-serve-")]
+
+
+# ---------------------------------------------------------------------------
+# Batched temporal depth.
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedTemporalDepth:
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    @pytest.mark.parametrize("depth", [2, 4, 8])
+    def test_bit_exact_with_mixed_fates(self, convention, depth):
+        boards = _mixed_fate_boards()
+        # Dynamic per-board limits: one board's limit lands mid-depth-block,
+        # the case that would corrupt its grid if depth overran an exit.
+        configs = [GameConfig(gen_limit=g, convention=convention)
+                   for g in (60, 60, 13, 7)]
+        results = engine.simulate_batch(
+            boards, configs, padded_shape=(32, 32), pad_batch_to=4,
+            temporal_depth=depth,
+        )
+        reasons = _assert_matches_solo(results, boards, configs)
+        assert reasons == {"empty", "similar", "gen_limit"}
+
+    def test_depth_validation(self):
+        with pytest.raises(ValueError):
+            engine.make_batch_runner((32, 32), 1, temporal_depth=0)
+        with pytest.raises(ValueError):
+            engine.make_batch_runner((32, 32), 1, temporal_depth=65)
+
+    def test_depth1_is_the_default(self):
+        """Absent a tuned plan the serve path stages at depth 1 — the pin
+        that default behavior is byte-identical to pre-resident serving."""
+        assert batcher._plan().temporal_depth == 1
+        staged = engine.stage_batch(
+            [np.zeros((32, 32), np.uint8)], GameConfig(gen_limit=2),
+            padded_shape=(32, 32), pad_batch_to=1,
+        )
+        assert staged.temporal_depth == 1
+
+
+# ---------------------------------------------------------------------------
+# The ring runner.
+# ---------------------------------------------------------------------------
+
+
+class TestRingEngine:
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_partial_ring_matches_batch_and_solo(self, convention):
+        boards = _mixed_fate_boards()
+        config = GameConfig(gen_limit=40, convention=convention)
+        s1 = engine.stage_batch(boards[:2], config, padded_shape=(32, 32),
+                                pad_batch_to=2)
+        s2 = engine.stage_batch(boards[2:], config, padded_shape=(32, 32),
+                                pad_batch_to=2)
+        ring = engine.stage_ring([s1, s2], ring=4)  # 2 filled, 2 inert slots
+        slots = engine.complete_ring(engine.dispatch_ring(ring))
+        assert len(slots) == 2
+        reasons = set()
+        for slot, chunk in zip(slots, (boards[:2], boards[2:])):
+            reasons |= _assert_matches_solo(slot, chunk, [config] * 2)
+        assert reasons == {"empty", "similar", "gen_limit"}
+
+    def test_masked_bucket_with_temporal_depth(self):
+        rng = np.random.default_rng(3)
+        boards = [rng.integers(0, 2, (20, 24), np.uint8),
+                  rng.integers(0, 2, (30, 30), np.uint8)]
+        config = GameConfig(gen_limit=25)
+        staged = engine.stage_batch(boards, config, padded_shape=(32, 32),
+                                    pad_batch_to=2, temporal_depth=4)
+        assert staged.mode == "masked"
+        ring = engine.stage_ring([staged], ring=2)
+        (results,) = engine.complete_ring(engine.dispatch_ring(ring))
+        _assert_matches_solo(results, boards, [config] * 2)
+
+    def test_redispatch_same_ring_is_idempotent(self):
+        """The retry path: a second dispatch from the retained host staging
+        (the donated device buffers of the first are consumed) returns
+        identical results — and never re-packs (the staging counter)."""
+        boards = _mixed_fate_boards()
+        config = GameConfig(gen_limit=30)
+        staged = engine.stage_batch(boards, config, padded_shape=(32, 32),
+                                    pad_batch_to=4)
+        packs0 = obs_registry.default().counter("engine_stage_packs_total")
+        ring = engine.stage_ring([staged], ring=2)
+        first = engine.complete_ring(engine.dispatch_ring(ring))
+        second = engine.complete_ring(engine.dispatch_ring(ring))
+        for a, b in zip(first[0], second[0]):
+            assert np.array_equal(a.grid, b.grid)
+            assert a.generations == b.generations
+            assert a.exit_reason == b.exit_reason
+        assert obs_registry.default().counter(
+            "engine_stage_packs_total") == packs0  # zero re-packs on retry
+
+    def test_ring_rejects_mixed_geometry_and_overflow(self):
+        config = GameConfig(gen_limit=5)
+        a = engine.stage_batch([np.zeros((32, 32), np.uint8)], config,
+                               padded_shape=(32, 32), pad_batch_to=1)
+        b = engine.stage_batch([np.zeros((32, 32), np.uint8)] * 2, config,
+                               padded_shape=(32, 32), pad_batch_to=2)
+        with pytest.raises(ValueError):
+            engine.stage_ring([a, b], ring=2)  # different batch rung
+        cuda = engine.stage_batch(
+            [np.zeros((32, 32), np.uint8)],
+            GameConfig(gen_limit=5, convention="cuda"),
+            padded_shape=(32, 32), pad_batch_to=1,
+        )
+        with pytest.raises(ValueError):
+            engine.stage_ring([a, cuda], ring=2)  # different convention
+        with pytest.raises(ValueError):
+            engine.stage_ring([a, a, a], ring=2)  # overflow
+        with pytest.raises(ValueError):
+            engine.stage_ring([], ring=2)
+
+
+# ---------------------------------------------------------------------------
+# The resident serve lane.
+# ---------------------------------------------------------------------------
+
+
+class TestResidentServe:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Scheduler(resident_ring=1)
+        with pytest.raises(ValueError):
+            Scheduler(resident_ring=2)  # pipeline_depth defaults to 1
+        with pytest.raises(ValueError):
+            Scheduler(resident_ring=2, pipeline_depth=4,
+                      run_batch=lambda key, jobs: [])  # no ring for injected
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_results_match_classic_depth1(self, tmp_path, convention):
+        """The acceptance pin: resident-lane results are byte-identical to
+        the classic depth-1 worker for mixed-fate batches across two
+        buckets — grids, generation counts, AND exit reasons."""
+        boards = []
+        for i in range(12):
+            if i % 4 == 0:
+                b = np.zeros((32, 32), np.uint8)
+                b[2, 2] = 1  # empty exit
+            elif i % 4 == 1:
+                b = np.zeros((30, 30), np.uint8)
+                b[3:5, 3:5] = 1  # still life in the masked bucket
+            else:
+                side = 32 if i % 2 == 0 else 30
+                b = text_grid.generate(side, side, seed=900 + i)
+            boards.append(b)
+
+        def run(**kwargs):
+            sched = Scheduler(flush_age=0.01, max_batch=4, **kwargs)
+            jobs = [
+                new_job(b.shape[1], b.shape[0], b, gen_limit=18,
+                        convention=convention)
+                for b in boards
+            ]
+            for job in jobs:
+                sched.submit(job)
+            sched.start()
+            assert sched.drain(timeout=120)
+            sched.stop(drain=False)
+            assert all(j.state == DONE for j in jobs)
+            return jobs
+
+        classic = run()
+        resident = run(pipeline_depth=8, resident_ring=4)
+        for a, b in zip(classic, resident):
+            assert np.array_equal(a.result.grid, b.result.grid)
+            assert a.result.generations == b.result.generations
+            assert a.result.exit_reason == b.result.exit_reason
+        reasons = {j.result.exit_reason for j in resident}
+        assert reasons == {"empty", "similar", "gen_limit"}
+
+    def test_ring_and_thread_hygiene_after_drain(self, tmp_path):
+        journal = JobJournal(str(tmp_path / "j"))
+        sched = Scheduler(journal=journal, flush_age=0.0, max_batch=4,
+                          pipeline_depth=4, resident_ring=2)
+        jobs = [new_job(32, 32, text_grid.generate(32, 32, seed=40 + i),
+                        gen_limit=8) for i in range(6)]
+        for job in jobs:
+            sched.submit(job)
+        sched.start()
+        assert sched.drain(timeout=120)
+        stats = sched.stats()
+        # Drained: every lane's open ring and unresolved drains are empty.
+        assert all(v == 0 for k, v in stats["resident_rings"].items()
+                   if k.endswith((".open", ".unresolved_drains")))
+        assert any(k.endswith(".drains_total") and v > 0
+                   for k, v in stats["resident_rings"].items())
+        sched.stop(drain=False)
+        assert _serve_threads() == []
+        # The flight-recorder state provider is gone after stop.
+        assert STATE_PROVIDER not in obs_recorder._state_providers
+        replay = journal.replay()
+        journal.close()
+        assert not replay.pending
+        assert set(replay.results) == {j.id for j in jobs}
+
+    def test_state_provider_reports_ring_state(self):
+        eng = ResidentEngine(ring=2)
+        try:
+            assert STATE_PROVIDER in obs_recorder._state_providers
+            key = batcher.bucket_for(
+                new_job(32, 32, np.zeros((32, 32), np.uint8), gen_limit=2)
+            )
+            staged = eng.stage(key, [
+                new_job(32, 32, text_grid.generate(32, 32, seed=1),
+                        gen_limit=4)
+            ])
+            ticket = eng.dispatch(staged)
+            state = eng.state()
+            # Eager policy: an idle lane dispatches the slot immediately
+            # (the device must never wait on a fuller ring).
+            assert state[f"{key.label()}.open"] == 0
+            assert state[f"{key.label()}.unresolved_drains"] == 1
+            results = eng.complete(ticket)
+            assert len(results) == 1
+            state = eng.state()
+            assert state[f"{key.label()}.open"] == 0
+            assert state[f"{key.label()}.unresolved_drains"] == 0
+            assert state[f"{key.label()}.drains_total"] == 1
+        finally:
+            eng.close()
+        assert STATE_PROVIDER not in obs_recorder._state_providers
+
+    def test_worker_retry_reuses_retained_staging_no_repack(self):
+        """The fixed satellite bug: the depth-1 worker used to re-run the
+        whole stage (stack + np.packbits) on every retry attempt. Now it
+        stages once and retries dispatch+complete from the retained host
+        staging — pinned by the pack counter AND the stage call count."""
+        calls = {"stage": 0, "dispatch": 0, "complete": 0}
+
+        def stage(key, jobs):
+            calls["stage"] += 1
+            return batcher.stage(key, jobs)
+
+        def dispatch(staged):
+            calls["dispatch"] += 1
+            return batcher.dispatch(staged)
+
+        def complete(inflight):
+            calls["complete"] += 1
+            if calls["complete"] == 1:
+                raise OSError("connection reset by peer")
+            return batcher.complete(inflight)
+
+        sched = Scheduler(flush_age=0.0,
+                          split_batch=(stage, dispatch, complete))
+        assert sched.pipeline_depth == 1  # the classic worker path
+        job = new_job(32, 32, text_grid.generate(32, 32, seed=5), gen_limit=6)
+        packs0 = obs_registry.default().counter("engine_stage_packs_total")
+        sched.submit(job)
+        sched.start()
+        assert _wait(lambda: job.state == DONE), job.state
+        sched.stop(drain=False)
+        assert calls == {"stage": 1, "dispatch": 2, "complete": 2}
+        assert obs_registry.default().counter(
+            "engine_stage_packs_total") == packs0 + 1
+        assert sched.metrics.counter("batch_retries_total") == 1
+
+    def test_flight_dump_and_report_carry_ring_state(self, tmp_path):
+        """The observability satellite end to end: a flight dump taken
+        mid-session carries the ring state provider, and `gol trace-report`
+        renders the resident span, the gap histogram, and the occupancy
+        gauge."""
+        from gol_tpu.obs import report as obs_report, trace as obs_trace
+
+        obs_registry.reset_default()
+        obs_trace.enable()
+        obs_recorder.install(str(tmp_path))
+        try:
+            sched = Scheduler(flush_age=0.0, max_batch=2, pipeline_depth=4,
+                              resident_ring=2)
+            jobs = [new_job(32, 32, text_grid.generate(32, 32, seed=80 + i),
+                            gen_limit=6) for i in range(4)]
+            for job in jobs:
+                sched.submit(job)
+            sched.start()
+            assert sched.drain(timeout=120)
+            path = obs_recorder.trigger("test")
+            sched.stop(drain=False)
+        finally:
+            obs_recorder.uninstall()
+            obs_trace.disable()
+        rendered = obs_report.render(path)
+        assert "serve.resident_loop" in rendered
+        assert "state[resident_rings]" in rendered
+        assert "dispatch_gap_seconds" in rendered
+        assert "ring_slot_occupancy" in rendered
+
+    def test_resident_metrics_land_in_registry(self, tmp_path):
+        obs_registry.reset_default()
+        sched = Scheduler(flush_age=0.0, max_batch=2, pipeline_depth=4,
+                          resident_ring=2)
+        jobs = [new_job(32, 32, text_grid.generate(32, 32, seed=70 + i),
+                        gen_limit=6) for i in range(4)]
+        for job in jobs:
+            sched.submit(job)
+        sched.start()
+        assert sched.drain(timeout=120)
+        sched.stop(drain=False)
+        snap = obs_registry.default().snapshot()
+        assert "dispatch_gap_seconds" in snap["histograms"]
+        assert "ring_slot_occupancy" in snap["gauges"]
+        assert 0 < snap["gauges"]["ring_slot_occupancy"] <= 1
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once under SIGKILL mid-ring (real subprocess + journal replay).
+# ---------------------------------------------------------------------------
+
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _start_resident_server(port: int, journal_dir: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "serve",
+            "--port", str(port), "--journal-dir", journal_dir,
+            "--flush-age", "0.001", "--max-batch", "4",
+            "--pipeline-depth", "8", "--resident-ring", "4",
+        ],
+        env=env, cwd=ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_serving(proc, url, timeout=120):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server died rc={proc.returncode}: {proc.stdout.read()}"
+            )
+        try:
+            code, _ = _http("GET", url + "/healthz", timeout=5)
+            if code == 200:
+                return
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.05)
+    raise RuntimeError("server did not come up")
+
+
+class TestSigkillMidRing:
+    def test_exactly_once_after_sigkill_and_replay(self, tmp_path):
+        """SIGKILL a resident-ring server with drains in flight; the
+        restarted server replays the journal and every accepted job ends
+        DONE exactly once, byte-identical to solo runs."""
+        journal_dir = str(tmp_path / "journal")
+        njobs, gen_limit = 12, 400
+        boards = [text_grid.generate(64, 64, seed=5000 + i)
+                  for i in range(njobs)]
+        payloads = [
+            {
+                "width": 64, "height": 64, "gen_limit": gen_limit,
+                "cells": text_grid.encode(b).decode("ascii"),
+            }
+            for b in boards
+        ]
+
+        port = _free_port()
+        url = f"http://127.0.0.1:{port}"
+        proc = _start_resident_server(port, journal_dir)
+        ids = []
+        try:
+            _wait_serving(proc, url)
+            for payload in payloads:
+                code, out = _http("POST", url + "/jobs", payload)
+                assert code == 202, out
+                ids.append(out["id"])
+            # Give the ring a moment to get drains genuinely in flight,
+            # then kill without any Python unwinding.
+            time.sleep(0.4)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        # Restart on the same journal: unfinished jobs replay and run.
+        port2 = _free_port()
+        url2 = f"http://127.0.0.1:{port2}"
+        proc2 = _start_resident_server(port2, journal_dir)
+        try:
+            _wait_serving(proc2, url2)
+            results = {}
+
+            def all_done():
+                for jid in ids:
+                    if jid in results:
+                        continue
+                    code, out = _http("GET", f"{url2}/result/{jid}",
+                                      timeout=30)
+                    if code != 200:
+                        return False
+                    results[jid] = out
+                return True
+
+            assert _wait(all_done, timeout=240), (
+                f"unfinished: {set(ids) - set(results)}"
+            )
+        finally:
+            if proc2.poll() is None:
+                proc2.send_signal(signal.SIGTERM)
+                try:
+                    proc2.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc2.kill()
+
+        # Byte-identical to solo runs (the engine contract survived the
+        # kill), and the journal shows each id DONE exactly once.
+        for jid, board in zip(ids, boards):
+            out = results[jid]
+            want = engine.simulate(board, GameConfig(gen_limit=gen_limit))
+            got = text_grid.decode(out["grid"].encode("ascii"), 64, 64)
+            assert np.array_equal(got, want.grid)
+            assert out["generations"] == want.generations
+        with open(os.path.join(journal_dir, JobJournal.FILENAME), "rb") as f:
+            events = [json.loads(line)
+                      for line in f.read().splitlines() if line]
+        for jid in ids:
+            dones = [e for e in events
+                     if e.get("event") == "done" and e.get("id") == jid]
+            assert len(dones) == 1, f"{jid} done {len(dones)} times"

@@ -1,0 +1,229 @@
+"""Resident mega-batch crash/restart smoke: SIGKILL mid-ring, replay, verify.
+
+The `make megabatch-smoke` harness (mirroring `make pipeline-smoke`),
+exercising the resident ring lanes against real OS processes:
+
+1. **Kill half** — a ``gol serve --resident-ring 4 --pipeline-depth 8``
+   session takes jobs across two padding buckets (an exact-fit packed
+   bucket and a masked one) and is SIGKILLed while ring drains are in
+   flight — no Python unwinding, like a power cut. A restarted server on
+   the same journal replays exactly the unfinished jobs; after a drain,
+   every accepted job is DONE exactly once (one `done` record per id in
+   the journal) and every result is byte-identical to a solo `gol run` of
+   the same board.
+
+2. **A/B half** — the same job set served by a classic ``--pipeline-depth
+   1`` server must return byte-identical grids, generation counts, and
+   exit reasons (the resident lane is a pure performance change).
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/megabatch_smoke.py [--jobs 16] [--gen-limit 300]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("GOL_FAULTS", None)
+    return env
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _start(port, journal_dir, *extra):
+    return subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu", "serve", "--port", str(port),
+         "--journal-dir", journal_dir, "--flush-age", "0.001",
+         "--max-batch", "4", *extra],
+        env=_env(), cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_up(proc, base, timeout=180):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server died rc={proc.returncode}:\n{proc.stdout.read()}"
+            )
+        try:
+            code, _ = _http("GET", base + "/healthz", timeout=5)
+            if code == 200:
+                return
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.05)
+    raise RuntimeError("server did not come up")
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _collect(base, ids, timeout):
+    results = {}
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline and len(results) < len(ids):
+        for jid in ids:
+            if jid in results:
+                continue
+            code, out = _http("GET", f"{base}/result/{jid}")
+            if code == 200:
+                results[jid] = out
+        time.sleep(0.05)
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jobs", type=int, default=16)
+    ap.add_argument("--gen-limit", type=int, default=300)
+    ap.add_argument("--kill-after", type=float, default=0.5,
+                    help="seconds after the last submit to SIGKILL")
+    args = ap.parse_args()
+
+    from gol_tpu.io import text_grid  # noqa: E402 - after sys.path insert
+
+    workdir = tempfile.mkdtemp(prefix="gol-megabatch-smoke-")
+    journal_dir = os.path.join(workdir, "journal")
+    resident = ["--resident-ring", "4", "--pipeline-depth", "8"]
+    boards = []
+    for i in range(args.jobs):
+        side = 64 if i % 2 == 0 else 60  # packed + masked buckets
+        boards.append(text_grid.generate(side, side, seed=8000 + i))
+    payloads = [
+        {"width": b.shape[1], "height": b.shape[0],
+         "gen_limit": args.gen_limit,
+         "cells": text_grid.encode(b).decode("ascii")}
+        for b in boards
+    ]
+
+    ok = True
+    # -- 1. SIGKILL mid-ring, replay, drain --------------------------------
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = _start(port, journal_dir, *resident)
+    ids = []
+    try:
+        _wait_up(proc, base)
+        for payload in payloads:
+            code, out = _http("POST", base + "/jobs", payload)
+            if code != 202:
+                print(f"megabatch-smoke: submit rejected {code}: {out}")
+                return 1
+            ids.append(out["id"])
+        time.sleep(args.kill_after)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    with open(os.path.join(journal_dir, "journal.jsonl"), "rb") as f:
+        done_before = sum(
+            1 for line in f.read().splitlines()
+            if line and json.loads(line).get("event") == "done"
+        )
+    print(f"megabatch-smoke: SIGKILL'd resident server; journal shows "
+          f"{done_before}/{args.jobs} done pre-kill")
+
+    port2 = _free_port()
+    base2 = f"http://127.0.0.1:{port2}"
+    proc2 = _start(port2, journal_dir, *resident)
+    try:
+        _wait_up(proc2, base2)
+        results = _collect(base2, ids, timeout=300)
+    finally:
+        _stop(proc2)
+    if len(results) != len(ids):
+        print(f"megabatch-smoke: {len(ids) - len(results)} job(s) never "
+              f"finished after replay")
+        return 1
+
+    with open(os.path.join(journal_dir, "journal.jsonl"), "rb") as f:
+        events = [json.loads(line) for line in f.read().splitlines() if line]
+    for jid in ids:
+        dones = [e for e in events
+                 if e.get("event") == "done" and e.get("id") == jid]
+        if len(dones) != 1:
+            print(f"megabatch-smoke: job {jid} has {len(dones)} done "
+                  f"records (want exactly 1)")
+            ok = False
+
+    # -- 2. A/B: classic depth-1 serve must match byte for byte ------------
+    port3 = _free_port()
+    base3 = f"http://127.0.0.1:{port3}"
+    proc3 = _start(port3, os.path.join(workdir, "journal-classic"))
+    try:
+        _wait_up(proc3, base3)
+        classic_ids = []
+        for payload in payloads:
+            code, out = _http("POST", base3 + "/jobs", payload)
+            if code != 202:
+                print(f"megabatch-smoke: classic submit rejected {code}")
+                return 1
+            classic_ids.append(out["id"])
+        classic = _collect(base3, classic_ids, timeout=300)
+    finally:
+        _stop(proc3)
+    if len(classic) != len(classic_ids):
+        print("megabatch-smoke: classic lane failed to finish")
+        return 1
+    for jid, cid in zip(ids, classic_ids):
+        a, b = results[jid], classic[cid]
+        if (a["grid"] != b["grid"] or a["generations"] != b["generations"]
+                or a["exit_reason"] != b["exit_reason"]):
+            print(f"megabatch-smoke: resident result for {jid} diverges "
+                  f"from the classic lane")
+            ok = False
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    if ok:
+        print(f"megabatch-smoke: OK — {args.jobs} jobs exactly-once across "
+              f"SIGKILL mid-ring + replay; resident byte-identical to "
+              f"classic depth-1")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
