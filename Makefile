@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -20,6 +20,15 @@ test:
 
 bench:
 	python3 bench.py
+
+# Regression gate over two BENCH_*.json artifacts of the same suite
+# (tools/bench_diff.py): nonzero exit when the headline metric moved in the
+# bad direction beyond TOLERANCE (default 10%), so it is CI-able.
+#   make bench-diff OLD=BENCH_r08.json NEW=/tmp/BENCH_r08.json [TOLERANCE=0.1]
+bench-diff:
+	@test -n "$(OLD)" && test -n "$(NEW)" || \
+		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1]"; exit 2; }
+	python3 tools/bench_diff.py $(OLD) $(NEW) $(if $(TOLERANCE),--tolerance $(TOLERANCE))
 
 # Serving restart-safety smoke (tools/serve_smoke.py): boots `gol serve` on a
 # free port, submits 50 jobs across 2 bucket shapes, SIGKILLs it mid-batch,
@@ -55,6 +64,13 @@ megabatch-smoke:
 # exactly once.
 pipeline-smoke:
 	python3 tools/pipeline_smoke.py
+
+# SLO smoke (tools/slo_smoke.py): an injected slow bucket trips the
+# multi-window burn-rate alert; observe-only logs and keeps accepting,
+# --slo-shed answers 429 + Retry-After, a SIGUSR1 flight dump carries the
+# SLO state provider, and a completed job's timeline decomposes exactly.
+slo-smoke:
+	python3 tools/slo_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

@@ -760,6 +760,10 @@ def _serve(args) -> int:
         raise ValueError(f"--flush-age must be >= 0, got {args.flush_age}")
     if args.warm_plans:
         _warm_plans()
+    if args.slo_latency_p99 <= 0:
+        raise ValueError(
+            f"--slo-latency-p99 must be > 0, got {args.slo_latency_p99}"
+        )
     server = GolServer(
         host=args.host,
         port=args.port,
@@ -770,6 +774,9 @@ def _serve(args) -> int:
         max_inflight=args.max_inflight,
         pipeline_depth=args.pipeline_depth,
         resident_ring=args.resident_ring,
+        slo_shed=args.slo_shed,
+        slo_latency_target=args.slo_latency_p99,
+        sample_interval=args.sample_interval,
     )
     stop = {"signaled": False}
 
@@ -931,6 +938,11 @@ def _tune(args) -> int:
             {"height": height, "width": width, "convention": convention}
             for convention in conventions
         ]
+        if result.marginal:
+            # The winner's marginal kernel rate rides with the plan: the
+            # serving dispatch-gap monitor reads it back as its roofline
+            # (select.marginal_rates).
+            plan_dict["marginal"] = result.marginal
         store.put(
             select.serve_fingerprint(), plan_dict,
             measured={"tuned_vs_default": round(result.speedup, 4)},
@@ -1081,8 +1093,29 @@ def _submit(args) -> int:
             )
             text_grid.write_grid(out_path, grid)
             print(f"{path}\tGenerations:\t{result['generations']}\t"
-                  f"{result['exit_reason']}\t-> {out_path}")
+                  f"{result['exit_reason']}\t-> {out_path}"
+                  f"{_submit_latency_note(base, job_id)}")
     return rc
+
+
+def _submit_latency_note(base: str, job_id: str) -> str:
+    """Where the client's time went, from the job's timeline (the server's
+    per-job milestone decomposition) — appended to the per-board result
+    line so the answer arrives without anyone curling a debug endpoint.
+    Empty when the server predates timelines or the fetch fails: the
+    result line must never fail because the ops surface did."""
+    import urllib.error
+
+    try:
+        status, tl = _http_json("GET", f"{base}/jobs/{job_id}/timeline",
+                                timeout=5)
+    except (urllib.error.URLError, ConnectionError, OSError):
+        return ""
+    if status != 200 or tl.get("total_seconds") is None:
+        return ""
+    queue_ms = (tl.get("segments") or {}).get("queue_wait", 0.0) * 1e3
+    return (f"\tqueue {queue_ms:.1f} ms"
+            f"\ttotal {tl['total_seconds'] * 1e3:.1f} ms")
 
 
 def _batch(args) -> int:
@@ -1156,6 +1189,81 @@ def _batch(args) -> int:
         f"{exec_s * 1000:.2f} msecs",
         file=sys.stderr,
     )
+    return 0
+
+
+def _fetch_json(url: str, timeout: float = 5.0):
+    """GET url -> payload dict via the one stdlib client (``_http_json``),
+    or {} on any connection/HTTP trouble — the ops surfaces below must
+    outlive a flapping server; that is their point."""
+    import urllib.error
+
+    try:
+        status, payload = _http_json("GET", url, timeout=timeout)
+    except (urllib.error.URLError, ConnectionError, OSError, ValueError):
+        return {}
+    return payload if status == 200 and isinstance(payload, dict) else {}
+
+
+def _top(args) -> int:
+    """``gol top``: live terminal dashboard over /metrics + /slo.
+
+    Polls the two JSON endpoints every --interval seconds and redraws one
+    ANSI frame in place (gol_tpu/obs/top.py renders; this loop only owns
+    HTTP and the terminal). --iterations N exits after N frames (0 = run
+    until interrupted) — the scriptable/test lane."""
+    from gol_tpu.obs import top as obs_top
+
+    base = args.server.rstrip("/")
+    if args.interval <= 0:
+        raise ValueError(f"--interval must be > 0, got {args.interval}")
+    ansi = sys.stdout.isatty() and not args.no_ansi
+    frames = 0
+    try:
+        while True:
+            metrics = _fetch_json(f"{base}/metrics?format=json")
+            slo = _fetch_json(f"{base}/slo")
+            frame = obs_top.render_frame(
+                metrics, slo or None, ansi=ansi,
+                title=f"gol top — {base}",
+            )
+            if ansi:
+                sys.stdout.write(obs_top.CLEAR)
+            sys.stdout.write(frame)
+            sys.stdout.flush()
+            frames += 1
+            if args.iterations and frames >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _slo_report(args) -> int:
+    """``gol slo-report``: summarize SLO state from a live server or a
+    flight-recorder dump (the ``slo`` state record a crash leaves behind)."""
+    from gol_tpu.obs import recorder, slo as obs_slo
+
+    target = args.target
+    if target.startswith(("http://", "https://")):
+        status = _fetch_json(f"{target.rstrip('/')}/slo", timeout=10)
+        if not status:
+            raise ValueError(f"no SLO status from {target} (is the server "
+                             "up, and does it have /slo?)")
+        sys.stdout.write(obs_slo.render_status(status))
+        return 0
+    # A flight dump: find the slo state record.
+    state = None
+    for rec in recorder.read_dump(target):
+        if rec.get("record") == "state" and rec.get("name") == obs_slo.STATE_PROVIDER:
+            state = {k: v for k, v in rec.items()
+                     if k not in ("record", "name")}
+    if state is None:
+        raise ValueError(
+            f"{target} holds no SLO state record (was the dumping process "
+            "a server? pre-SLO dumps have none)"
+        )
+    sys.stdout.write(obs_slo.render_status(state))
     return 0
 
 
@@ -1421,6 +1529,24 @@ def build_parser() -> argparse.ArgumentParser:
         "shutdown; GET /debug/trace snapshots them live; crashes dump "
         "flight-*.jsonl; SIGUSR1 dumps without stopping the server",
     )
+    srv.add_argument(
+        "--slo-shed", action="store_true",
+        help="shed load when an SLO burn is critical: POST /jobs answers "
+        "429 + Retry-After until the burn clears. Default is observe-only "
+        "(burns log and export at GET /slo; admission is untouched)",
+    )
+    srv.add_argument(
+        "--slo-latency-p99", type=float, default=60.0, metavar="S",
+        help="the per-priority-class p99 end-to-end latency objective in "
+        "seconds (default 60); error-rate (1%%) and queue-saturation (80%%) "
+        "objectives are built in — see gol_tpu/obs/slo.py",
+    )
+    srv.add_argument(
+        "--sample-interval", type=float, default=1.0, metavar="S",
+        help="seconds between SLO/dispatch-gap sampler ticks (the "
+        "gol-serve-sampler thread); <= 0 disables the background sampler "
+        "(GET /slo then evaluates on demand)",
+    )
     srv.set_defaults(func=_serve)
 
     tun = sub.add_parser(
@@ -1492,6 +1618,37 @@ def build_parser() -> argparse.ArgumentParser:
     rpt.add_argument("trace_file", help="trace-*.json or flight-*.jsonl")
     rpt.set_defaults(func=_trace_report)
 
+    topp = sub.add_parser(
+        "top",
+        help="live terminal dashboard over a running gol serve: queue "
+        "depths, ring occupancy, latency percentiles, SLO burn rates, and "
+        "the live dispatch-gap ratio",
+    )
+    topp.add_argument("--server", default="http://127.0.0.1:8000")
+    topp.add_argument("--interval", type=float, default=2.0, metavar="S",
+                      help="seconds between refreshes (default 2)")
+    topp.add_argument(
+        "--iterations", type=int, default=0, metavar="N",
+        help="exit after N frames (default 0 = run until interrupted)",
+    )
+    topp.add_argument(
+        "--no-ansi", action="store_true",
+        help="plain frames, no screen clearing/colors (also automatic when "
+        "stdout is not a terminal)",
+    )
+    topp.set_defaults(func=_top)
+
+    slr = sub.add_parser(
+        "slo-report",
+        help="summarize SLO state from a running server's /slo endpoint or "
+        "from a flight-recorder dump's slo state record",
+    )
+    slr.add_argument(
+        "target",
+        help="server URL (http://...) or a flight-*.jsonl dump path",
+    )
+    slr.set_defaults(func=_slo_report)
+
     sbm = sub.add_parser(
         "submit", help="submit jobs to a running gol serve and fetch results"
     )
@@ -1547,7 +1704,7 @@ def main(argv: list[str] | None = None) -> int:
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in (
         "run", "generate", "show", "serve", "submit", "batch", "tune",
-        "trace-report", "-h", "--help"
+        "trace-report", "top", "slo-report", "-h", "--help"
     ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)

@@ -16,12 +16,25 @@ tune, and the CLI:
                      fault-injection trigger, and SIGUSR1;
 - ``obs.profiler`` — device-fenced phase timing + guarded jax.profiler
                      capture (the one implementation behind ``--profile``);
-- ``obs.report``   — ``gol trace-report`` rendering.
+- ``obs.report``   — ``gol trace-report`` rendering;
+- ``obs.timeline`` — the per-job milestone/segment vocabulary behind
+                     ``GET /jobs/<id>/timeline``;
+- ``obs.slo``      — declarative service-level objectives evaluated over
+                     rolling registry windows (``GET /slo``, burn-rate
+                     alerts, optional admission shedding);
+- ``obs.sampler``  — the serve-side background sampler: SLO evaluation
+                     ticks plus the continuous dispatch-gap monitor;
+- ``obs.top``      — ``gol top`` terminal dashboard rendering.
 
 Stdlib-only at import time (jax loads lazily inside ``profiler.capture``),
 so arming observability never reorders backend initialization.
 """
 
-from gol_tpu.obs import profiler, recorder, registry, report, trace  # noqa: F401
+from gol_tpu.obs import (  # noqa: F401
+    profiler, recorder, registry, report, sampler, slo, timeline, top, trace,
+)
 
-__all__ = ["profiler", "recorder", "registry", "report", "trace"]
+__all__ = [
+    "profiler", "recorder", "registry", "report", "sampler", "slo",
+    "timeline", "top", "trace",
+]

@@ -33,6 +33,7 @@ retry attempts here at module load.
 from __future__ import annotations
 
 import collections
+import re
 import threading
 
 # Quantiles exported for every histogram (the serving contract).
@@ -45,7 +46,14 @@ def quantile(samples, q: float) -> float | None:
     """Nearest-rank quantile over ``samples`` (round-based, the serving
     histograms' rule since PR 2 — moved here verbatim so /metrics output is
     byte-stable). Returns None on an empty sample set."""
-    ordered = sorted(samples)
+    return _quantile_sorted(sorted(samples), q)
+
+
+def _quantile_sorted(ordered, q: float) -> float | None:
+    """``quantile`` over an ALREADY-sorted list — the shared rank rule,
+    split out so ``Histogram.summary`` pays one sort for all three
+    quantiles (it runs on every registry snapshot, which the SLO sampler
+    takes once per tick)."""
     if not ordered:
         return None
     idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
@@ -81,9 +89,10 @@ class Histogram:
         return quantile(self.samples, q)
 
     def summary(self) -> dict:
+        ordered = sorted(self.samples)  # one sort serves all three ranks
         out = {"count": self.count, "sum": self.total}
         for q in QUANTILES:
-            out[f"p{int(q * 100)}"] = self.quantile(q)
+            out[f"p{int(q * 100)}"] = _quantile_sorted(ordered, q)
         return out
 
 
@@ -142,6 +151,16 @@ class Registry:
             lines.append(f"{p}_{name}_sum {_fmt(summary['sum'])}")
             lines.append(f"{p}_{name}_count {_fmt(summary['count'])}")
         return "\n".join(lines) + "\n"
+
+
+def metric_label(label: str) -> str:
+    """Sanitize a free-form label (a bucket name like ``256x256/c/packed``)
+    into a Prometheus-legal metric-name suffix. The registry has no label
+    dimension on purpose (a counter is one dict slot); per-bucket series
+    mangle the bucket into the name instead, through this ONE rule so the
+    writer (scheduler) and the readers (sampler, tune marginal records)
+    can never disagree on the spelling."""
+    return re.sub(r"[^A-Za-z0-9]+", "_", label).strip("_")
 
 
 def _fmt(v: float) -> str:

@@ -54,9 +54,16 @@ def load_spans(path: str) -> tuple[list[dict], dict]:
             for e in doc["traceEvents"]
             if e.get("ph") == "X"
         ]
-        return spans, dict(doc.get("otherData") or {})
+        meta = dict(doc.get("otherData") or {})
+        flows = _flow_counts(
+            e.get("ph") for e in doc["traceEvents"]
+            if e.get("ph") in ("s", "t", "f")
+        )
+        if flows:
+            meta["flows"] = flows
+        return spans, meta
     # Flight-recorder JSONL.
-    spans, meta = [], {}
+    spans, meta, flow_phases = [], {}, []
     for line in raw.split(b"\n"):
         if not line:
             continue
@@ -66,8 +73,17 @@ def load_spans(path: str) -> tuple[list[dict], dict]:
             continue
         kind = rec.get("record")
         if kind == "header":
+            flows = meta.get("flows")
             meta = {k: v for k, v in rec.items() if k != "record"}
+            if flows:
+                meta["flows"] = flows
         elif kind == "span":
+            phase = (rec.get("attrs") or {}).get("flow_phase")
+            if phase in ("s", "t", "f"):
+                # Flow points ride the span ring but are arrows, not
+                # durations — count them instead of polluting the tables.
+                flow_phases.append(phase)
+                continue
             spans.append({
                 "name": rec.get("name", "?"),
                 "start_us": float(rec.get("start_s", 0.0)) * 1e6,
@@ -85,8 +101,18 @@ def load_spans(path: str) -> tuple[list[dict], dict]:
             meta.setdefault("state", {})[rec.get("name", "?")] = {
                 k: v for k, v in rec.items() if k not in ("record", "name")
             }
+    flows = _flow_counts(flow_phases)
+    if flows:
+        meta["flows"] = flows
     spans.sort(key=lambda s: s["start_us"])
     return spans, meta
+
+
+def _flow_counts(phases) -> dict:
+    counts = {"s": 0, "t": 0, "f": 0}
+    for p in phases:
+        counts[p] += 1
+    return {k: v for k, v in counts.items() if v}
 
 
 def _fmt_ms(us: float) -> str:
@@ -184,6 +210,16 @@ def render(path: str) -> str:
             lines.append(
                 f"state[{name}]: "
                 + ", ".join(f"{k}={v}" for k, v in sorted(state.items()))
+            )
+            lines.append("")
+        flows = meta.get("flows")
+        if flows:
+            # Job-lifecycle flow arrows (obs.trace.flow): how many jobs the
+            # trace saw start / step / finish.
+            lines.append(
+                "job flows: "
+                f"{flows.get('s', 0)} started, {flows.get('t', 0)} step(s), "
+                f"{flows.get('f', 0)} finished"
             )
             lines.append("")
     if not spans:

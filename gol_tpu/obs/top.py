@@ -1,0 +1,157 @@
+"""``gol top``: a live ANSI terminal dashboard over /metrics + /slo.
+
+One screen, refreshed in place, answering the operator's standing questions
+without curl loops: is the queue backing up, are the rings full, where are
+the latency percentiles, is any SLO burning, and how close to the tuned
+roofline is the service running (the live BENCH_r08 dispatch-gap ratio).
+
+Pure rendering here — ``render_frame`` maps the two JSON payloads (the
+``/metrics?format=json`` snapshot, whose ``process`` section carries the
+process-global registry, and the ``/slo`` status) to one string; the CLI
+owns polling and the terminal. Keeping it pure keeps it testable and keeps
+this package free of HTTP concerns.
+"""
+
+from __future__ import annotations
+
+CLEAR = "\x1b[2J\x1b[H"  # clear screen + cursor home
+_RESET = "\x1b[0m"
+_COLORS = {"ok": "\x1b[32m", "warning": "\x1b[33m", "critical": "\x1b[31m"}
+
+
+def _color(status: str, text: str, ansi: bool) -> str:
+    if not ansi:
+        return text
+    return _COLORS.get(status, "") + text + _RESET
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v != 0 and (abs(v) >= 1e5 or abs(v) < 1e-3):
+            return f"{v:.3e}"
+        return f"{v:.3f}".rstrip("0").rstrip(".") or "0"
+    return str(v)
+
+
+def _bar(frac: float, width: int = 20) -> str:
+    frac = max(0.0, min(1.0, frac))
+    filled = round(frac * width)
+    return "[" + "#" * filled + "." * (width - filled) + "]"
+
+
+def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
+                 title: str = "gol top") -> str:
+    """One dashboard frame from the two polled payloads (either may be an
+    empty dict when its endpoint was unreachable — the frame says so
+    instead of dying, because `gol top` outliving a crashing server is the
+    point of a dashboard)."""
+    counters = metrics.get("counters") or {}
+    gauges = metrics.get("gauges") or {}
+    hists = metrics.get("histograms") or {}
+    process = metrics.get("process") or {}
+    pgauges = process.get("gauges") or {}
+    phists = process.get("histograms") or {}
+
+    overall = (slo or {}).get("status", "?")
+    lines = [
+        f"{title} — SLO {_color(overall, overall.upper(), ansi)}"
+        + ("" if metrics else "   [/metrics unreachable]")
+        + ("" if slo else "   [/slo unreachable]"),
+        "",
+    ]
+
+    # -- queue / flow -------------------------------------------------------
+    lines.append("queue")
+    depth = gauges.get("queue_depth", 0)
+    lines.append(
+        f"  depth {int(depth):>6}   inflight {int(gauges.get('inflight_batches', 0)):>3}"
+        f"   journal-q {int(gauges.get('journal_queue_depth', 0)):>3}"
+        f"   boards/s {_fmt(gauges.get('boards_per_sec'))}"
+    )
+    lines.append(
+        f"  jobs: accepted {int(counters.get('jobs_accepted_total', 0))}"
+        f"  done {int(counters.get('jobs_completed_total', 0))}"
+        f"  failed {int(counters.get('jobs_failed_total', 0))}"
+        f"  rejected {int(counters.get('jobs_rejected_total', 0))}"
+        f"  shed {int(counters.get('jobs_shed_total', 0))}"
+        f"  batches {int(counters.get('batches_total', 0))}"
+    )
+
+    # -- rings / dispatch gap ----------------------------------------------
+    ring_occ = pgauges.get("ring_slot_occupancy")
+    gap = gauges.get("dispatch_gap_ratio")
+    if ring_occ is not None or gap is not None:
+        lines.append("")
+        lines.append("device")
+        if ring_occ is not None:
+            lines.append(f"  ring occupancy {_bar(ring_occ)} {_fmt(ring_occ)}")
+        if gap is not None:
+            lines.append(
+                f"  dispatch gap   {_bar(gap)} {_fmt(gap)} of tuned roofline"
+                f"   ({_fmt(gauges.get('serve_cell_updates_per_sec'))} cells/s)"
+            )
+        gap_hist = phists.get("dispatch_gap_seconds")
+        if gap_hist:
+            lines.append(
+                f"  device idle between drains: p50 {_fmt(gap_hist.get('p50'))}s"
+                f"  p99 {_fmt(gap_hist.get('p99'))}s"
+                f"  (n={gap_hist.get('count')})"
+            )
+
+    # -- latency percentiles ------------------------------------------------
+    rows = [
+        (name, hists[name]) for name in (
+            "queue_latency_seconds", "run_latency_seconds",
+            "job_latency_seconds", "job_latency_seconds_high",
+            "job_latency_seconds_normal", "job_latency_seconds_low",
+        ) if name in hists
+    ]
+    if rows:
+        lines.append("")
+        lines.append(f"  {'latency (s)':<28} {'p50':>10} {'p95':>10} "
+                     f"{'p99':>10} {'count':>8}")
+        for name, h in rows:
+            lines.append(
+                f"  {name:<28} {_fmt(h.get('p50')):>10} "
+                f"{_fmt(h.get('p95')):>10} {_fmt(h.get('p99')):>10} "
+                f"{h.get('count', 0):>8}"
+            )
+
+    # -- SLO burn rates -----------------------------------------------------
+    objectives = (slo or {}).get("objectives") or []
+    if objectives:
+        windows = [f"{w}s" for w in (slo.get("windows_s") or [])]
+        lines.append("")
+        header = f"  {'objective':<24} {'status':>9}"
+        for w in windows:
+            header += f" {'burn@' + w:>11}"
+        lines.append(header)
+        for r in objectives:
+            row = f"  {r['name']:<24} " + _color(
+                r["status"], f"{r['status']:>9}", ansi
+            )
+            for w in windows:
+                win = (r.get("windows") or {}).get(w) or {}
+                row += f" {win.get('burn', 0.0):>11.3f}"
+            lines.append(row)
+
+    # -- per-bucket achieved rates -----------------------------------------
+    buckets = sorted(
+        (name[len("bucket_cell_updates_per_sec_"):], value)
+        for name, value in gauges.items()
+        if name.startswith("bucket_cell_updates_per_sec_")
+    )
+    if buckets:
+        lines.append("")
+        lines.append("  bucket throughput (cell-updates/s)")
+        for bucket, rate in buckets:
+            ratio = gauges.get(f"dispatch_gap_ratio_{bucket}")
+            extra = f"   gap {_fmt(ratio)}" if ratio is not None else ""
+            lines.append(f"    {bucket:<28} {_fmt(rate):>12}{extra}")
+
+    return "\n".join(lines) + "\n"
+
+
+__all__ = ["CLEAR", "render_frame"]

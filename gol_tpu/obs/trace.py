@@ -173,6 +173,19 @@ class Tracer:
                 self._dropped += 1
             self._ring.append(span)
 
+    def flow(self, name: str, flow_id, phase: str, **attrs) -> None:
+        """A Chrome *flow* point: ``phase`` is ``"s"`` (start), ``"t"``
+        (step), or ``"f"`` (finish). Flows with one id draw an arrow chain
+        across threads in Perfetto — the serving layer uses them to tie a
+        job's lifecycle (submit -> claim -> finish) to the ``serve.batch`` /
+        ``serve.resident_loop`` spans it rode through. Dropped (no
+        allocation past the enabled check) while tracing is disabled."""
+        if not self.enabled:
+            return
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        self.event(name, flow_phase=phase, flow_id=str(flow_id), **attrs)
+
     def _record(self, span: Span) -> None:
         self._local.depth = max(0, getattr(self._local, "depth", 1) - 1)
         with self._lock:
@@ -203,7 +216,9 @@ class Tracer:
         }
 
     def chrome_events(self) -> list[dict]:
-        """The ring as Chrome trace events (``ph:"X"`` complete events).
+        """The ring as Chrome trace events: ``ph:"X"`` complete events for
+        spans, plus ``ph:"s"/"t"/"f"`` flow events for ``flow()`` points
+        (the arrow chains tying job lifecycles to batch spans in Perfetto).
 
         Timestamps are microseconds since the process anchor — relative, as
         the trace-event format allows; the absolute anchor rides in the
@@ -215,14 +230,35 @@ class Tracer:
         pid = os.getpid()
         events = []
         for s in self.snapshot():
+            attrs = dict(s["attrs"] or {})
+            phase = attrs.pop("flow_phase", None)
+            ts = (s["start_s"] - self.anchor_perf) * 1e6
+            if phase in ("s", "t", "f"):
+                ev = {
+                    "name": s["name"],
+                    "cat": "flow",
+                    "ph": phase,
+                    "id": attrs.pop("flow_id", "0"),
+                    "ts": ts,
+                    "pid": pid,
+                    "tid": s["tid"],
+                }
+                if phase == "f":
+                    # Bind the finish to the enclosing slice, the Chrome
+                    # trace format's rule for flows that END inside a span.
+                    ev["bp"] = "e"
+                if attrs:
+                    ev["args"] = attrs
+                events.append(ev)
+                continue
             events.append({
                 "name": s["name"],
                 "ph": "X",
-                "ts": (s["start_s"] - self.anchor_perf) * 1e6,
+                "ts": ts,
                 "dur": s["duration_s"] * 1e6,
                 "pid": pid,
                 "tid": s["tid"],
-                "args": dict(s["attrs"] or {}, depth=s["depth"]),
+                "args": dict(attrs, depth=s["depth"]),
             })
         events.sort(key=lambda e: e["ts"])
         return events
@@ -276,6 +312,13 @@ def span(name: str, **attrs):
 
 def event(name: str, **attrs) -> None:
     _TRACER.event(name, **attrs)
+
+
+def flow(name: str, flow_id, phase: str, **attrs) -> None:
+    """Record a flow point (``phase`` in s/t/f); no-op while disabled."""
+    if not _TRACER.enabled:
+        return
+    _TRACER.flow(name, flow_id, phase, **attrs)
 
 
 def snapshot() -> list[dict]:

@@ -99,6 +99,11 @@ class Job:
     finished_at: float | None = None
     result: JobResult | None = None
     error: str | None = None
+    # Per-job milestone stamps (obs/timeline.py vocabulary): perf_counter
+    # values keyed by milestone name, stamped by the scheduler identically
+    # across the classic/pipelined/resident lanes. Process-local like the
+    # *_at fields above — never journaled; replayed jobs restart empty.
+    timeline: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         # Normalize numeric fields FIRST: jobs arrive from untrusted JSON,
@@ -201,6 +206,17 @@ class Job:
             deadline_s=rec.get("deadline_s"),
             accepted_at=time.perf_counter(),
         )
+
+
+def priority_class(priority: int) -> str:
+    """The SLO bucketing of a job priority: objectives are declared per
+    *class* (high > 0, normal == 0, low < 0), not per raw integer — a fleet
+    cannot carry one latency histogram per arbitrary client-chosen int."""
+    if priority > 0:
+        return "high"
+    if priority < 0:
+        return "low"
+    return "normal"
 
 
 def new_job(width: int, height: int, board, **kwargs) -> Job:

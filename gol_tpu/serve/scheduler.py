@@ -56,12 +56,13 @@ import time
 from typing import Any
 
 from gol_tpu.obs import trace as obs_trace
+from gol_tpu.obs.registry import metric_label
 from gol_tpu.resilience.retry import RetryPolicy, is_transient_io
 from gol_tpu.serve import batcher
 from gol_tpu.serve.batcher import BucketKey, bucket_for, pad_batch
 from gol_tpu.serve.jobs import (
     CANCELLED, DONE, FAILED, QUEUED, RUNNING, SCHEDULED,
-    Job, JobJournal,
+    Job, JobJournal, priority_class,
 )
 from gol_tpu.serve.metrics import Metrics
 
@@ -327,12 +328,16 @@ class Scheduler:
             if record and self.journal is not None:
                 self.journal.record_submit(job)
             job.accepted_at = self._clock()
+            job.timeline["accepted"] = job.accepted_at
             self._jobs[job.id] = job
             self._buckets.setdefault(key, []).append(job)
             self._queued += 1
             self.metrics.inc("jobs_accepted_total")
             self.metrics.set_gauge("queue_depth", self._queued)
             self._cv.notify_all()
+        # Flow START: with tracing on, the job's lifecycle becomes a Perfetto
+        # arrow chain from here to its finish inside a batch span.
+        obs_trace.flow("job", job.id, "s", bucket=key.label())
         return job
 
     def resubmit_replayed(self, replayed: list[Job]) -> int:
@@ -442,13 +447,22 @@ class Scheduler:
                     self.metrics.set_gauge("inflight_batches", self._inflight)
                     self._cv.notify_all()
 
+    @staticmethod
+    def _stamp(batch: list[Job], milestone: str, t: float) -> None:
+        """Stamp one timeline milestone on every job of a batch (the splits
+        run at batch granularity, so batchmates share each stamp)."""
+        for job in batch:
+            job.timeline[milestone] = t
+
     def _begin_batch(self, batch: list[Job], started: float) -> None:
         for job in batch:
             job.started_at = started
+            job.timeline["claimed"] = started
             job.transition(RUNNING)
             self.metrics.observe(
                 "queue_latency_seconds", started - job.accepted_at
             )
+            obs_trace.flow("job", job.id, "t", state="claimed")
 
     def _on_retry(self, key: BucketKey, batch: list[Job]):
         def on_retry(attempt, err, delay):
@@ -470,9 +484,11 @@ class Scheduler:
         )
         for job in batch:
             job.finished_at = finished
+            job.timeline["done"] = finished
             job.error = f"{type(err).__name__}: {err}"
             job.transition(FAILED)
             self.metrics.inc("jobs_failed_total")
+            obs_trace.flow("job", job.id, "f", state="failed")
             self._journal_terminal(JobJournal.record_failed, job)
 
     def _finish_batch(self, key: BucketKey, batch: list[Job], results,
@@ -487,11 +503,29 @@ class Scheduler:
         self.metrics.observe("batch_occupancy", len(batch) / slots)
         self.metrics.observe("run_latency_seconds", elapsed)
         self.metrics.set_gauge("boards_per_sec", len(batch) / elapsed)
+        cells = 0
         for job, result in zip(batch, results):
             job.finished_at = finished
+            job.timeline["done"] = finished
             job.result = result
             job.transition(DONE)
             self.metrics.inc("jobs_completed_total")
+            # End-to-end latency per SLO priority class (obs/slo.py keys
+            # its per-priority p99 objectives on these histogram names).
+            latency = finished - job.accepted_at
+            self.metrics.observe("job_latency_seconds", latency)
+            self.metrics.observe(
+                "job_latency_seconds_" + priority_class(job.priority), latency
+            )
+            # Achieved useful work: actual board cells times the generations
+            # the board really ran (padding slots and canvas don't count).
+            cells += job.height * job.width * result.generations
+        # Fed to the dispatch-gap sampler (obs/sampler.py): achieved
+        # cell-updates per bucket vs the tuned plan's marginal kernel rate.
+        self.metrics.inc("serve_cell_updates_total", cells)
+        self.metrics.inc(
+            "serve_cell_updates_total_" + metric_label(key.label()), cells
+        )
         # One journal append + fsync for the whole batch's done records
         # (identical lines to per-job appends — replay is oblivious): the
         # per-record fsync was the last per-*job* serial host cost on the
@@ -519,10 +553,22 @@ class Scheduler:
                 return self._run_batch(key, batch)
             stage_fn, dispatch_fn, complete_fn = self._split
             if staged is None:
+                t0 = self._clock()
                 with obs_trace.span("pipeline.stage", bucket=key.label(),
                                     jobs=len(batch)):
                     staged = stage_fn(key, batch)
-            return complete_fn(dispatch_fn(staged))
+                self._stamp(batch, "stage_start", t0)
+                self._stamp(batch, "staged", self._clock())
+            inflight = dispatch_fn(staged)
+            t = self._clock()
+            self._stamp(batch, "dispatched", t)
+            # The classic worker blocks on readback immediately, so the
+            # device segment collapses to ~0 here and the compute time
+            # shows in `readback` — the pipelined lanes pull them apart.
+            self._stamp(batch, "readback_start", t)
+            results = complete_fn(inflight)
+            self._stamp(batch, "completed", self._clock())
+            return results
 
         try:
             # The batch span: what a traced `gol serve` session exports and
@@ -536,6 +582,10 @@ class Scheduler:
                     retryable=self.retryable,
                     on_retry=self._on_retry(key, batch),
                 )
+                # Flow FINISH inside the batch span, so Perfetto binds the
+                # arrow head to the enclosing serve.batch slice.
+                for job in batch:
+                    obs_trace.flow("job", job.id, "f", bucket=key.label())
         except Exception as err:  # noqa: BLE001 - every job must terminate
             self._fail_batch(key, batch, err)
             return
@@ -598,10 +648,14 @@ class Scheduler:
             return flight  # completer runs self._run_batch whole
         stage_fn, dispatch_fn, _ = self._split
         try:
+            t0 = self._clock()
             with obs_trace.span("pipeline.stage", bucket=key.label(),
                                 jobs=len(batch)):
                 flight.staged = stage_fn(key, batch)
+            self._stamp(batch, "stage_start", t0)
+            self._stamp(batch, "staged", self._clock())
             flight.inflight = dispatch_fn(flight.staged)
+            self._stamp(batch, "dispatched", self._clock())
         except Exception as err:  # noqa: BLE001 - completer owns terminality
             # Carried to the completer so ONE code path (its retry policy)
             # classifies every failure: a transient dispatch error retries
@@ -641,10 +695,19 @@ class Scheduler:
                 if flight.error is not None:
                     raise flight.error
                 if flight.inflight is not None:
-                    return complete_fn(flight.inflight)
+                    self._stamp(batch, "readback_start", self._clock())
+                    results = complete_fn(flight.inflight)
+                    self._stamp(batch, "completed", self._clock())
+                    return results
             if self._split is not None and flight.staged is not None:
                 _, dispatch_fn, _ = self._split
-                return complete_fn(dispatch_fn(flight.staged))
+                inflight = dispatch_fn(flight.staged)
+                t = self._clock()
+                self._stamp(batch, "dispatched", t)
+                self._stamp(batch, "readback_start", t)
+                results = complete_fn(inflight)
+                self._stamp(batch, "completed", self._clock())
+                return results
             return self._run_batch(key, batch)
 
         try:
@@ -655,6 +718,8 @@ class Scheduler:
                     retryable=self.retryable,
                     on_retry=self._on_retry(key, batch),
                 )
+                for job in batch:
+                    obs_trace.flow("job", job.id, "f", bucket=key.label())
         except Exception as err:  # noqa: BLE001 - every job must terminate
             self._fail_batch(key, batch, err)
             return
@@ -693,6 +758,15 @@ class Scheduler:
     def _journal_append(self, record_fn, job_or_batch) -> None:
         try:
             record_fn(self.journal, job_or_batch)
+            # The timeline's final milestone: the terminal record is durable
+            # (fsynced). Stamped here so it is correct on BOTH journal lanes
+            # — inline (classic/pipelined) and the resident writer thread,
+            # where it visibly trails `done` (journal_lag_seconds).
+            t = self._clock()
+            jobs = (job_or_batch if isinstance(job_or_batch, list)
+                    else [job_or_batch])
+            for j in jobs:
+                j.timeline["journaled"] = t
         except OSError as err:
             self.metrics.inc("journal_errors_total")
             jobs = (job_or_batch if isinstance(job_or_batch, list)

@@ -12,8 +12,17 @@ Endpoints (all JSON unless noted):
   reason; 409 while the job is not DONE, 410 for FAILED/CANCELLED.
 - ``DELETE /jobs/<id>`` — cancel a still-QUEUED job; 409 once it has been
   claimed by a batch (dispatch is not interruptible), 404 if unknown.
-- ``GET /metrics``    — Prometheus text format; ``?format=json`` for the
-  JSON snapshot.
+- ``GET /jobs/<id>/timeline`` — the job's milestone/segment decomposition
+  (obs/timeline.py): where this request's latency went, queue-wait through
+  journaled DONE. 404 unknown; restored (pre-restart) jobs report
+  ``restored`` with no timeline (milestones are process-local).
+- ``GET /metrics``    — Prometheus text format (contract byte-stable);
+  ``?format=json`` for the JSON snapshot, which additionally carries the
+  process-global registry (gauges + histogram summaries — ring occupancy,
+  dispatch-gap histogram) under ``process``, the same values
+  ``gol trace-report`` renders from a flight dump.
+- ``GET /slo``        — the SLO engine's status (obs/slo.py): overall
+  health, per-objective multi-window burn rates, shedding state.
 - ``GET /debug/trace``— observability snapshot (gol_tpu/obs): tracing
   state, the retained span ring, and the process-global registry counters
   (engine/checkpoint/retry/tuner/halo). Live and read-only — the HTTP
@@ -21,6 +30,11 @@ Endpoints (all JSON unless noted):
 - ``POST /drain``     — stop admission, flush the queue, wait for in-flight
   batches; responds when quiescent. Idempotent.
 - ``GET /healthz``    — liveness + queue stats.
+
+With ``slo_shed`` (CLI ``--slo-shed``) a critical SLO burn sheds new jobs:
+``POST /jobs`` answers 429 with a ``Retry-After`` header until the burn
+clears. The default is observe-only (test-pinned): burns log and export,
+admission is untouched.
 
 The server composes replay-on-start with PR 1's auto-resume story: started
 on a journal directory that holds unfinished jobs, it re-queues exactly
@@ -37,7 +51,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
 
 from gol_tpu.io import text_grid
-from gol_tpu.obs import registry as obs_registry, trace as obs_trace
+from gol_tpu.obs import (
+    recorder as obs_recorder,
+    registry as obs_registry,
+    sampler as obs_sampler,
+    slo as obs_slo,
+    timeline as obs_timeline,
+    trace as obs_trace,
+)
 from gol_tpu.serve.jobs import DONE, FAILED, CANCELLED, JobJournal, new_job
 from gol_tpu.serve.metrics import Metrics
 from gol_tpu.serve.scheduler import Draining, QueueFull, Scheduler
@@ -45,6 +66,22 @@ from gol_tpu.serve.scheduler import Draining, QueueFull, Scheduler
 logger = logging.getLogger(__name__)
 
 _MAX_BODY = 64 << 20  # 64 MiB: a 4096^2 text board is ~17 MB
+
+
+def _tuned_marginal_rates() -> dict[str, float]:
+    """The tuned plan's recorded marginal kernel rates for the dispatch-gap
+    monitor, degrading to {} like every other cache problem (a server with
+    no tuned marginals still serves; it just has no roofline to compare
+    against)."""
+    try:
+        from gol_tpu.tune import select
+
+        return select.marginal_rates()
+    except Exception:  # noqa: BLE001 - cache trouble must not block boot
+        logger.warning("could not load tuned marginal rates; the "
+                       "dispatch-gap monitor will report rates only",
+                       exc_info=True)
+        return {}
 
 
 class GolServer:
@@ -57,6 +94,10 @@ class GolServer:
         journal_dir: str | None = None,
         scheduler: Scheduler | None = None,
         metrics: Metrics | None = None,
+        slo: obs_slo.SloEngine | None = None,
+        slo_shed: bool = False,
+        slo_latency_target: float = 60.0,
+        sample_interval: float = 1.0,
         **scheduler_kwargs,
     ):
         self.metrics = metrics or Metrics()
@@ -64,6 +105,27 @@ class GolServer:
         self.scheduler = scheduler or Scheduler(
             journal=journal, metrics=self.metrics, **scheduler_kwargs
         )
+        # The SLO engine evaluates the scheduler's own metrics registry;
+        # observe-only unless slo_shed (the pinned default). An injected
+        # engine keeps its own objectives/thresholds.
+        self.slo = slo or obs_slo.SloEngine(
+            obs_slo.default_objectives(
+                self.scheduler.max_queue_depth,
+                latency_target_s=slo_latency_target,
+            ),
+            registry=self.metrics,
+            shed=slo_shed,
+        )
+        # One background thread ticks the SLO evaluation AND the dispatch-
+        # gap monitor; sample_interval <= 0 disables the thread (tests call
+        # sampler.tick() themselves).
+        self.sampler = obs_sampler.ServeSampler(
+            self.metrics,
+            slo=self.slo,
+            interval=sample_interval if sample_interval > 0 else 1.0,
+            marginal_rates=_tuned_marginal_rates(),
+        )
+        self._sample_interval = sample_interval
         self.replayed = 0
         self._replay_results = {}
         self._replay_failed = {}
@@ -88,8 +150,16 @@ class GolServer:
         host, port = self.address
         return f"http://{host}:{port}"
 
-    def start(self) -> None:
+    def _boot(self) -> None:
         self.scheduler.start()
+        # The SLO state rides every flight-recorder dump: a crash report
+        # answers "was the service healthy when it died" on its own.
+        obs_recorder.add_state_provider(obs_slo.STATE_PROVIDER, self.slo.state)
+        if self._sample_interval > 0:
+            self.sampler.start()
+
+    def start(self) -> None:
+        self._boot()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="gol-serve-http", daemon=True
         )
@@ -97,7 +167,7 @@ class GolServer:
         logger.info("gol serve listening on %s", self.url)
 
     def serve_forever(self) -> None:
-        self.scheduler.start()
+        self._boot()
         logger.info("gol serve listening on %s", self.url)
         self.httpd.serve_forever()
 
@@ -105,6 +175,8 @@ class GolServer:
         return self.scheduler.drain(timeout=timeout)
 
     def shutdown(self, drain: bool = True) -> None:
+        self.sampler.stop()
+        obs_recorder.remove_state_provider(obs_slo.STATE_PROVIDER)
         self.scheduler.stop(drain=drain)
         self.httpd.shutdown()
         self.httpd.server_close()
@@ -139,6 +211,32 @@ class GolServer:
         job = new_job(width, height, board, **kwargs)
         self.scheduler.submit(job)
         return {"id": job.id, "state": job.state}
+
+    def should_shed(self) -> tuple[bool, float]:
+        """Admission-path SLO check (observe-only engines always pass)."""
+        shed, retry_after = self.slo.should_shed()
+        if shed:
+            self.metrics.inc("jobs_shed_total")
+        return shed, retry_after
+
+    def timeline_json(self, job_id: str) -> dict | None:
+        """GET /jobs/<id>/timeline payload, or None for an unknown id."""
+        job = self.scheduler.job(job_id)
+        if job is None:
+            if (job_id in self._replay_results
+                    or job_id in self._replay_failed
+                    or job_id in self._replay_cancelled):
+                # The job predates this process; its perf_counter milestones
+                # died with the process that ran it.
+                return {"id": job_id, "restored": True,
+                        "milestones": {}, "segments": {}}
+            return None
+        # dict() snapshot: worker/journal threads stamp concurrently.
+        return {
+            "id": job.id,
+            "state": job.state,
+            **obs_timeline.summary(dict(job.timeline)),
+        }
 
     def job_json(self, job_id: str) -> dict | None:
         job = self.scheduler.job(job_id)
@@ -202,7 +300,8 @@ def _make_handler(server: GolServer):
         def log_message(self, format, *args):  # noqa: A002 - stdlib signature
             logger.debug("%s - %s", self.address_string(), format % args)
 
-        def _reply(self, code: int, payload, content_type="application/json"):
+        def _reply(self, code: int, payload, content_type="application/json",
+                   headers=None):
             body = (
                 json.dumps(payload).encode("utf-8")
                 if content_type == "application/json"
@@ -211,6 +310,8 @@ def _make_handler(server: GolServer):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             if code >= 400:
                 # Error paths may not have consumed the request body (e.g.
                 # an over-MAX_BODY reject); closing is the safe way to keep
@@ -245,6 +346,19 @@ def _make_handler(server: GolServer):
             path = urlparse(self.path).path
             try:
                 if path == "/jobs":
+                    # SLO-driven shedding (only ever with --slo-shed): a
+                    # critical burn answers 429 + Retry-After BEFORE the
+                    # body is read — load shedding that first parses a 17MB
+                    # board sheds nothing.
+                    shed, retry_after = server.should_shed()
+                    if shed:
+                        self._reply(
+                            429,
+                            {"error": "shedding load: SLO burn is critical",
+                             "retry_after_s": retry_after},
+                            headers={"Retry-After": str(int(retry_after))},
+                        )
+                        return
                     try:
                         out = server.submit_json(self._read_body())
                     except (QueueFull, Draining) as e:
@@ -290,7 +404,11 @@ def _make_handler(server: GolServer):
             parsed = urlparse(self.path)
             path = parsed.path
             if path.startswith("/jobs/"):
-                out = server.job_json(path[len("/jobs/"):])
+                rest = path[len("/jobs/"):]
+                if rest.endswith("/timeline"):
+                    out = server.timeline_json(rest[: -len("/timeline")])
+                else:
+                    out = server.job_json(rest)
                 if out is None:
                     self._reply(404, {"error": "unknown job"})
                 else:
@@ -301,12 +419,23 @@ def _make_handler(server: GolServer):
             elif path == "/metrics":
                 fmt = parse_qs(parsed.query).get("format", ["prometheus"])[0]
                 if fmt == "json":
-                    self._reply(200, server.metrics.snapshot())
+                    # Parity with what `gol trace-report` renders from a
+                    # flight dump: the serving snapshot PLUS the process-
+                    # global registry's gauges and histogram summaries
+                    # (ring occupancy, dispatch-gap distribution, engine
+                    # counters) under "process". The Prometheus text
+                    # contract below stays byte-stable — serving series
+                    # only, test-pinned.
+                    snap = server.metrics.snapshot()
+                    snap["process"] = obs_registry.default().snapshot()
+                    self._reply(200, snap)
                 else:
                     self._reply(
                         200, server.metrics.prometheus(),
                         content_type="text/plain; version=0.0.4",
                     )
+            elif path == "/slo":
+                self._reply(200, server.slo.status())
             elif path == "/debug/trace":
                 tracer = obs_trace.tracer()
                 self._reply(200, {

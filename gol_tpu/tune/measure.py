@@ -106,6 +106,10 @@ class SearchResult:
     trials: list[Trial]
     default_label: str
     winner: object  # the winning plan (EnginePlan | ServePlan)
+    # Serve searches only: the winner geometry's marginal kernel rate per
+    # bucket (sanitized label -> cell-updates/s), the roofline the live
+    # dispatch-gap monitor (obs/sampler.py) compares achieved rates against.
+    marginal: dict | None = None
 
     @property
     def winner_trial(self) -> Trial:
@@ -123,7 +127,7 @@ class SearchResult:
         return self.default_trial.median_s / self.winner_trial.median_s
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "kind": self.kind,
             "context": self.context,
             "default": self.default_label,
@@ -133,6 +137,9 @@ class SearchResult:
             "gates_all_ok": all(t.gate == "ok" for t in self.trials),
             "trials": [t.to_dict() for t in self.trials],
         }
+        if self.marginal:
+            out["marginal_kernel_cells_per_sec"] = self.marginal
+        return out
 
 
 def _pick_winner(trials: list[Trial], default_label: str):
@@ -298,6 +305,70 @@ def run_engine_search(
 _SERVE_COUNTS = (1, 3, 5, 8, 13, 21)
 
 
+def measure_marginal_rate(
+    board_height: int,
+    board_width: int,
+    convention: str,
+    plan,
+    *,
+    gen_limit: int = 8,
+    batch: int = 8,
+    seed: int = 7,
+    repeats: int = 3,
+) -> dict[str, float]:
+    """The winner geometry's **marginal kernel rate**: cell-updates/s of the
+    compiled batch program with every fixed cost differenced out (timed at
+    G and 3G generation limits, rate from the difference — the BENCH_r08
+    protocol, run at tune time). Returned as {sanitized bucket label:
+    rate} so the serve-side dispatch-gap monitor (obs/sampler.py) can match
+    it against the live ``serve_cell_updates_total_<bucket>`` counters —
+    both sides spell the bucket through ``obs.registry.metric_label``."""
+    from gol_tpu import engine
+    from gol_tpu.obs.registry import metric_label
+    from gol_tpu.serve import batcher
+    from gol_tpu.serve.batcher import BucketKey
+
+    ph = batcher.pad_dim(board_height, plan=plan)
+    pw = batcher.pad_dim(board_width, plan=plan)
+    total = batcher.pad_batch(
+        min(batch, plan.batch_ladder[-1]), plan=plan
+    )
+    rng = np.random.default_rng(seed)
+    chunk = [
+        rng.integers(0, 2, size=(board_height, board_width), dtype=np.uint8)
+        for _ in range(min(batch, total))
+    ]
+    config_for = lambda g: GameConfig(gen_limit=g, convention=convention)
+    g1, g2 = gen_limit, 3 * gen_limit
+
+    def staged_for(g):
+        return engine.stage_batch(
+            chunk, config_for(g), padded_shape=(ph, pw), pad_batch_to=total,
+            temporal_depth=plan.temporal_depth,
+        )
+
+    times = {}
+    for g in (g1, g2):
+        engine.complete_batch(engine.dispatch_batch(staged_for(g)))  # warm
+        best = float("inf")
+        for _ in range(repeats):
+            # Fresh staging per run (the program donates its operand); the
+            # transfer cost is identical at g1 and g2, so the difference
+            # subtracts it out along with dispatch and readback.
+            s = staged_for(g)
+            t0 = time.perf_counter()
+            engine.complete_batch(engine.dispatch_batch(s))
+            best = min(best, time.perf_counter() - t0)
+        times[g] = best
+    per_gen = max(times[g2] - times[g1], 1e-9) / (g2 - g1)
+    rate = board_height * board_width * len(chunk) / per_gen
+    mode = engine.resolve_batch_mode(
+        [board_height] * len(chunk), [board_width] * len(chunk), (ph, pw)
+    )
+    key = BucketKey(height=ph, width=pw, convention=convention, kernel=mode)
+    return {metric_label(key.label()): round(rate, 1)}
+
+
 def run_serve_search(
     board_height: int,
     board_width: int,
@@ -377,6 +448,17 @@ def run_serve_search(
                     trials[-1].median_s * 1e3)
 
     winner = _pick_winner(trials, default_label)
+    try:
+        marginal = measure_marginal_rate(
+            board_height, board_width, convention, winner.plan,
+            gen_limit=gen_limit,
+        )
+    except Exception as err:  # noqa: BLE001 - the plan is still good
+        logger.warning(
+            "marginal-rate measurement failed (%s: %s); the plan persists "
+            "without a dispatch-gap roofline", type(err).__name__, err,
+        )
+        marginal = None
     return SearchResult(
         kind="serve",
         context={
@@ -393,6 +475,7 @@ def run_serve_search(
         trials=trials,
         default_label=default_label,
         winner=winner.plan,
+        marginal=marginal,
     )
 
 

@@ -112,6 +112,30 @@ def serve_plan(max_batch: int = 64) -> ServePlan:
     return plan
 
 
+def marginal_rates() -> dict[str, float]:
+    """The tuned serve plan's recorded marginal kernel rates: sanitized
+    bucket label (``obs.registry.metric_label`` spelling) -> cell-updates/s.
+    The serving dispatch-gap monitor (obs/sampler.py) divides achieved
+    bucket rates by these to export the live BENCH_r08 gap ratio. Empty
+    when nothing measured exists — the monitor then reports rates only,
+    the usual absent-cache degradation."""
+    entry = _store().get(serve_fingerprint())
+    if not entry:
+        return {}
+    recorded = entry.get("marginal")
+    if not isinstance(recorded, dict):
+        return {}
+    out = {}
+    for label, rate in recorded.items():
+        try:
+            rate = float(rate)
+        except (TypeError, ValueError):
+            continue
+        if rate > 0:
+            out[str(label)] = rate
+    return out
+
+
 def warm_entries() -> list[dict]:
     """Shapes recorded by the offline tuner for server warmup: each entry is
     ``{"height", "width", "convention", ...}`` — `gol serve --warm-plans`

@@ -55,6 +55,11 @@ def test_no_wall_clock_in_obs():
     """Same rule for gol_tpu/obs/: span durations, histogram samples, and
     report math are ``time.perf_counter()`` only — an observability layer
     whose own numbers step under NTP would poison every consumer at once.
+    The rglob below covers the WHOLE package, emphatically including the
+    SLO engine's rolling windows and the dispatch-gap sampler's tick deltas
+    (obs/slo.py, obs/sampler.py): a stepped clock there would fire — or
+    suppress — a burn-rate page, and with ``--slo-shed`` turn a clock
+    adjustment into load shedding.
     The ONE sanctioned wall-clock read is the tracer's per-process alignment
     anchor, taken via ``time.time_ns()`` at ``trace.enable()`` — outside
     this needle set on purpose, exported as metadata, and never part of any

@@ -35,17 +35,26 @@ from gol_tpu.serve.jobs import DONE, new_job
 from gol_tpu.serve.metrics import Metrics
 
 
+def _reset_tracer():
+    """Off, empty, and back at the DEFAULT ring size: a test that shrank
+    the ring (test_ring_is_bounded_and_counts_drops) must not leave a
+    4-slot ring for every later traced-session test — with job flow events
+    in the ring too, a tiny leftover ring evicts the very spans those
+    tests assert on."""
+    trace.enable(ring_size=trace._DEFAULT_RING)
+    trace.disable()
+    trace.clear()
+
+
 @pytest.fixture(autouse=True)
 def _clean_obs():
     """Every test starts and ends with tracing off, recorder disarmed, and a
     fresh global registry — obs state is process-global by design."""
-    trace.disable()
-    trace.clear()
+    _reset_tracer()
     recorder.uninstall()
     registry.reset_default()
     yield
-    trace.disable()
-    trace.clear()
+    _reset_tracer()
     recorder.uninstall()
     registry.reset_default()
 
@@ -171,7 +180,12 @@ class TestChromeExport:
         batch_buckets = {e["args"]["bucket"] for e in events
                          if e["name"] == "serve.batch"}
         assert len(batch_buckets) == 2  # one lane per padding bucket
-        assert all(e["ph"] == "X" for e in events)
+        # Spans export as ph:"X"; job lifecycles additionally export as
+        # flow events (ph s/t/f) tying each job to its batch span (ISSUE 7).
+        assert {e["ph"] for e in events} <= {"X", "s", "t", "f"}
+        assert all("dur" in e for e in events if e["ph"] == "X")
+        finished = {e["id"] for e in events if e["ph"] == "f"}
+        assert finished == {j.id for j in jobs}
 
 
 class TestRegistry:

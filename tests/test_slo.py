@@ -1,0 +1,838 @@
+"""ISSUE 7's job-granular observability tier: per-job timelines, the SLO
+engine, the dispatch-gap sampler, flow events, and the ops surfaces.
+
+The load-bearing assertions:
+
+- a job's timeline **decomposes exactly**: the segment sum from ``accepted``
+  to ``done`` equals its measured end-to-end latency, identically across
+  the classic depth-1, pipelined, and resident-ring lanes, and its DONE
+  milestone agrees with the journal (a done record exists iff the timeline
+  completed);
+- telemetry off stays the zero-allocation no-op path (``trace.flow`` while
+  disabled records nothing);
+- the SLO engine's multi-window burn rule: critical only when EVERY window
+  burns, shedding only when explicitly enabled (observe-only is the pinned
+  default), 429 + Retry-After on the admission path when it is;
+- ``/metrics`` parity: the JSON variant carries the process-global
+  registry's gauges/histograms under ``process`` while the Prometheus text
+  contract stays serving-series-only.
+"""
+
+import importlib.util
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu.config import GameConfig
+from gol_tpu.io import text_grid
+from gol_tpu.obs import (
+    registry as obs_registry,
+    report as obs_report,
+    sampler as obs_sampler,
+    slo as obs_slo,
+    timeline as obs_timeline,
+    top as obs_top,
+    trace as obs_trace,
+)
+from gol_tpu.obs.registry import Registry, metric_label
+from gol_tpu.serve import batcher
+from gol_tpu.serve.jobs import DONE, JobJournal, new_job, priority_class
+from gol_tpu.serve.scheduler import Scheduler
+from gol_tpu.serve.server import GolServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    """Tracing off, ring empty and at its DEFAULT size around every test:
+    obs trace state is process-global, and an earlier test file may have
+    shrunk the ring (test_obs exercises bounded rings)."""
+    obs_trace.enable(ring_size=obs_trace._DEFAULT_RING)
+    obs_trace.disable()
+    obs_trace.clear()
+    yield
+    obs_trace.enable(ring_size=obs_trace._DEFAULT_RING)
+    obs_trace.disable()
+    obs_trace.clear()
+
+
+def _small_jobs(n=6, gen_limit=8, priority=None):
+    jobs = []
+    for i in range(n):
+        side = 32 if i % 2 == 0 else 30  # two buckets: packed + masked
+        kwargs = {} if priority is None else {"priority": priority}
+        jobs.append(new_job(
+            side, side, text_grid.generate(side, side, seed=100 + i),
+            gen_limit=gen_limit, **kwargs,
+        ))
+    return jobs
+
+
+def _run_scheduler(tmp_path, name, **sched_kwargs):
+    journal = JobJournal(str(tmp_path / name))
+    sched = Scheduler(journal=journal, flush_age=0.005, max_batch=4,
+                      **sched_kwargs)
+    jobs = _small_jobs()
+    for job in jobs:
+        sched.submit(job)
+    sched.start()
+    assert sched.drain(timeout=120)
+    sched.stop(drain=False)
+    replay = journal.replay()
+    journal.close()
+    return jobs, sched, replay
+
+
+# ---------------------------------------------------------------------------
+# Timelines
+# ---------------------------------------------------------------------------
+
+
+class TestTimeline:
+    def test_segments_tile_the_timeline_exactly(self):
+        tl = {"accepted": 1.0, "claimed": 1.5, "stage_start": 1.6,
+              "staged": 1.9, "dispatched": 2.0, "readback_start": 2.2,
+              "completed": 2.5, "done": 2.6, "journaled": 2.9}
+        segs = obs_timeline.segments(tl)
+        assert segs == {
+            "queue_wait": 0.5, "batch_form": pytest.approx(0.1),
+            "stage": pytest.approx(0.3), "dispatch": pytest.approx(0.1),
+            "device": pytest.approx(0.2), "readback": pytest.approx(0.3),
+            "finalize": pytest.approx(0.1), "journal": pytest.approx(0.3),
+        }
+        total = sum(v for k, v in segs.items() if k != "journal")
+        assert total == pytest.approx(tl["done"] - tl["accepted"])
+        out = obs_timeline.summary(tl)
+        assert out["total_seconds"] == pytest.approx(1.6)
+        assert out["journal_lag_seconds"] == pytest.approx(0.3)
+        assert out["milestones"]["accepted"] == 0.0
+
+    def test_partial_timeline_stays_wellformed(self):
+        """A no-split lane (injected run_batch) has fewer milestones; the
+        consecutive-present rule must still tile accepted -> done."""
+        tl = {"accepted": 1.0, "claimed": 1.2, "done": 2.0}
+        segs = obs_timeline.segments(tl)
+        assert segs == {"queue_wait": pytest.approx(0.2),
+                        "finalize": pytest.approx(0.8)}
+        assert obs_timeline.summary({})["milestones"] == {}
+
+    @pytest.mark.parametrize("lane,kwargs", [
+        ("classic", dict(pipeline_depth=1)),
+        ("pipelined", dict(pipeline_depth=2)),
+        ("resident", dict(pipeline_depth=4, resident_ring=2)),
+    ])
+    def test_every_lane_yields_exact_timelines(self, tmp_path, lane, kwargs):
+        """The ISSUE acceptance, per lane: every job's segment sum matches
+        its end-to-end latency exactly, milestones are monotonic, and the
+        DONE milestone agrees with the journal record."""
+        jobs, _, replay = _run_scheduler(tmp_path, lane, **kwargs)
+        for job in jobs:
+            assert job.state == DONE
+            tl = dict(job.timeline)
+            # The full split runs in every real lane: all nine milestones.
+            for m in obs_timeline.MILESTONES:
+                assert m in tl, (lane, m)
+            stamps = [tl[m] for m in obs_timeline.MILESTONES]
+            assert stamps == sorted(stamps), (lane, tl)
+            out = obs_timeline.summary(tl)
+            seg_sum = sum(v for k, v in out["segments"].items()
+                          if k != "journal")
+            assert seg_sum == pytest.approx(out["total_seconds"], abs=1e-9)
+            assert out["total_seconds"] == pytest.approx(
+                job.finished_at - job.accepted_at, abs=1e-9
+            )
+            # DONE milestone <-> journal agreement, both directions.
+            assert job.id in replay.results, (lane, job.id)
+            assert tl["journaled"] >= tl["done"]
+        assert not replay.pending
+
+    def test_latency_and_cell_metrics_fed(self, tmp_path):
+        jobs, sched, _ = _run_scheduler(tmp_path, "metrics")
+        snap = sched.metrics.snapshot()
+        hist = snap["histograms"]["job_latency_seconds"]
+        assert hist["count"] == len(jobs)
+        assert snap["histograms"]["job_latency_seconds_normal"]["count"] == len(jobs)
+        cells = snap["counters"]["serve_cell_updates_total"]
+        assert cells == sum(
+            j.height * j.width * j.result.generations for j in jobs
+        )
+        bucket_counters = [
+            k for k in snap["counters"]
+            if k.startswith("serve_cell_updates_total_")
+        ]
+        assert len(bucket_counters) == 2  # the packed and masked buckets
+        assert sum(snap["counters"][k] for k in bucket_counters) == cells
+
+    def test_priority_class(self):
+        assert priority_class(3) == "high"
+        assert priority_class(0) == "normal"
+        assert priority_class(-1) == "low"
+
+
+# ---------------------------------------------------------------------------
+# Flow events + chrome export + trace-report (satellite: resident exports)
+# ---------------------------------------------------------------------------
+
+
+class TestFlowEvents:
+    def test_flow_disabled_records_nothing(self):
+        obs_trace.disable()
+        obs_trace.clear()
+        obs_trace.flow("job", "abc", "s")
+        assert obs_trace.snapshot() == []
+        # The span no-op pin still holds alongside.
+        assert obs_trace.span("x") is obs_trace._NOOP
+
+    def test_bad_phase_rejected(self):
+        obs_trace.enable()
+        try:
+            with pytest.raises(ValueError):
+                obs_trace.tracer().flow("job", "abc", "x")
+        finally:
+            obs_trace.disable()
+            obs_trace.clear()
+
+    def test_resident_trace_roundtrips_with_flows(self, tmp_path):
+        """Satellite 3: a traced resident-lane session exports
+        serve.resident_loop spans plus job flow events; the Chrome JSON is
+        well-formed Perfetto input and `gol trace-report` renders it."""
+        obs_trace.enable()
+        try:
+            jobs, _, _ = _run_scheduler(
+                tmp_path, "traced", pipeline_depth=4, resident_ring=2,
+            )
+            path = obs_trace.export_chrome(str(tmp_path / "trace.json"))
+        finally:
+            obs_trace.disable()
+            obs_trace.clear()
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events
+        # Perfetto well-formedness: every event has name/ph/ts/pid/tid;
+        # complete events carry dur; flow events carry id; timestamps are
+        # sorted (the export contract).
+        last_ts = None
+        for e in events:
+            for field in ("name", "ph", "ts", "pid", "tid"):
+                assert field in e, e
+            if e["ph"] == "X":
+                assert "dur" in e
+            else:
+                assert e["ph"] in ("s", "t", "f")
+                assert e.get("id")
+            if last_ts is not None:
+                assert e["ts"] >= last_ts
+            last_ts = e["ts"]
+        names = {e["name"] for e in events if e["ph"] == "X"}
+        assert "serve.resident_loop" in names
+        assert "serve.batch" in names
+        # Every job's lifecycle flows: one start and one finish per id.
+        starts = {e["id"] for e in events if e["ph"] == "s"}
+        finishes = {e["id"] for e in events if e["ph"] == "f"}
+        assert starts == finishes == {j.id for j in jobs}
+        for e in events:
+            if e["ph"] == "f":
+                assert e["bp"] == "e"
+        # And the report renders both artifacts without choking on flows.
+        text = obs_report.render(path)
+        assert "serve.resident_loop" in text
+        assert "job flows:" in text
+        assert f"{len(jobs)} started" in text
+
+    def test_flight_dump_flows_counted_not_tabled(self, tmp_path):
+        """Flow points ride the span ring; the report must count them
+        instead of rendering 0-duration phases."""
+        obs_trace.enable()
+        try:
+            with obs_trace.span("phase.a"):
+                pass
+            obs_trace.flow("job", "j1", "s")
+            obs_trace.flow("job", "j1", "f")
+            from gol_tpu.obs import recorder
+
+            recorder.install(str(tmp_path))
+            try:
+                dump = recorder.trigger("test")
+            finally:
+                recorder.uninstall()
+        finally:
+            obs_trace.disable()
+            obs_trace.clear()
+        spans, meta = obs_report.load_spans(dump)
+        assert [s["name"] for s in spans] == ["phase.a"]
+        assert meta["flows"] == {"s": 1, "f": 1}
+        text = obs_report.render(dump)
+        assert "job flows: 1 started, 0 step(s), 1 finished" in text
+
+
+# ---------------------------------------------------------------------------
+# The SLO engine
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _engine(reg, clock, **kwargs):
+    kwargs.setdefault("windows", (10.0, 60.0))
+    return obs_slo.SloEngine(
+        obs_slo.default_objectives(100, latency_target_s=1.0),
+        registry=reg, clock=clock, **kwargs,
+    )
+
+
+class TestSloEngine:
+    def test_error_rate_burn_over_windows(self):
+        reg, clock = Registry(), _Clock()
+        eng = _engine(reg, clock)
+        eng.sample()
+        reg.inc("jobs_accepted_total", 100)
+        reg.inc("jobs_failed_total", 4)
+        clock.advance(5)
+        status = eng.evaluate()
+        err = next(o for o in status["objectives"]
+                   if o["name"] == "error_rate")
+        # 4% failures against a 1% budget on both windows: burn 4, critical.
+        assert err["windows"]["10s"]["observed"] == pytest.approx(0.04)
+        assert err["windows"]["10s"]["burn"] == pytest.approx(4.0)
+        assert err["status"] == obs_slo.CRITICAL
+        assert status["status"] == obs_slo.CRITICAL
+
+    def test_no_traffic_means_no_burn(self):
+        reg, clock = Registry(), _Clock()
+        eng = _engine(reg, clock)
+        status = eng.evaluate()
+        assert status["status"] == obs_slo.OK
+        for o in status["objectives"]:
+            assert o["burn"] == 0.0
+
+    def test_latency_burn_and_recovery_rule(self):
+        reg, clock = Registry(), _Clock()
+        eng = _engine(reg, clock)
+        eng.sample()  # baseline: count 0
+        reg.observe("job_latency_seconds_normal", 3.0)  # 3x the 1s target
+        clock.advance(5)
+        status = eng.evaluate()
+        lat = next(o for o in status["objectives"]
+                   if o["name"] == "latency_p99_normal")
+        assert lat["status"] == obs_slo.CRITICAL
+        assert lat["burn"] == pytest.approx(3.0)
+        # Once BOTH windows have an observation-free span, burn drops to 0
+        # (the reservoir p99 alone cannot hold an alert up forever).
+        clock.advance(100)
+        eng.sample()
+        clock.advance(100)
+        status = eng.evaluate()
+        lat = next(o for o in status["objectives"]
+                   if o["name"] == "latency_p99_normal")
+        assert lat["status"] == obs_slo.OK
+        assert lat["burn"] == 0.0
+
+    def test_multi_window_rule_needs_every_window(self):
+        """A burst that only the short window sees must NOT alert: the
+        binding burn is the minimum across windows."""
+        reg, clock = Registry(), _Clock()
+        eng = _engine(reg, clock)
+        eng.sample()
+        clock.advance(55)
+        reg.inc("jobs_accepted_total", 10)
+        eng.sample()
+        clock.advance(5)
+        # Fresh failures land inside the 10s window only; the 60s window
+        # dilutes them over the earlier accepted traffic... with counters
+        # both windows see the same totals here, so use saturation instead:
+        reg.set_gauge("queue_depth", 95)  # 95% of capacity vs 80% target
+        status = eng.evaluate()
+        sat = next(o for o in status["objectives"]
+                   if o["name"] == "queue_saturation")
+        # Saturation max-over-window sees the spike in every window that
+        # contains the newest sample -> burns everywhere (it is a gauge).
+        assert sat["burn"] == pytest.approx(0.95 / 0.8, rel=1e-3)
+        assert sat["status"] == obs_slo.WARNING  # 1.19 < critical 2.0
+
+    def test_shed_only_when_enabled_and_critical(self):
+        reg, clock = Registry(), _Clock()
+        observe = _engine(reg, clock)
+        reg.inc("jobs_accepted_total", 10)
+        reg.inc("jobs_failed_total", 10)
+        clock.advance(5)
+        observe.evaluate()
+        assert observe.should_shed() == (False, 0.0)
+
+        shedding = _engine(reg, clock, shed=True, retry_after_s=7.0)
+        shedding.sample()  # baseline BEFORE the new failures
+        reg.inc("jobs_accepted_total", 10)
+        reg.inc("jobs_failed_total", 10)
+        clock.advance(5)
+        shedding.evaluate()
+        assert shedding.should_shed() == (True, 7.0)
+        state = shedding.state()
+        assert state["status"] == obs_slo.CRITICAL
+        assert state["shed_active"] is True
+        assert state["burn.error_rate"] > 0
+
+    def test_render_status(self):
+        reg, clock = Registry(), _Clock()
+        eng = _engine(reg, clock)
+        text = obs_slo.render_status(eng.evaluate())
+        assert "SLO status: ok" in text
+        assert "observe-only" in text
+        assert "error_rate" in text
+        # The flight-dump state form renders too.
+        eng.evaluate()
+        assert "burn" in obs_slo.render_status(eng.state())
+
+    def test_render_flight_dump_state_reports_active_shedding(self):
+        """The post-mortem's one operational fact — was the server rejecting
+        traffic when it died — must survive the state record's flattened
+        shed_enabled/shed_active keys."""
+        reg, clock = Registry(), _Clock()
+        eng = _engine(reg, clock, shed=True)
+        eng.sample()
+        reg.inc("jobs_accepted_total", 10)
+        reg.inc("jobs_failed_total", 10)
+        clock.advance(5)
+        eng.evaluate()
+        text = obs_slo.render_status(eng.state())
+        assert "SLO status: critical" in text
+        assert "shedding: enabled (ACTIVE)" in text
+        assert "error_rate: burn" in text
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError):
+            obs_slo.Objective(name="x", kind="nope", target=1, source="s")
+        with pytest.raises(ValueError):
+            obs_slo.Objective(name="x", kind="latency", target=0, source="s")
+        with pytest.raises(ValueError):
+            obs_slo.Objective(name="x", kind="error_rate", target=1, source="s")
+
+
+# ---------------------------------------------------------------------------
+# The dispatch-gap sampler
+# ---------------------------------------------------------------------------
+
+
+class TestSampler:
+    def test_gap_gauges_from_counters_and_marginals(self):
+        reg, clock = Registry(), _Clock()
+        bucket = metric_label("256x256/c/packed")
+        sampler = obs_sampler.ServeSampler(
+            reg, interval=1.0, clock=clock,
+            marginal_rates={bucket: 2000.0},
+        )
+        reg.inc("serve_cell_updates_total", 0)
+        reg.inc(f"serve_cell_updates_total_{bucket}", 0)
+        sampler.tick()  # first tick: baselines only, no gauges yet
+        assert "dispatch_gap_ratio" not in reg.snapshot()["gauges"]
+        reg.inc("serve_cell_updates_total", 1000)
+        reg.inc(f"serve_cell_updates_total_{bucket}", 1000)
+        clock.advance(1.0)
+        sampler.tick()
+        gauges = reg.snapshot()["gauges"]
+        assert gauges[f"bucket_cell_updates_per_sec_{bucket}"] == pytest.approx(1000.0)
+        # 1000 cells in 1s against a 2000/s roofline: gap ratio 0.5.
+        assert gauges[f"dispatch_gap_ratio_{bucket}"] == pytest.approx(0.5)
+        assert gauges["dispatch_gap_ratio"] == pytest.approx(0.5)
+        assert gauges["serve_cell_updates_per_sec"] == pytest.approx(1000.0)
+        # An idle tick keeps the last ratio (no decay to 0).
+        clock.advance(1.0)
+        sampler.tick()
+        assert reg.snapshot()["gauges"]["dispatch_gap_ratio"] == pytest.approx(0.5)
+
+    def test_unknown_bucket_work_suppresses_overall_ratio(self):
+        """Work in a bucket with NO tuned marginal must not deflate the
+        whole-service ratio (it would read as a standing false regression
+        on a healthy service); per-bucket ratios still export."""
+        reg, clock = Registry(), _Clock()
+        sampler = obs_sampler.ServeSampler(
+            reg, interval=1.0, clock=clock,
+            marginal_rates={"known": 2000.0},
+        )
+        for name in ("serve_cell_updates_total",
+                     "serve_cell_updates_total_known",
+                     "serve_cell_updates_total_mystery"):
+            reg.inc(name, 0)
+        sampler.tick()
+        reg.inc("serve_cell_updates_total_known", 1000)
+        reg.inc("serve_cell_updates_total_mystery", 1000)
+        reg.inc("serve_cell_updates_total", 2000)
+        clock.advance(1.0)
+        sampler.tick()
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["dispatch_gap_ratio_known"] == pytest.approx(0.5)
+        assert "dispatch_gap_ratio" not in gauges
+        assert gauges["serve_cell_updates_per_sec"] == pytest.approx(2000.0)
+
+    def test_without_marginals_rates_only(self):
+        reg, clock = Registry(), _Clock()
+        sampler = obs_sampler.ServeSampler(reg, interval=1.0, clock=clock)
+        reg.inc("serve_cell_updates_total_b1", 0)
+        sampler.tick()
+        reg.inc("serve_cell_updates_total_b1", 500)
+        clock.advance(2.0)
+        sampler.tick()
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["bucket_cell_updates_per_sec_b1"] == pytest.approx(250.0)
+        assert "dispatch_gap_ratio_b1" not in gauges
+
+    def test_thread_lifecycle(self):
+        import threading
+
+        reg = Registry()
+        sampler = obs_sampler.ServeSampler(reg, interval=0.05)
+        sampler.start()
+        assert any(t.name == obs_sampler.THREAD_NAME
+                   for t in threading.enumerate())
+        sampler.stop()
+        assert not any(t.name == obs_sampler.THREAD_NAME
+                       for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# Tuned marginal rates (select <- tune handshake)
+# ---------------------------------------------------------------------------
+
+
+class TestMarginalRates:
+    def test_select_reads_persisted_marginals(self, tmp_path, monkeypatch):
+        from gol_tpu.tune import plans, select
+
+        monkeypatch.setenv(plans.ENV_CACHE_PATH, str(tmp_path / "plans.json"))
+        select.reset()
+        try:
+            assert select.marginal_rates() == {}
+            store = plans.PlanStore()
+            store.put(select.serve_fingerprint(), {
+                "pad_quantum": 32,
+                "batch_ladder": [1, 2, 4, 8, 16, 32, 64],
+                "marginal": {"256x256_c_packed": 3.2e9,
+                             "bogus": "not-a-rate", "zero": 0},
+            })
+            select.reset()
+            assert select.marginal_rates() == {
+                "256x256_c_packed": pytest.approx(3.2e9)
+            }
+        finally:
+            select.reset()
+
+    def test_measure_marginal_rate_spells_like_the_scheduler(self):
+        """tune's marginal key must match the scheduler's per-bucket counter
+        suffix — the sampler joins the two by string equality."""
+        from gol_tpu.tune import measure
+        from gol_tpu.tune.space import DEFAULT_SERVE_PLAN
+
+        rates = measure.measure_marginal_rate(
+            32, 32, "c", DEFAULT_SERVE_PLAN,
+            gen_limit=2, batch=2, repeats=1,
+        )
+        job = new_job(32, 32, np.zeros((32, 32), np.uint8))
+        want_key = metric_label(batcher.bucket_for(job).label())
+        assert set(rates) == {want_key}
+        assert rates[want_key] > 0
+
+
+# ---------------------------------------------------------------------------
+# Server surfaces: /slo, timeline endpoint, shedding, /metrics parity
+# ---------------------------------------------------------------------------
+
+
+def _http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _wait(predicate, timeout=60):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _submit_board(base, side=32, gen_limit=4, seed=5):
+    board = text_grid.generate(side, side, seed=seed)
+    return _http("POST", f"{base}/jobs", {
+        "width": side, "height": side,
+        "cells": text_grid.encode(board).decode("ascii"),
+        "gen_limit": gen_limit,
+    })
+
+
+class TestServerSurfaces:
+    @pytest.fixture
+    def server(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "journal"),
+                        flush_age=0.01, sample_interval=0)
+        srv.start()
+        yield srv
+        srv.shutdown()
+
+    def _done_job(self, server):
+        base = server.url
+        status, raw, _ = _submit_board(base)
+        assert status == 202
+        jid = json.loads(raw)["id"]
+        assert _wait(lambda: json.loads(
+            _http("GET", f"{base}/jobs/{jid}")[1])["state"] == "done")
+        return jid
+
+    def test_timeline_endpoint(self, server):
+        base = server.url
+        jid = self._done_job(server)
+        status, raw, _ = _http("GET", f"{base}/jobs/{jid}/timeline")
+        assert status == 200
+        tl = json.loads(raw)
+        assert tl["state"] == "done"
+        seg_sum = sum(v for k, v in tl["segments"].items() if k != "journal")
+        assert seg_sum == pytest.approx(tl["total_seconds"], abs=1e-9)
+        assert tl["journal_lag_seconds"] >= 0
+        assert tl["milestones"]["accepted"] == 0.0
+        assert _http("GET", f"{base}/jobs/nope/timeline")[0] == 404
+
+    def test_slo_endpoint_and_observe_only_default(self, server):
+        base = server.url
+        # Early baseline sample (a real server's sampler thread does this).
+        server.slo.sample()
+        self._done_job(server)
+        server.slo.evaluate()
+        status, raw, _ = _http("GET", f"{base}/slo")
+        assert status == 200
+        slo = json.loads(raw)
+        assert slo["status"] in ("ok", "warning", "critical")
+        assert slo["shed"] == {"enabled": False, "active": False,
+                               "retry_after_s": 5.0}
+        assert {o["name"] for o in slo["objectives"]} == {
+            "latency_p99_high", "latency_p99_normal", "latency_p99_low",
+            "error_rate", "queue_saturation",
+        }
+        for o in slo["objectives"]:
+            assert set(o["windows"]) == {"60s", "300s"}
+        # Observe-only: even a critical engine state never sheds.
+        assert server.should_shed() == (False, 0.0)
+
+    def test_metrics_json_parity_and_prometheus_stability(self, server):
+        base = server.url
+        self._done_job(server)
+        obs_registry.default().set_gauge("ring_slot_occupancy", 0.5)
+        status, raw, _ = _http("GET", f"{base}/metrics?format=json")
+        snap = json.loads(raw)
+        # The serving snapshot, plus the process-global registry's gauges
+        # and histogram summaries under "process" — what trace-report
+        # renders from a flight dump, now live on /metrics.
+        assert set(snap) >= {"counters", "gauges", "histograms", "process"}
+        assert set(snap["process"]) == {"counters", "gauges", "histograms"}
+        assert snap["process"]["gauges"]["ring_slot_occupancy"] == 0.5
+        assert snap["process"]["counters"]["engine_batches_total"] >= 1
+        assert "job_latency_seconds" in snap["histograms"]
+        # Prometheus text: serving series only, and the PR-2 pinned lines
+        # unchanged — no "process" leakage.
+        status, raw, _ = _http("GET", f"{base}/metrics")
+        text = raw.decode()
+        assert "gol_serve_jobs_completed_total 1" in text
+        assert 'gol_serve_run_latency_seconds{quantile="0.99"}' in text
+        assert "process" not in text
+        assert "engine_batches_total" not in text
+
+    def test_shedding_server_429_with_retry_after(self, tmp_path):
+        srv = GolServer(port=0, flush_age=0.01, sample_interval=0,
+                        slo_shed=True, slo_latency_target=1e-9)
+        srv.start()
+        try:
+            base = srv.url
+            srv.slo.sample()  # the pre-traffic baseline
+            status, raw, _ = _submit_board(base, seed=6)
+            assert status == 202  # no latency samples yet: nothing burns
+            jid = json.loads(raw)["id"]
+            assert _wait(lambda: json.loads(
+                _http("GET", f"{base}/jobs/{jid}")[1])["state"] == "done")
+            srv.slo.evaluate()  # any completed job breaches a 1ns target
+            status, raw, headers = _submit_board(base, seed=7)
+            assert status == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert "shedding" in json.loads(raw)["error"]
+            assert srv.metrics.counter("jobs_shed_total") == 1
+            # The flight-recorder state provider is registered while up.
+            from gol_tpu.obs import recorder
+
+            assert obs_slo.STATE_PROVIDER in recorder._state_providers
+        finally:
+            srv.shutdown()
+        from gol_tpu.obs import recorder
+
+        assert obs_slo.STATE_PROVIDER not in recorder._state_providers
+
+    def test_sampler_thread_hygiene(self, tmp_path):
+        import threading
+
+        srv = GolServer(port=0, flush_age=0.01, sample_interval=0.05)
+        srv.start()
+        assert _wait(lambda: any(
+            t.name == obs_sampler.THREAD_NAME for t in threading.enumerate()
+        ), timeout=5)
+        srv.shutdown()
+        assert not any(t.name == obs_sampler.THREAD_NAME
+                       for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# gol top rendering
+# ---------------------------------------------------------------------------
+
+
+class TestTop:
+    def test_render_frame_sections(self):
+        metrics = {
+            "counters": {"jobs_accepted_total": 10, "jobs_completed_total": 9,
+                         "jobs_failed_total": 1, "batches_total": 3},
+            "gauges": {"queue_depth": 2, "inflight_batches": 1,
+                       "dispatch_gap_ratio": 0.62,
+                       "serve_cell_updates_per_sec": 1.5e9,
+                       "bucket_cell_updates_per_sec_256x256_c_packed": 1.5e9,
+                       "dispatch_gap_ratio_256x256_c_packed": 0.62},
+            "histograms": {"job_latency_seconds": {
+                "count": 9, "sum": 1.0, "p50": 0.1, "p95": 0.2, "p99": 0.3}},
+            "process": {"gauges": {"ring_slot_occupancy": 0.75},
+                        "histograms": {"dispatch_gap_seconds": {
+                            "count": 4, "sum": 0.1, "p50": 0.01,
+                            "p95": 0.02, "p99": 0.03}}},
+        }
+        slo = {"status": "warning", "windows_s": [60, 300],
+               "objectives": [{"name": "error_rate", "status": "warning",
+                               "windows": {"60s": {"burn": 1.2},
+                                           "300s": {"burn": 1.1}}}]}
+        frame = obs_top.render_frame(metrics, slo, ansi=False)
+        assert "SLO WARNING" in frame
+        assert "depth      2" in frame
+        assert "ring occupancy" in frame
+        assert "0.62 of tuned roofline" in frame
+        assert "job_latency_seconds" in frame
+        assert "error_rate" in frame and "1.200" in frame
+        assert "256x256_c_packed" in frame
+        # ANSI mode colors the status; plain mode must not.
+        assert "\x1b[" not in frame
+        assert "\x1b[33m" in obs_top.render_frame(metrics, slo, ansi=True)
+
+    def test_render_frame_survives_unreachable_endpoints(self):
+        frame = obs_top.render_frame({}, None, ansi=False)
+        assert "/metrics unreachable" in frame
+        assert "/slo unreachable" in frame
+
+
+# ---------------------------------------------------------------------------
+# bench_diff (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def _bench_diff():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "bench_diff.py")
+    spec = importlib.util.spec_from_file_location("bench_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchDiff:
+    def test_within_tolerance_passes(self):
+        bd = _bench_diff()
+        doc = {"metric": "serve_rate", "value": 100.0, "unit": "boards/s",
+               "detail": {"b1": 10.0}}
+        new = {"metric": "serve_rate", "value": 95.0, "unit": "boards/s",
+               "detail": {"b1": 10.5}}
+        lines, regressed = bd.compare(doc, new, 0.10)
+        assert not regressed
+        assert "within tolerance" in lines[0]
+
+    def test_higher_better_regression(self):
+        bd = _bench_diff()
+        old = {"metric": "serve_rate", "value": 100.0, "unit": "x"}
+        new = {"metric": "serve_rate", "value": 80.0, "unit": "x"}
+        lines, regressed = bd.compare(old, new, 0.10)
+        assert regressed and "REGRESSION" in lines[0]
+        # An improvement of the same size is NOT a regression.
+        _, regressed = bd.compare(new, old, 0.10)
+        assert not regressed
+
+    def test_lower_better_direction(self):
+        bd = _bench_diff()
+        old = {"metric": "checkpoint_sync_seconds", "value": 1.0, "unit": "s"}
+        slower = {"metric": "checkpoint_sync_seconds", "value": 1.5, "unit": "s"}
+        _, regressed = bd.compare(old, slower, 0.10)
+        assert regressed
+        _, regressed = bd.compare(slower, old, 0.10)
+        assert not regressed
+
+    def test_mismatched_metrics_rejected(self):
+        bd = _bench_diff()
+        with pytest.raises(ValueError):
+            bd.compare({"metric": "a", "value": 1}, {"metric": "b", "value": 1},
+                       0.1)
+
+    def test_nested_drift_reported_not_fatal(self):
+        bd = _bench_diff()
+        old = {"metric": "m", "value": 1.0, "unit": "x",
+               "lanes": {"a": 1.0}, "env": {"jax": 4.0}}
+        new = {"metric": "m", "value": 1.0, "unit": "x",
+               "lanes": {"a": 2.0}, "env": {"jax": 5.0}}
+        lines, regressed = bd.compare(old, new, 0.10)
+        assert not regressed
+        assert any("lanes.a" in line for line in lines)
+        assert not any("env.jax" in line for line in lines)  # config-ignored
+
+    def test_cli_exit_codes(self, tmp_path):
+        bd = _bench_diff()
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps({"metric": "m", "value": 100, "unit": "x"}))
+        b.write_text(json.dumps({"metric": "m", "value": 50, "unit": "x"}))
+        assert bd.main([str(a), str(a)]) == 0
+        assert bd.main([str(a), str(b)]) == 1
+        assert bd.main([str(a), str(tmp_path / "missing.json")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# gol submit's latency note (satellite 6)
+# ---------------------------------------------------------------------------
+
+
+class TestSubmitLatencyNote:
+    def test_note_from_live_server(self, tmp_path):
+        from gol_tpu import cli
+
+        srv = GolServer(port=0, flush_age=0.01, sample_interval=0)
+        srv.start()
+        try:
+            base = srv.url
+            status, raw, _ = _submit_board(base, seed=9)
+            jid = json.loads(raw)["id"]
+            assert _wait(lambda: json.loads(
+                _http("GET", f"{base}/jobs/{jid}")[1])["state"] == "done")
+            note = cli._submit_latency_note(base, jid)
+            assert "queue " in note and "total " in note and "ms" in note
+            # Unknown job / dead server: the note degrades to nothing.
+            assert cli._submit_latency_note(base, "nope") == ""
+        finally:
+            srv.shutdown()
+        assert cli._submit_latency_note(base, jid) == ""

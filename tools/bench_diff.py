@@ -1,0 +1,152 @@
+"""Compare two BENCH_*.json artifacts with noise thresholds.
+
+The repo accumulates one measurement artifact per perf PR (BENCH_r01..r09)
+and, until now, no tooling to compare any two of them — a regression only
+surfaced when a human eyeballed the JSON. This makes the comparison a
+command with an exit code, so CI (or `make bench-diff`) can gate on it:
+
+    python tools/bench_diff.py BENCH_old.json BENCH_new.json [--tolerance F]
+
+- The **headline** ``value`` is judged directionally: metrics/units naming
+  seconds/latency/time are lower-better, everything else (rates, speedup
+  ratios, boards/s) higher-better. A move in the bad direction beyond
+  ``--tolerance`` (relative, default 10% — comfortably outside the
+  trimmed-median scatter the tune/ protocol sees on shared machines) exits
+  nonzero.
+- Every other shared numeric leaf is compared informationally: leaves that
+  moved more than the tolerance are listed as drift (no exit-code verdict —
+  nested fields mix directions and units; the headline is the contract).
+
+Exit codes: 0 within tolerance, 1 headline regression, 2 usage/shape error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Substrings marking a lower-is-better headline (times); everything else is
+# treated as higher-is-better (rates, ratios, counts of useful work).
+LOWER_BETTER_HINTS = ("seconds", "second", "latency", "_time", "msec", "ms")
+
+# Nested leaves that are configuration, not measurement: never drift.
+CONFIG_HINTS = ("seed", "iters", "gen_limit", "boards", "repeats",
+                "max_batch", "ring", "checkpoint_every", "total_cell",
+                "counts")
+
+
+def flatten(doc, prefix: str = "") -> dict[str, float]:
+    out: dict[str, float] = {}
+    if isinstance(doc, dict):
+        for key, value in doc.items():
+            out.update(flatten(value, f"{prefix}{key}."))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[prefix[:-1]] = float(doc)
+    return out
+
+
+def lower_is_better(metric: str, unit: str) -> bool:
+    text = f"{metric} {unit}".lower()
+    return any(h in text for h in LOWER_BETTER_HINTS)
+
+
+def _is_config(path: str) -> bool:
+    low = path.lower()
+    return (low.startswith(("env.", "load.", "context."))
+            or any(h in low for h in CONFIG_HINTS))
+
+
+def compare(old: dict, new: dict, tolerance: float):
+    """(report lines, regressed?) for two parsed artifacts."""
+    lines = []
+    metric_old = old.get("metric", "?")
+    metric_new = new.get("metric", "?")
+    if metric_old != metric_new:
+        raise ValueError(
+            f"artifacts measure different things: {metric_old!r} vs "
+            f"{metric_new!r} — compare runs of the SAME suite"
+        )
+    unit = str(new.get("unit", old.get("unit", "")))
+    regressed = False
+    try:
+        v_old, v_new = float(old["value"]), float(new["value"])
+    except (KeyError, TypeError, ValueError):
+        raise ValueError("both artifacts need a numeric headline 'value'")
+    lower = lower_is_better(str(metric_old), unit)
+    rel = (v_new - v_old) / abs(v_old) if v_old else 0.0
+    bad = rel > tolerance if lower else rel < -tolerance
+    better = rel < -tolerance if lower else rel > tolerance
+    verdict = ("REGRESSION" if bad
+               else "improvement" if better else "within tolerance")
+    if bad:
+        regressed = True
+    lines.append(
+        f"headline {metric_old} ({'lower' if lower else 'higher'} is "
+        f"better): {v_old:g} -> {v_new:g} {unit} ({rel:+.1%}) — {verdict}"
+    )
+
+    flat_old, flat_new = flatten(old), flatten(new)
+    shared = sorted(set(flat_old) & set(flat_new) - {"value"})
+    drifted = []
+    for path in shared:
+        if _is_config(path):
+            continue
+        a, b = flat_old[path], flat_new[path]
+        if a == b:
+            continue
+        rel = (b - a) / abs(a) if a else float("inf")
+        if abs(rel) > tolerance:
+            drifted.append(f"  {path}: {a:g} -> {b:g} ({rel:+.1%})")
+    if drifted:
+        lines.append(f"drift beyond {tolerance:.0%} in "
+                     f"{len(drifted)} nested leaf/leaves (informational):")
+        lines.extend(drifted)
+    else:
+        lines.append(f"no nested leaf drifted beyond {tolerance:.0%}")
+    only_old = sorted(set(flat_old) - set(flat_new))
+    only_new = sorted(set(flat_new) - set(flat_old))
+    if only_old:
+        lines.append(f"leaves only in OLD: {', '.join(only_old[:8])}"
+                     + (" ..." if len(only_old) > 8 else ""))
+    if only_new:
+        lines.append(f"leaves only in NEW: {', '.join(only_new[:8])}"
+                     + (" ..." if len(only_new) > 8 else ""))
+    return lines, regressed
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("old", help="baseline BENCH_*.json")
+    parser.add_argument("new", help="candidate BENCH_*.json")
+    parser.add_argument(
+        "--tolerance", type=float, default=0.10,
+        help="relative noise threshold (default 0.10 = 10%%)",
+    )
+    args = parser.parse_args(argv)
+    if args.tolerance < 0:
+        print(f"bench-diff: tolerance must be >= 0, got {args.tolerance}",
+              file=sys.stderr)
+        return 2
+    docs = []
+    for path in (args.old, args.new):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError) as err:
+            print(f"bench-diff: cannot read {path}: {err}", file=sys.stderr)
+            return 2
+    try:
+        lines, regressed = compare(docs[0], docs[1], args.tolerance)
+    except ValueError as err:
+        print(f"bench-diff: {err}", file=sys.stderr)
+        return 2
+    print(f"bench-diff: {args.old} -> {args.new} "
+          f"(tolerance {args.tolerance:.0%})")
+    for line in lines:
+        print(line)
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

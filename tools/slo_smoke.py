@@ -1,0 +1,307 @@
+"""SLO end-to-end smoke: burn-rate alerting, shedding, and the flight dump.
+
+The `make slo-smoke` harness, against real `gol serve` processes:
+
+1. boot a server in **observe-only** mode (the default) with a deliberately
+   tight p99 latency objective (--slo-latency-p99) and a --trace dir (arms
+   the flight recorder);
+2. inject a **slow bucket**: jobs whose batches take far longer than the
+   objective (big boards, deep generation limits — plus the first-dispatch
+   compile, which is exactly the kind of latency a tight SLO must catch);
+3. wait for ``GET /slo`` to report the latency burn **critical** on every
+   window (the multi-window rule);
+4. observe-only contract: submissions are STILL 202-accepted, the server
+   merely logs the critical burn;
+5. ``kill -USR1`` the server: the flight dump must carry the ``slo`` state
+   record (the state provider), and ``gol slo-report <dump>`` must render;
+6. restart with ``--slo-shed``: once the burn is critical again, POST /jobs
+   must answer **429 with a Retry-After header** until the burn clears;
+7. along the way, a completed job's ``GET /jobs/<id>/timeline`` must
+   decompose: segment sum == total_seconds exactly.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/slo_smoke.py
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gol_tpu.io import text_grid  # noqa: E402
+
+SLOW_SIDE = 128
+SLOW_GENS = 20000
+TARGET_S = 0.05
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=10):
+    """(status, parsed json, headers) — HTTPError is a reply, not a crash."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except ValueError:
+            payload = {}
+        return e.code, payload, dict(e.headers)
+
+
+def _start_server(port, journal_dir, trace_dir, shed):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    argv = [
+        sys.executable, "-m", "gol_tpu", "serve",
+        "--port", str(port),
+        "--journal-dir", journal_dir,
+        "--flush-age", "0.02",
+        "--slo-latency-p99", str(TARGET_S),
+        "--sample-interval", "0.25",
+        "--trace", trace_dir,
+    ]
+    if shed:
+        argv.append("--slo-shed")
+    proc = subprocess.Popen(
+        argv, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(
+                f"server died on boot rc={proc.returncode}:\n{out[-3000:]}")
+        try:
+            status, _, _ = _http("GET", f"{base}/healthz", timeout=2)
+            if status == 200:
+                return proc
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("server did not become healthy within 120s")
+
+
+def _stop(proc):
+    if proc is None or proc.poll() is not None:
+        return ""
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out, _ = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    return out or ""
+
+
+def _submit_slow(base, n=3):
+    ids = []
+    for i in range(n):
+        board = text_grid.generate(SLOW_SIDE, SLOW_SIDE, seed=500 + i)
+        status, payload, _ = _http("POST", f"{base}/jobs", {
+            "width": SLOW_SIDE, "height": SLOW_SIDE,
+            "cells": text_grid.encode(board).decode("ascii"),
+            "gen_limit": SLOW_GENS,
+        })
+        if status != 202:
+            raise RuntimeError(
+                f"slow-bucket submit rejected HTTP {status}: {payload}")
+        ids.append(payload["id"])
+    return ids
+
+
+def _wait_done(base, ids, timeout=300):
+    deadline = time.perf_counter() + timeout
+    pending = set(ids)
+    while pending and time.perf_counter() < deadline:
+        for job_id in list(pending):
+            status, payload, _ = _http("GET", f"{base}/jobs/{job_id}")
+            if status == 200 and payload["state"] == "done":
+                pending.discard(job_id)
+            elif status == 200 and payload["state"] in ("failed", "cancelled"):
+                raise RuntimeError(f"job {job_id} ended {payload['state']}")
+        if pending:
+            time.sleep(0.2)
+    if pending:
+        raise RuntimeError(f"{len(pending)} slow job(s) never completed")
+
+
+def _wait_critical(base, timeout=30):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        status, slo, _ = _http("GET", f"{base}/slo")
+        if status == 200 and slo.get("status") == "critical":
+            return slo
+        time.sleep(0.25)
+    raise RuntimeError(
+        f"SLO never went critical within {timeout}s (last: {slo})")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.parse_args(argv)
+    workdir = tempfile.mkdtemp(prefix="gol-slo-smoke-")
+    rc = 1
+    proc = None
+    try:
+        # -- phase A: observe-only ------------------------------------------
+        port = _free_port()
+        base = f"http://127.0.0.1:{port}"
+        trace_dir = os.path.join(workdir, "trace-a")
+        proc = _start_server(port, os.path.join(workdir, "journal-a"),
+                             trace_dir, shed=False)
+        print(f"slo-smoke: observe-only server up on {base} "
+              f"(p99 target {TARGET_S}s)")
+        ids = _submit_slow(base)
+        _wait_done(base, ids)
+
+        # Timeline decomposition of a completed slow job.
+        status, tl, _ = _http("GET", f"{base}/jobs/{ids[0]}/timeline")
+        if status != 200 or tl.get("total_seconds") is None:
+            print(f"slo-smoke: timeline missing: HTTP {status} {tl}")
+            return 1
+        seg_sum = sum(v for k, v in tl["segments"].items() if k != "journal")
+        if abs(seg_sum - tl["total_seconds"]) > 1e-9:
+            print(f"slo-smoke: timeline segments {seg_sum} != total "
+                  f"{tl['total_seconds']}")
+            return 1
+        print(f"slo-smoke: timeline decomposes ({len(tl['segments'])} "
+              f"segments, total {tl['total_seconds'] * 1e3:.0f} ms)")
+
+        slo = _wait_critical(base)
+        burn = next(o for o in slo["objectives"]
+                    if o["name"] == "latency_p99_normal")
+        print(f"slo-smoke: latency burn critical "
+              f"(binding burn {burn['burn']}, windows "
+              f"{[w['burn'] for w in burn['windows'].values()]})")
+        if slo["shed"]["enabled"] or slo["shed"]["active"]:
+            print(f"slo-smoke: observe-only server claims shedding: {slo['shed']}")
+            return 1
+
+        # Observe-only: a critical burn must NOT shed.
+        board = text_grid.generate(32, 32, seed=1)
+        status, payload, _ = _http("POST", f"{base}/jobs", {
+            "width": 32, "height": 32,
+            "cells": text_grid.encode(board).decode("ascii"), "gen_limit": 2,
+        })
+        if status != 202:
+            print(f"slo-smoke: observe-only server shed a job "
+                  f"(HTTP {status}: {payload})")
+            return 1
+        print("slo-smoke: observe-only accepted under critical burn (202)")
+
+        # SIGUSR1 -> flight dump with the slo state record.
+        proc.send_signal(signal.SIGUSR1)
+        dump = None
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline and dump is None:
+            for path in glob.glob(os.path.join(trace_dir, "flight-*.jsonl")):
+                with open(path, "rb") as f:
+                    for line in f.read().split(b"\n"):
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if (rec.get("record") == "state"
+                                and rec.get("name") == "slo"):
+                            dump = (path, rec)
+            time.sleep(0.25)
+        if dump is None:
+            print(f"slo-smoke: no flight dump with an slo state record "
+                  f"in {trace_dir}")
+            return 1
+        path, rec = dump
+        if rec.get("status") != "critical":
+            print(f"slo-smoke: flight slo state is {rec.get('status')!r}, "
+                  "expected critical")
+            return 1
+        print(f"slo-smoke: flight dump carries SLO state ({path})")
+        report = subprocess.run(
+            [sys.executable, "-m", "gol_tpu", "slo-report", path],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        if report.returncode != 0 or "critical" not in report.stdout:
+            print(f"slo-smoke: gol slo-report failed on the dump: "
+                  f"rc={report.returncode}\n{report.stdout}{report.stderr}")
+            return 1
+        out = _stop(proc)
+        proc = None
+        if "CRITICAL" not in out or "observe-only" not in out:
+            print(f"slo-smoke: observe-only server never logged the "
+                  f"critical burn:\n{out[-2000:]}")
+            return 1
+        print("slo-smoke: observe-only server logged the burn")
+
+        # -- phase B: --slo-shed --------------------------------------------
+        port = _free_port()
+        base = f"http://127.0.0.1:{port}"
+        proc = _start_server(port, os.path.join(workdir, "journal-b"),
+                             os.path.join(workdir, "trace-b"), shed=True)
+        print(f"slo-smoke: shedding server up on {base}")
+        ids = _submit_slow(base)
+        _wait_done(base, ids)
+        _wait_critical(base)
+        status, payload, headers = _http("POST", f"{base}/jobs", {
+            "width": 32, "height": 32,
+            "cells": text_grid.encode(board).decode("ascii"), "gen_limit": 2,
+        })
+        if status != 429:
+            print(f"slo-smoke: shedding server answered HTTP {status} "
+                  f"under critical burn (want 429): {payload}")
+            return 1
+        retry_after = headers.get("Retry-After")
+        if not retry_after or int(retry_after) <= 0:
+            print(f"slo-smoke: 429 without a usable Retry-After "
+                  f"(headers: {headers})")
+            return 1
+        print(f"slo-smoke: shed with 429 + Retry-After {retry_after}s")
+        _stop(proc)
+        proc = None
+
+        print("slo-smoke: PASS — burn tripped on the injected slow bucket, "
+              "observe-only logged + accepted, --slo-shed 429'd with "
+              "Retry-After, flight dump carried SLO state, timeline "
+              "decomposed exactly")
+        rc = 0
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        if rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"slo-smoke: artifacts kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
