@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -71,6 +71,13 @@ pipeline-smoke:
 # SLO state provider, and a completed job's timeline decomposes exactly.
 slo-smoke:
 	python3 tools/slo_smoke.py
+
+# Fleet crash/rebalance smoke (tools/fleet_smoke.py): a 3-worker
+# `gol fleet` takes 100 jobs across 3 buckets, one worker is SIGKILLed
+# mid-batch (its partition replays/rebalances to exactly-once fleet-wide,
+# results oracle-identical), and a cascaded SIGTERM drain exits clean.
+fleet-smoke:
+	python3 tools/fleet_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

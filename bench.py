@@ -1072,6 +1072,230 @@ def _bench_telemetry(args) -> int:
     return 0 if worst >= 0.97 else 1
 
 
+def _bench_fleet(args) -> int:
+    """Sharded-fleet scaling suite (--suite fleet) -> BENCH_r10.json.
+
+    The horizontal question: does adding workers add throughput? One
+    multi-bucket load — 16 equal-work padding buckets (one 160^2 canvas,
+    16 distinct similarity frequencies, each a separately compiled
+    program) — runs through
+
+    1. a **fleet of N in {1, 2, 4} workers** behind the real router
+       (workers are `gol serve` subprocesses on their own journal
+       partitions; jobs submitted over HTTP through the router's
+       bucket-consistent placement), and
+    2. the **single-process resident lane** (Scheduler with resident
+       rings, in-process — the PR-6 fastest solo configuration) as the
+       no-fleet reference point (unpinned: the whole host is its device).
+
+    Two controls keep the comparison about the FLEET tier, not about the
+    shared host:
+
+    - every fleet worker is pinned (`taskset`) to an equal core slice —
+      the fixed per-worker resource budget a real deployment has (one
+      worker per device/host); without it the N=1 worker borrows every
+      core and the suite measures XLA's intra-op scaling instead;
+    - the 16 bucket frequencies are chosen so the rendezvous placement is
+      balanced (4/4/4/4 at N=4, 8/8 at N=2): the suite measures scale-out
+      of a balanceable load — placement imbalance is a policy question the
+      placement tests own, not a throughput question.
+
+    Headline: N=4 aggregate jobs/sec over N=1 (the scale-out acceptance,
+    >= 2.5x on the multi-bucket load). Per-lane aggregate jobs/sec and
+    cell-updates/sec are recorded for `tools/bench_diff.py --metric`
+    gating (e.g. --metric lanes.fleet_n4.jobs_per_sec). rc 0 iff the
+    headline clears 2.5 and every job of every run lands DONE.
+    """
+    import concurrent.futures
+    import shutil
+    import tempfile
+
+    import jax
+
+    from gol_tpu.fleet import client as fleet_client
+    from gol_tpu.fleet.router import RouterServer
+    from gol_tpu.fleet.workers import Fleet
+    from gol_tpu.io import text_grid
+    from gol_tpu.serve.jobs import DONE, JobJournal, new_job
+    from gol_tpu.serve.scheduler import Scheduler
+
+    repeats = args.repeats
+    # Long enough that per-worker compute dominates the fixed
+    # submit/route/poll overhead (~0.4 s per round): at 2000 the N=4 lane
+    # finishes in under a second and the ratio measures the overhead, not
+    # the fleet (2.30x measured); at 6000 compute dominates (3.1x).
+    gen_limit = args.gen_limit if args.gen_limit is not None else 6000
+    side = 160
+    # 16 equal-work buckets: same canvas, distinct similarity frequencies
+    # (a baked program constant, so each is its own bucket). This set
+    # rendezvous-balances over w0..w3 AND over w0..w1 (see docstring).
+    freqs = (2, 3, 4, 5, 6, 7, 8, 9, 10, 12, 14, 17, 18, 21, 24, 27)
+    per_bucket = 8
+    max_batch = 8
+    njobs = len(freqs) * per_bucket
+    cores = os.cpu_count() or 4
+    slice_width = max(1, min(6, (cores - 2) // 4))
+    workroot = tempfile.mkdtemp(prefix="gol-bench-fleet-")
+    print(
+        f"bench fleet: {njobs} jobs across {len(freqs)} equal-work "
+        f"{side}^2 buckets, gen_limit {gen_limit}, repeats {repeats}, "
+        f"{slice_width} cores/worker, platform={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    boards = {
+        freq: [text_grid.generate(side, side, seed=4000 + 100 * freq + i)
+               for i in range(per_bucket)]
+        for freq in freqs
+    }
+    nominal_work = side * side * njobs * gen_limit
+
+    def pin(worker):
+        # w<K> -> its own core slice; the big lane (unused here) and any
+        # respawn keep the same slice.
+        index = int("".join(ch for ch in worker.id if ch.isdigit()) or 0)
+        lo = (index * slice_width) % max(1, cores - slice_width + 1)
+        return ["taskset", "-c", f"{lo}-{lo + slice_width - 1}"]
+
+    def _http(method, url, body=None, timeout=120):
+        # The one fleet stdlib client: HTTP error statuses come back as
+        # (status, payload) so submit_all can REPORT a worker 4xx/5xx
+        # instead of dying on an unhandled HTTPError.
+        return fleet_client.http_json(method, url, body, timeout=timeout)
+
+    def submit_all(base: str) -> None:
+        def one(freq_board):
+            freq, board = freq_board
+            status, payload = _http("POST", f"{base}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": gen_limit,
+                "similarity_frequency": freq,
+            })
+            if status != 202:
+                raise RuntimeError(f"submit rejected HTTP {status}: {payload}")
+
+        work = [(freq, b) for freq, bs in boards.items() for b in bs]
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(one, work))
+
+    def completed(base: str) -> tuple[int, int]:
+        _, snap = _http("GET", f"{base}/metrics?format=json")
+        return (int(snap["counters"].get("jobs_completed_total", 0)),
+                int(snap["counters"].get("jobs_failed_total", 0)))
+
+    def run_round(base: str) -> float:
+        done0, _ = completed(base)
+        t0 = time.perf_counter()
+        submit_all(base)
+        while True:
+            done, failed = completed(base)
+            if failed:
+                raise RuntimeError(f"{failed} job(s) FAILED")
+            if done - done0 >= njobs:
+                return time.perf_counter() - t0
+            time.sleep(0.05)
+
+    def fleet_lane(n_workers: int) -> dict:
+        fleet_dir = os.path.join(workroot, f"fleet-n{n_workers}")
+        fleet = Fleet(fleet_dir, spawn_prefix=pin, serve_args=[
+            "--flush-age", "0.2",
+            "--max-batch", str(max_batch),
+            "--pipeline-depth", "2",
+            "--max-queue-depth", "4096",
+        ])
+        fleet.spawn_fleet(n_workers)
+        router = RouterServer(fleet, port=0)
+        router.start()
+        try:
+            run_round(router.url)  # warm: every bucket compiles on its owner
+            best = min(run_round(router.url) for _ in range(repeats))
+        finally:
+            router.shutdown(cascade=True)
+        rate = njobs / best
+        print(f"  fleet n={n_workers}: {rate:.1f} jobs/s "
+              f"({best:.2f}s for {njobs} jobs)", file=sys.stderr)
+        return {
+            "workers": n_workers,
+            "seconds": round(best, 3),
+            "jobs_per_sec": round(rate, 2),
+            "cell_updates_per_sec": round(nominal_work / best, 1),
+        }
+
+    def solo_resident_lane() -> dict:
+        ring = 4
+        best = None
+        for _ in range(repeats + 1):  # first round doubles as the warm run
+            tmp = tempfile.mkdtemp(dir=workroot)
+            journal = JobJournal(os.path.join(tmp, "journal"))
+            sched = Scheduler(journal=journal, flush_age=0.2,
+                              max_batch=max_batch, pipeline_depth=2 * ring,
+                              resident_ring=ring, max_queue_depth=4096)
+            jobs = [new_job(side, side, b, gen_limit=gen_limit,
+                            similarity_frequency=freq)
+                    for freq, bs in boards.items() for b in bs]
+            for job in jobs:
+                sched.submit(job)
+            sched.start()
+            t0 = time.perf_counter()
+            ok = sched.drain(timeout=900)
+            elapsed = time.perf_counter() - t0
+            sched.stop(drain=False)
+            journal.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not ok or any(j.state != DONE for j in jobs):
+                raise RuntimeError("solo resident lane failed to drain DONE")
+            best = elapsed if best is None else min(best, elapsed)
+        rate = njobs / best
+        print(f"  solo resident (ring {ring}): {rate:.1f} jobs/s "
+              f"({best:.2f}s)", file=sys.stderr)
+        return {
+            "seconds": round(best, 3),
+            "jobs_per_sec": round(rate, 2),
+            "cell_updates_per_sec": round(nominal_work / best, 1),
+        }
+
+    lanes = {}
+    try:
+        lanes["solo_resident"] = solo_resident_lane()
+        for n in (1, 2, 4):
+            lanes[f"fleet_n{n}"] = fleet_lane(n)
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+    scaling = (lanes["fleet_n4"]["jobs_per_sec"]
+               / lanes["fleet_n1"]["jobs_per_sec"])
+    payload = {
+        "metric": "fleet_n4_over_n1_jobs_per_sec",
+        "value": round(scaling, 3),
+        "unit": "x",
+        "vs_baseline": None,  # the N=1 lane IS the baseline; floor is 2.5
+        "load": {
+            "jobs": njobs,
+            "buckets": [f"{side}x{side}/sim{f}" for f in freqs],
+            "per_bucket": per_bucket,
+            "gen_limit": gen_limit,
+            "max_batch": max_batch,
+            "cores_per_worker": slice_width,
+            "nominal_cell_updates": nominal_work,
+            "note": "fleet workers taskset-pinned to equal core slices "
+            "(fixed per-worker budget; the solo resident lane is unpinned "
+            "— whole host); cell_updates_per_sec figures assume gen_limit "
+            "exits (identical boards exit identically across lanes); "
+            "jobs_per_sec is the exact, gated figure",
+        },
+        "lanes": lanes,
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r10.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    print(json.dumps(payload))
+    return 0 if scaling >= 2.5 else 1
+
+
 # Named measurement suites, table-driven: adding one is one line here (plus
 # its _bench_* function) — no if/elif chain to grow. Each entry is
 # (runner, one-line help shown by --list-suites). Suites pin their own
@@ -1098,6 +1322,12 @@ SUITES = {
         "resident mega-batch engine: marginal kernel rate vs end-to-end "
         "serve rate at pipeline depth {1, 2, 4} and the resident ring, "
         "with the dispatch-gap ratio; writes BENCH_r08.json",
+    ),
+    "fleet": (
+        _bench_fleet,
+        "sharded-fleet scaling: aggregate jobs/sec through the router at "
+        "N in {1, 2, 4} core-pinned workers vs the single-process resident "
+        "lane on 16 equal-work 160^2 buckets; writes BENCH_r10.json",
     ),
     "telemetry": (
         _bench_telemetry,

@@ -807,6 +807,96 @@ def _serve(args) -> int:
     return 0
 
 
+def _fleet(args) -> int:
+    """``gol fleet``: the sharded serving fleet — router + N workers.
+
+    Spawns ``--workers`` local ``gol serve`` subprocesses (each on its own
+    journal partition under ``--fleet-dir``) and/or attaches externally
+    managed workers by ``--attach URL`` (the multi-host lane: boot workers
+    wherever ``parallel/bootstrap.py`` put the devices, hand the router
+    their URLs), then serves the single-server HTTP job API unchanged
+    behind bucket-consistent routing (gol_tpu/fleet/).
+
+    Restart story: started on a ``--fleet-dir`` holding a manifest, the
+    router reattaches workers that are still alive and respawns dead local
+    partitions, whose journals replay to exactly-once — killing the router
+    loses nothing. SIGTERM/SIGINT cascade a fleet-wide graceful drain:
+    admission stops at the router, every worker drains, local workers get
+    SIGTERM, then the router exits."""
+    import signal
+
+    from gol_tpu.fleet.router import RouterServer
+    from gol_tpu.fleet.workers import Fleet
+
+    if args.workers < 0:
+        raise ValueError(f"--workers must be >= 0, got {args.workers}")
+    if args.flush_age < 0:
+        raise ValueError(f"--flush-age must be >= 0, got {args.flush_age}")
+    if args.health_interval <= 0:
+        raise ValueError(
+            f"--health-interval must be > 0, got {args.health_interval}"
+        )
+    # Worker flags forwarded verbatim to every spawned `gol serve` —
+    # including --warm-plans, so a tuned fleet pre-compiles each worker's
+    # bucket programs (and the plan cache is shared via GOL_PLAN_CACHE /
+    # the default cache path, exactly as for a single server).
+    serve_args = [
+        "--max-queue-depth", str(args.max_queue_depth),
+        "--max-batch", str(args.max_batch),
+        "--flush-age", str(args.flush_age),
+        "--pipeline-depth", str(args.pipeline_depth),
+        "--slo-latency-p99", str(args.slo_latency_p99),
+        "--sample-interval", str(args.sample_interval),
+    ]
+    if args.resident_ring:
+        serve_args += ["--resident-ring", str(args.resident_ring)]
+    if args.warm_plans:
+        serve_args += ["--warm-plans"]
+    if args.compile_cache:
+        serve_args += ["--compile-cache", args.compile_cache]
+    if args.slo_shed:
+        serve_args += ["--slo-shed"]
+
+    fleet = Fleet(args.fleet_dir, serve_args=serve_args)
+    recovered = fleet.load()
+    if recovered:
+        print(f"reattached {recovered} worker partition(s) from "
+              f"{fleet.manifest_path}", flush=True)
+    for url in args.attach or []:
+        fleet.attach(url)
+    fleet.spawn_fleet(args.workers, big_lane=args.big_lane)
+    if not fleet.workers():
+        raise ValueError(
+            "fleet has no workers: pass --workers N and/or --attach URL"
+        )
+    fleet.start_health(args.health_interval)
+    router = RouterServer(fleet, host=args.host, port=args.port,
+                          big_edge=args.big_edge)
+    stop = {"signaled": False}
+
+    def _on_signal(signum, frame):
+        # Second signal: exit hard (workers' journals replay on restart).
+        if stop["signaled"]:
+            raise SystemExit(1)
+        stop["signaled"] = True
+        import threading
+
+        threading.Thread(
+            target=lambda: router.shutdown(cascade=True), daemon=True
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    roster = ", ".join(f"{w.id}={w.url}" for w in fleet.workers())
+    print(f"fleet router on {router.url} "
+          f"({len(fleet.workers())} workers: {roster})", flush=True)
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def _warm_plans() -> None:
     """Pre-compile the bucket programs of every tuner-recorded serve shape
     (plus the tuned quantum/ladder geometry, consulted implicitly by
@@ -968,25 +1058,13 @@ def _tune(args) -> int:
 
 
 def _http_json(method: str, url: str, body: dict | None = None, timeout=30):
-    """Tiny stdlib JSON client shared by ``gol submit`` (urllib)."""
-    import urllib.error
-    import urllib.request
+    """The ONE stdlib JSON client (``gol_tpu/fleet/client.py`` — jax-free,
+    shared with the router/health loops): HTTP errors come back as
+    (status, payload), connection trouble raises for the callers'
+    retry/timeout logic."""
+    from gol_tpu.fleet import client as fleet_client
 
-    data = None
-    headers = {"Accept": "application/json"}
-    if body is not None:
-        data = json.dumps(body).encode("utf-8")
-        headers["Content-Type"] = "application/json"
-    req = urllib.request.Request(url, data=data, headers=headers, method=method)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, json.loads(resp.read().decode("utf-8"))
-    except urllib.error.HTTPError as e:
-        try:
-            payload = json.loads(e.read().decode("utf-8"))
-        except (ValueError, UnicodeDecodeError):
-            payload = {"error": str(e)}
-        return e.code, payload
+    return fleet_client.http_json(method, url, body, timeout=timeout)
 
 
 def _submit(args) -> int:
@@ -1007,8 +1085,25 @@ def _submit(args) -> int:
     if height <= 0:
         height = DEFAULT_HEIGHT
     base = args.server.rstrip("/")
-    ids = {}
-    for path in args.input_files:
+    # --shard-across: against a fleet router, fan the multi-board submit
+    # round-robin over the fleet's workers directly (GET /fleet lists
+    # them); against a single `gol serve` — no /fleet endpoint — the flag
+    # is a no-op and every job goes to --server as always.
+    targets = [base]
+    if args.shard_across:
+        membership = _fetch_json(f"{base}/fleet")
+        urls = [
+            str(w["url"]).rstrip("/")
+            for w in membership.get("workers", [])
+            if w.get("url") and w.get("healthy", True) and not w.get("big")
+        ]
+        if urls:
+            targets = urls
+            print(f"gol submit: sharding {len(args.input_files)} board(s) "
+                  f"across {len(urls)} fleet worker(s)", file=sys.stderr)
+    ids = {}  # job id -> (input path, server base the job lives on)
+    for i, path in enumerate(args.input_files):
+        target = targets[i % len(targets)]
         grid = text_grid.read_grid(path, width, height)
         body = {
             "width": width,
@@ -1020,12 +1115,12 @@ def _submit(args) -> int:
         }
         if args.deadline is not None:
             body["deadline_s"] = args.deadline
-        status, payload = _http_json("POST", f"{base}/jobs", body)
+        status, payload = _http_json("POST", f"{target}/jobs", body)
         if status != 202:
             print(f"gol submit: {path}: HTTP {status}: "
                   f"{payload.get('error', payload)}", file=sys.stderr)
             return 1
-        ids[payload["id"]] = path
+        ids[payload["id"]] = (path, target)
         print(f"{path}\t{payload['id']}")
     if not args.wait:
         return 0
@@ -1035,29 +1130,67 @@ def _submit(args) -> int:
     outdir = args.output_dir
     if outdir:
         os.makedirs(outdir, exist_ok=True)
-    pending = dict(ids)
+    return _collect_results(dict(ids), args, outdir)
+
+
+def _collect_results(pending: dict, args, outdir) -> int:
+    """Poll every submitted job to a terminal state and write its result.
+
+    ``pending`` maps job id -> (input path, server base URL) — with
+    ``--shard-across`` the bases differ per job, so contact tracking is
+    PER TARGET: one dead worker (e.g. respawned by its fleet on a new
+    port, unreachable at the URL this client recorded) abandons only ITS
+    jobs after ``--server-timeout`` of no contact; jobs on healthy
+    targets keep completing. Connection errors and 5xx answers are both
+    transient-with-timeout — the server-restart/worker-respawn windows
+    the journal-replay story is built for."""
+    import time as _time
+    import urllib.error
+
     rc = 0
-    last_contact = time.perf_counter()
+    now = time.perf_counter()
+    last_contact = {base: now for _, base in pending.values()}
     while pending:
         _time.sleep(args.poll_interval)
+        stale_this_sweep = set()  # targets already found down this sweep
         for job_id in list(pending):
+            path, job_base = pending[job_id]
+            if job_base in stale_this_sweep:
+                continue
+
+            def target_down(detail):
+                stale_this_sweep.add(job_base)
+                if (time.perf_counter() - last_contact[job_base]
+                        <= args.server_timeout):
+                    return False  # transient so far; retry next sweep
+                victims = [j for j, (_, b) in pending.items()
+                           if b == job_base]
+                print(
+                    f"gol submit: no contact with {job_base} for "
+                    f"{args.server_timeout:.0f}s ({detail}); giving up on "
+                    f"{len(victims)} job(s) there",
+                    file=sys.stderr,
+                )
+                for j in victims:
+                    del pending[j]
+                return True
+
             try:
-                status, payload = _http_json("GET", f"{base}/jobs/{job_id}")
+                status, payload = _http_json("GET",
+                                             f"{job_base}/jobs/{job_id}")
             except (urllib.error.URLError, ConnectionError, OSError) as e:
-                # Transient connection loss — notably the server-restart
-                # window the journal-replay story is built for (kill,
-                # restart, replay). Keep polling; only a sustained outage
-                # aborts the client.
-                if time.perf_counter() - last_contact > args.server_timeout:
-                    print(
-                        f"gol submit: no contact with {base} for "
-                        f"{args.server_timeout:.0f}s ({e}); giving up with "
-                        f"{len(pending)} job(s) unfetched",
-                        file=sys.stderr,
-                    )
-                    return 1
-                break  # retry the sweep after the poll interval
-            last_contact = time.perf_counter()
+                if target_down(e):
+                    rc = 1
+                continue
+            if status >= 500:
+                # A fleet router whose worker is mid-respawn answers 503
+                # while the partition replays; same treatment as a
+                # connection error. (Contact is only refreshed by real
+                # answers, so a permanently-5xxing target times out.)
+                if target_down(f"HTTP {status}"):
+                    rc = 1
+                continue
+            last_contact[job_base] = time.perf_counter()
             if status != 200:
                 print(f"gol submit: lost job {job_id}: HTTP {status}",
                       file=sys.stderr)
@@ -1067,17 +1200,22 @@ def _submit(args) -> int:
             state = payload["state"]
             if state in ("queued", "scheduled", "running"):
                 continue
-            path = pending.pop(job_id)
+            del pending[job_id]
             if state != "done":
                 print(f"gol submit: {path}: job {state}: "
                       f"{payload.get('error', '')}", file=sys.stderr)
                 rc = 1
                 continue
             try:
-                status, result = _http_json("GET", f"{base}/result/{job_id}")
+                status, result = _http_json(
+                    "GET", f"{job_base}/result/{job_id}"
+                )
             except (urllib.error.URLError, ConnectionError, OSError):
-                pending[job_id] = path  # refetch on the next sweep
-                break
+                pending[job_id] = (path, job_base)  # refetch next sweep
+                continue
+            if status >= 500:
+                pending[job_id] = (path, job_base)  # refetch next sweep
+                continue
             if status != 200:
                 print(f"gol submit: {path}: result fetch HTTP {status}",
                       file=sys.stderr)
@@ -1094,7 +1232,7 @@ def _submit(args) -> int:
             text_grid.write_grid(out_path, grid)
             print(f"{path}\tGenerations:\t{result['generations']}\t"
                   f"{result['exit_reason']}\t-> {out_path}"
-                  f"{_submit_latency_note(base, job_id)}")
+                  f"{_submit_latency_note(job_base, job_id)}")
     return rc
 
 
@@ -1549,6 +1687,69 @@ def build_parser() -> argparse.ArgumentParser:
     )
     srv.set_defaults(func=_serve)
 
+    flt = sub.add_parser(
+        "fleet",
+        help="run the sharded serving fleet: a router front-end over N "
+        "`gol serve` workers (same HTTP job API, bucket-consistent "
+        "routing, partitioned journals, health-aware placement, "
+        "fleet-wide drain)",
+    )
+    flt.add_argument("--host", default="127.0.0.1")
+    flt.add_argument("--port", type=int, default=8000,
+                     help="router listen port (0 = pick a free one)")
+    flt.add_argument(
+        "--workers", type=int, default=2, metavar="N",
+        help="local worker subprocesses to run (default 2; partitions "
+        "recovered from an existing --fleet-dir manifest count toward N)",
+    )
+    flt.add_argument(
+        "--attach", action="append", default=[], metavar="URL",
+        help="adopt an externally managed `gol serve` by URL (repeatable; "
+        "the multi-host lane — boot workers where parallel/bootstrap.py "
+        "put the devices, hand the router their URLs). Attached workers "
+        "are health-checked and routed around, never respawned",
+    )
+    flt.add_argument(
+        "--fleet-dir", default="./fleet", metavar="D",
+        help="fleet state directory: the membership manifest plus one "
+        "journal partition per local worker (default ./fleet). Restarting "
+        "on the same directory reattaches live workers and respawns dead "
+        "partitions, whose journals replay to exactly-once",
+    )
+    flt.add_argument(
+        "--big-lane", action="store_true",
+        help="spawn one dedicated worker for oversized boards (padded "
+        "edge > --big-edge): giant compiles and batches never block the "
+        "bucket workers",
+    )
+    flt.add_argument(
+        "--big-edge", type=int, default=1024, metavar="N",
+        help="padded board edge above which jobs route to the big-lane "
+        "worker when one exists (default 1024)",
+    )
+    flt.add_argument(
+        "--health-interval", type=float, default=1.0, metavar="S",
+        help="seconds between worker health/burn probes (default 1)",
+    )
+    # Worker passthrough flags (forwarded to every spawned `gol serve`).
+    flt.add_argument("--max-queue-depth", type=int, default=1024)
+    flt.add_argument("--max-batch", type=int, default=64)
+    flt.add_argument("--flush-age", type=float, default=0.05, metavar="S")
+    flt.add_argument("--pipeline-depth", type=int, default=1)
+    flt.add_argument("--resident-ring", type=int, default=0, metavar="R")
+    flt.add_argument(
+        "--warm-plans", action="store_true",
+        help="each worker pre-compiles its tuner-recorded bucket programs "
+        "at boot (per-worker plan warm-up from the shared plan cache)",
+    )
+    flt.add_argument("--compile-cache", default=None, metavar="DIR")
+    flt.add_argument("--slo-shed", action="store_true")
+    flt.add_argument("--slo-latency-p99", type=float, default=60.0,
+                     metavar="S")
+    flt.add_argument("--sample-interval", type=float, default=1.0,
+                     metavar="S")
+    flt.set_defaults(func=_fleet)
+
     tun = sub.add_parser(
         "tune",
         help="offline measured search: pick kernel/depth/block/bucket plans "
@@ -1675,6 +1876,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sbm.add_argument("--output-dir", default=None,
                      help="write results here (default: next to each input)")
+    sbm.add_argument(
+        "--shard-across", action="store_true",
+        help="against a fleet router (`gol fleet`), fan the boards "
+        "round-robin over the fleet's workers directly (GET /fleet lists "
+        "them) instead of routing every submit through the front-end; "
+        "a no-op against a single `gol serve`",
+    )
     sbm.set_defaults(func=_submit)
 
     bat = sub.add_parser(
@@ -1703,8 +1911,8 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in (
-        "run", "generate", "show", "serve", "submit", "batch", "tune",
-        "trace-report", "top", "slo-report", "-h", "--help"
+        "run", "generate", "show", "serve", "fleet", "submit", "batch",
+        "tune", "trace-report", "top", "slo-report", "-h", "--help"
     ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)

@@ -1,0 +1,27 @@
+"""The horizontal serving tier: a router front-end over N serve workers.
+
+``gol serve`` (PRs 2-7) is one process on one device; this package is the
+fleet around it — the analog of the reference promoting one rank's loop to
+an ``MPI_Cart_create`` topology of ranks:
+
+- ``placement``  — deterministic bucket -> worker mapping (rendezvous
+  hashing; the process-to-node mapping problem of PAPERS, solved so each
+  worker's <= 7-program-per-bucket compile budget and resident rings stay
+  hot on one worker);
+- ``workers``    — membership: spawn local ``gol serve`` subprocesses on
+  journal partitions, or attach multi-host workers by URL; the manifest,
+  health/burn probing, supervised respawn, fleet-wide drain;
+- ``router``     — the HTTP front-end: single-server API unchanged,
+  bucket-routed submits with 429/unreachable spillover, fleet-merged
+  ``/metrics`` + ``/slo``, ``/fleet`` membership, cascaded ``/drain``;
+- ``client``     — the stdlib HTTP JSON client all of the above share.
+
+The whole package is jax-free on purpose: the router owns no device, and a
+fleet process must boot (and restart) in milliseconds, not at import-jax
+speed. Exactly-once across the fleet is the sum of the per-partition
+journals — the router persists nothing but the membership manifest.
+"""
+
+from gol_tpu.fleet.placement import PLACEMENT_QUANTUM, PlacementKey  # noqa: F401
+from gol_tpu.fleet.router import RouterServer  # noqa: F401
+from gol_tpu.fleet.workers import Fleet, Worker  # noqa: F401

@@ -1,0 +1,70 @@
+"""Stdlib HTTP JSON client for the fleet tier.
+
+Deliberately jax-free (like the rest of ``gol_tpu/fleet``): the router is a
+front-end process — it parses a request far enough to *place* it and then
+moves bytes; the workers own the devices. Everything here is urllib over
+persistent-nothing (one request per connection is fine at router rates;
+the hot path is the worker's compute, not the hop).
+
+``http_json`` mirrors ``gol_tpu.cli._http_json``'s contract — HTTP errors
+come back as (status, payload) so callers branch on codes, while genuine
+connection trouble (refused, reset, timeout) raises ``OSError``/``URLError``
+for the caller's liveness logic to classify.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+def http_json(
+    method: str,
+    url: str,
+    body: dict | None = None,
+    *,
+    raw: bytes | None = None,
+    timeout: float = 30.0,
+):
+    """One JSON exchange -> (status, payload).
+
+    ``raw`` forwards pre-encoded bytes verbatim (the router's submit path:
+    the client's body was already parsed for placement; re-encoding a 17 MB
+    board a second time would be pure tax). HTTP error statuses return
+    normally; connection-level failures raise (URLError/OSError).
+    """
+    if body is not None and raw is not None:
+        raise ValueError("pass body or raw, not both")
+    data = raw
+    headers = {"Accept": "application/json"}
+    if body is not None:
+        data = json.dumps(body).encode("utf-8")
+    if data is not None:
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, _parse(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, _parse(e.read())
+
+
+def _parse(raw: bytes):
+    try:
+        return json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return {"error": raw[:200].decode("utf-8", "replace")}
+
+
+def probe(url: str, path: str = "/healthz", timeout: float = 2.0) -> dict | None:
+    """GET url+path -> payload dict, or None when unreachable/unhealthy —
+    the liveness primitive the health loop and manifest reattach share."""
+    try:
+        status, payload = http_json("GET", url.rstrip("/") + path,
+                                    timeout=timeout)
+    except (urllib.error.URLError, ConnectionError, OSError, ValueError):
+        return None
+    if status != 200 or not isinstance(payload, dict):
+        return None
+    return payload

@@ -1,0 +1,581 @@
+"""The fleet router: one HTTP front-end over N serving workers.
+
+Exposes the single-server job API **unchanged** — clients built against
+``gol serve`` (``gol submit``, ``gol top``, curl loops) talk to a router
+without modification — and adds the fleet surfaces:
+
+- ``POST /jobs``      — placed by padding bucket (``fleet/placement``:
+  rendezvous-hashed, so a bucket's compiled programs and resident rings
+  stay hot on ONE worker), forwarded verbatim. A worker that 429s or is
+  unreachable spills to the next-ranked worker before the client sees an
+  error; oversized boards (padded edge > ``big_edge``) go to the dedicated
+  big-lane worker when the fleet has one. The 202 payload gains a
+  ``worker`` field.
+- ``GET /jobs/<id>``, ``/jobs/<id>/timeline``, ``GET /result/<id>``,
+  ``DELETE /jobs/<id>`` — forwarded to the owning worker (an in-memory
+  id->worker map, rebuilt lazily by broadcast after a router restart: the
+  workers' journals are the durable truth, the router keeps none).
+- ``GET /metrics``    — fleet-merged: counters and gauges sum across
+  workers, histogram quantiles take the worst worker (a conservative
+  upper bound — true fleet quantiles would need raw samples);
+  ``?format=json`` carries the merged view top-level (same schema as one
+  worker, so dashboards work unchanged) plus per-worker snapshots under
+  ``workers`` and membership under ``fleet``.
+- ``GET /slo``        — overall status is the worst worker's; objectives
+  are every worker's, names prefixed ``<worker>:``.
+- ``GET /fleet``      — membership: per-worker id/url/pid/health (what
+  ``gol submit --shard-across`` and ``gol top`` read).
+- ``POST /drain``     — fleet-wide cascade: admission stops here first,
+  then every worker drains concurrently; responds when all are quiescent.
+- ``GET /healthz``    — router liveness + fleet stats.
+
+The router owns no device and no journal: exactly-once is the sum of the
+partitions' journals (see ``fleet/workers``), which is why killing the
+router loses nothing — restart, ``Fleet.load()``, keep serving.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+import errno
+import socket
+
+from gol_tpu.fleet import client, placement
+from gol_tpu.fleet.workers import Fleet, Worker
+from gol_tpu.obs.registry import Registry, _fmt
+
+logger = logging.getLogger(__name__)
+
+_MAX_BODY = 64 << 20  # the worker-side cap; the router must not be tighter
+
+# SLO status ordering for the fleet-wide worst-of merge.
+_SLO_RANK = {"ok": 0, "warning": 1, "critical": 2}
+
+
+def _delivery_impossible(err: BaseException) -> bool:
+    """Whether a submit-forward failure GUARANTEES the request never
+    reached the worker — the only failures safe to spill to another
+    worker (anything ambiguous, e.g. a timeout mid-exchange, may have
+    been accepted and journaled; re-sending would run the board twice).
+    Connection refused, DNS failure, and host/network-unreachable all
+    fail before a byte is delivered."""
+    reason = getattr(err, "reason", err)
+    if not isinstance(reason, BaseException):
+        reason = err
+    if isinstance(reason, (ConnectionRefusedError, socket.gaierror)):
+        return True
+    return isinstance(reason, OSError) and reason.errno in (
+        errno.EHOSTUNREACH, errno.ENETUNREACH,
+        getattr(errno, "EHOSTDOWN", errno.EHOSTUNREACH),
+    )
+
+
+# -- pure merge helpers (unit-tested without HTTP) --------------------------
+
+def merge_metrics(snapshots: dict[str, dict]) -> dict:
+    """Merge per-worker /metrics JSON snapshots into one fleet view.
+
+    Counters and extensive gauges SUM (fleet queue depth is the sum of
+    worker queues; fleet boards/sec is the sum of worker rates). INTENSIVE
+    gauges — ratios and occupancies, which live in [0, 1] per worker — take
+    the MAX (summing four workers' 0.9 dispatch-gap ratios into 3.6 would
+    be nonsense; the worst worker is the figure an operator acts on).
+    Histogram ``count``/``sum`` sum; quantiles take the MAX across workers
+    — the honest aggregate without raw samples is "no worker is worse than
+    this", which is the bound an operator alerts on anyway."""
+    counters: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    hists: dict[str, dict] = {}
+    for snap in snapshots.values():
+        for name, value in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + value
+        for name, value in (snap.get("gauges") or {}).items():
+            if any(hint in name for hint in ("ratio", "occupancy")):
+                prev = gauges.get(name)
+                gauges[name] = value if prev is None else max(prev, value)
+            else:
+                gauges[name] = gauges.get(name, 0) + value
+        for name, summary in (snap.get("histograms") or {}).items():
+            out = hists.setdefault(name, {"count": 0, "sum": 0.0})
+            out["count"] += summary.get("count") or 0
+            out["sum"] += summary.get("sum") or 0.0
+            for key, value in summary.items():
+                if key.startswith("p") and value is not None:
+                    prev = out.get(key)
+                    out[key] = value if prev is None else max(prev, value)
+    return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
+def merged_prometheus(merged: dict, fleet_gauges: dict) -> str:
+    """Prometheus text for the merged snapshot, in the worker registry's
+    exposition shape (same ``gol_serve_`` series names, sum semantics) plus
+    ``gol_fleet_*`` membership gauges."""
+    lines: list[str] = []
+    for name, value in sorted(merged.get("counters", {}).items()):
+        lines.append(f"# TYPE gol_serve_{name} counter")
+        lines.append(f"gol_serve_{name} {_fmt(value)}")
+    for name, value in sorted(merged.get("gauges", {}).items()):
+        lines.append(f"# TYPE gol_serve_{name} gauge")
+        lines.append(f"gol_serve_{name} {_fmt(value)}")
+    for name, summary in sorted(merged.get("histograms", {}).items()):
+        lines.append(f"# TYPE gol_serve_{name} summary")
+        for q in (0.5, 0.95, 0.99):
+            v = summary.get(f"p{int(q * 100)}")
+            if v is not None:
+                lines.append(f'gol_serve_{name}{{quantile="{q}"}} {_fmt(v)}')
+        lines.append(f"gol_serve_{name}_sum {_fmt(summary['sum'])}")
+        lines.append(f"gol_serve_{name}_count {_fmt(summary['count'])}")
+    for name, value in sorted(fleet_gauges.items()):
+        lines.append(f"# TYPE gol_fleet_{name} gauge")
+        lines.append(f"gol_fleet_{name} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_slo(statuses: dict[str, dict | None]) -> dict:
+    """Merge per-worker /slo payloads: worst status wins, every objective
+    is listed under ``<worker>:<name>``, shedding is any-worker. An
+    unreachable worker degrades the headline — at least ``warning``, and
+    ``critical`` when NO worker answered: a fleet serving nothing must
+    never show a green status to the surface that exists to catch it."""
+    overall = "ok"
+    objectives = []
+    windows = None
+    shed_enabled = shed_active = False
+    unreachable = []
+    for worker_id, status in sorted(statuses.items()):
+        if not status:
+            unreachable.append(worker_id)
+            continue
+        if _SLO_RANK.get(status.get("status"), 0) > _SLO_RANK[overall]:
+            overall = status["status"]
+        if windows is None:
+            windows = status.get("windows_s")
+        shed = status.get("shed") or {}
+        shed_enabled = shed_enabled or bool(shed.get("enabled"))
+        shed_active = shed_active or bool(shed.get("active"))
+        for obj in status.get("objectives") or []:
+            objectives.append({**obj, "name": f"{worker_id}:{obj['name']}"})
+    if unreachable:
+        floor = "critical" if len(unreachable) == len(statuses) else "warning"
+        if _SLO_RANK[floor] > _SLO_RANK[overall]:
+            overall = floor
+    return {
+        "status": overall,
+        "windows_s": windows or [],
+        "shed": {"enabled": shed_enabled, "active": shed_active},
+        "objectives": objectives,
+        "unreachable": unreachable,
+        "workers": {
+            wid: (status if status else {"status": "unreachable"})
+            for wid, status in statuses.items()
+        },
+    }
+
+
+class RouterServer:
+    """The routing process: membership + placement + HTTP front end."""
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        big_edge: int = 1024,
+        http=client.http_json,
+        submit_timeout: float = 120.0,
+    ):
+        if big_edge < placement.PLACEMENT_QUANTUM:
+            raise ValueError(
+                f"big_edge must be >= {placement.PLACEMENT_QUANTUM}, "
+                f"got {big_edge}"
+            )
+        self.fleet = fleet
+        self.big_edge = big_edge
+        self.http = http
+        self.submit_timeout = submit_timeout
+        self.registry = Registry(prefix="gol_fleet")
+        # job id -> worker id, memory only (the partitions are the truth;
+        # a miss rebuilds by broadcast). Bounded: entries evict when their
+        # result/cancellation is fetched, with a FIFO cap as the backstop
+        # for jobs whose results nobody ever collects — a router fronting
+        # millions of jobs must not grow a dict forever.
+        self._jobs: dict[str, str] = {}
+        self._jobs_cap = 65536
+        self._jobs_lock = threading.Lock()
+        self._draining = False
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="gol-fleet-http", daemon=True
+        )
+        self._thread.start()
+        logger.info("fleet router listening on %s", self.url)
+
+    def serve_forever(self) -> None:
+        logger.info("fleet router listening on %s", self.url)
+        self.httpd.serve_forever()
+
+    def drain(self, timeout: float = 600.0) -> dict:
+        """Fleet-wide graceful drain: stop admission HERE first (new jobs
+        get 429 at the front door), then cascade to every worker."""
+        self._draining = True
+        results = self.fleet.drain_all(timeout=timeout)
+        return {
+            "drained": bool(results) and all(
+                r.get("drained") for r in results.values()
+            ),
+            "workers": results,
+        }
+
+    def shutdown(self, cascade: bool = True) -> None:
+        """Stop serving; with ``cascade`` (the SIGTERM path) drain the
+        whole fleet and SIGTERM local workers first. ``cascade=False``
+        abandons the workers untouched — the router-restart lane."""
+        if cascade:
+            self.drain()
+            self.fleet.stop_health()
+            self.fleet.terminate()
+        else:
+            self.fleet.stop_health()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- placement + forwarding --------------------------------------------
+
+    def candidates(self, key: placement.PlacementKey) -> list[Worker]:
+        """Ranked forwarding order for one bucket: the rendezvous owner
+        first, spillover next; workers the health loop marked unhealthy or
+        backpressured sink to the tail (tried only when nothing better is
+        left — routing around a worker must not turn into rejecting jobs
+        the moment the last healthy worker wobbles)."""
+        workers = {w.id: w for w in self.fleet.workers() if w.url}
+        if not workers:
+            return []
+        normal = [w for w in workers.values() if not w.big]
+        bigs = [w for w in workers.values() if w.big]
+        pool = normal or list(workers.values())
+        ranked = [workers[wid] for wid in placement.rank(
+            key.label(), [w.id for w in pool]
+        )]
+        if bigs and key.max_edge > self.big_edge:
+            big_ranked = [workers[wid] for wid in placement.rank(
+                key.label(), [w.id for w in bigs]
+            )]
+            ranked = big_ranked + [w for w in ranked if not w.big]
+        order = [w for w in ranked if w.healthy and not w.backpressure]
+        order += [w for w in ranked if w.healthy and w.backpressure]
+        order += [w for w in ranked if not w.healthy]
+        return order
+
+    def route_submit(self, raw: bytes):
+        """(status, payload) for POST /jobs: place, forward, spill."""
+        if self._draining:
+            self.registry.inc("jobs_rejected_total")
+            return 429, {"error": "fleet is draining; not accepting jobs"}
+        body = json.loads(raw.decode("utf-8"))
+        if not isinstance(body, dict):
+            raise ValueError("request body must be a JSON object")
+        key = placement.key_for(body)  # raises -> handler's 400
+        order = self.candidates(key)
+        if not order:
+            return 503, {"error": "fleet has no routable workers"}
+        last = (503, {"error": "no worker accepted the job"})
+        for worker in order:
+            try:
+                status, payload = self.http(
+                    "POST", worker.url + "/jobs", raw=raw,
+                    timeout=self.submit_timeout,
+                )
+            except (urllib.error.URLError, ConnectionError, OSError) as err:
+                self.registry.inc("route_errors_total")
+                if not _delivery_impossible(err):
+                    # A timeout/reset AFTER the bytes went out is ambiguous
+                    # — the worker may have accepted and journaled the job
+                    # (first-dispatch compiles can outlive submit_timeout).
+                    # Spilling here would run the board twice under two
+                    # ids; surface the ambiguity instead and let the
+                    # client decide (poll /fleet, resubmit knowingly).
+                    return 504, {
+                        "error": f"worker {worker.id} did not answer the "
+                                 "submit in time; outcome unknown — the "
+                                 "job may have been accepted there",
+                    }
+                # Nothing was delivered: spilling is safe.
+                last = (503, {
+                    "error": f"worker {worker.id} unreachable: {err}",
+                })
+                continue
+            if status == 429:
+                # The worker is shedding (SLO burn) or full: drain it of
+                # new work and spill to the next-ranked worker — the
+                # client only sees a 429 when the WHOLE fleet sheds.
+                self.fleet.note_shed(worker.id)
+                self.registry.inc("route_sheds_total")
+                last = (status, payload)
+                continue
+            if status == 202 and isinstance(payload, dict) and "id" in payload:
+                with self._jobs_lock:
+                    self._jobs[payload["id"]] = worker.id
+                    while len(self._jobs) > self._jobs_cap:
+                        # FIFO: dict order is insertion order; a dropped
+                        # mapping costs one broadcast on the next lookup.
+                        self._jobs.pop(next(iter(self._jobs)))
+                self.registry.inc("jobs_routed_total")
+                self.registry.inc(
+                    "jobs_routed_total_" + ("big" if worker.big else worker.id)
+                )
+                payload = {**payload, "worker": worker.id}
+            # Client errors (400) return from the first worker verbatim:
+            # a malformed job is malformed everywhere.
+            return status, payload
+        return last
+
+    def forward_job(self, method: str, job_id: str, suffix: str = ""):
+        """(status, payload) for the per-job endpoints: the mapped worker
+        first, then broadcast (the map is memory-only; after a router
+        restart the workers' journals are the only truth and whoever
+        answers non-404 owns the job)."""
+        path = ("/result/" if suffix == "result" else "/jobs/") + job_id
+        if suffix not in ("", "result"):
+            path = f"/jobs/{job_id}/{suffix}"
+        with self._jobs_lock:
+            owner = self._jobs.get(job_id)
+        workers = self.fleet.workers()
+        ordered = sorted(
+            [w for w in workers if w.url],
+            key=lambda w: w.id != owner,  # mapped owner first
+        )
+        # A worker mid-(re)boot has no URL yet; the job may be in its
+        # partition (replaying right now), so "not found" would be a lie —
+        # it counts as unreachable, which clients treat as transient.
+        unreachable = sum(1 for w in workers if not w.url)
+        for worker in ordered:
+            try:
+                status, payload = self.http(method, worker.url + path,
+                                            timeout=30)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                unreachable += 1
+                continue
+            if status == 404:
+                continue
+            # The mapping's useful life ends when the client collects the
+            # terminal answer: a fetched result (200) or tombstone (410 =
+            # failed/cancelled), or a successful DELETE. Evict then — the
+            # rare re-fetch pays one broadcast; the map stays bounded.
+            terminal = (
+                (suffix == "result" and status in (200, 410))
+                or (method == "DELETE" and status == 200)
+            )
+            with self._jobs_lock:
+                if terminal:
+                    self._jobs.pop(job_id, None)
+                elif owner is None:
+                    self._jobs.setdefault(job_id, worker.id)
+                    while len(self._jobs) > self._jobs_cap:
+                        self._jobs.pop(next(iter(self._jobs)))
+            return status, payload
+        if unreachable:
+            # The job may live on the unreachable worker(s): "not found"
+            # would be a lie, and clients treat 5xx as transient (the
+            # worker-respawn window) — exactly the semantics wanted here.
+            return 503, {"error": f"job {job_id} not found on reachable "
+                                  f"workers; {unreachable} worker(s) "
+                                  "unreachable"}
+        return 404, {"error": f"unknown job {job_id}"}
+
+    # -- merged observability ----------------------------------------------
+
+    def _collect(self, path: str) -> dict[str, dict | None]:
+        """Fetch one path from every worker CONCURRENTLY: with a serial
+        sweep, each unreachable worker would add its full connect timeout
+        to every /metrics and /slo response — freezing `gol top` and
+        blowing scrape deadlines exactly during the outage the operator
+        is debugging."""
+        workers = self.fleet.workers()
+        out: dict[str, dict | None] = {w.id: None for w in workers}
+        lock = threading.Lock()
+
+        def fetch(worker: Worker):
+            payload = None
+            if worker.url is not None:
+                try:
+                    status, body = self.http("GET", worker.url + path,
+                                             timeout=5)
+                    if status == 200 and isinstance(body, dict):
+                        payload = body
+                except (urllib.error.URLError, ConnectionError, OSError):
+                    payload = None
+            with lock:
+                out[worker.id] = payload
+
+        threads = [threading.Thread(target=fetch, args=(w,), daemon=True)
+                   for w in workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        return out
+
+    def metrics_json(self) -> dict:
+        snaps = self._collect("/metrics?format=json")
+        merged = merge_metrics({k: v for k, v in snaps.items() if v})
+        health = {w.id: w.public() for w in self.fleet.workers()}
+        workers = {}
+        for wid, snap in snaps.items():
+            entry = dict(snap) if snap else {"unreachable": True}
+            entry["health"] = health.get(wid, {})
+            workers[wid] = entry
+        merged["workers"] = workers
+        merged["fleet"] = {
+            **self.fleet.stats(),
+            "draining": self._draining,
+            "router": self.registry.snapshot(),
+        }
+        return merged
+
+    def metrics_prometheus(self) -> str:
+        snaps = self._collect("/metrics?format=json")
+        merged = merge_metrics({k: v for k, v in snaps.items() if v})
+        stats = self.fleet.stats()
+        fleet_gauges = {
+            "workers": stats["workers"],
+            "workers_healthy": stats["healthy"],
+            "workers_backpressured": stats["backpressured"],
+            "worker_restarts": stats["restarts"],
+            "jobs_routed_total": self.registry.counter("jobs_routed_total"),
+            "route_sheds_total": self.registry.counter("route_sheds_total"),
+            "route_errors_total": self.registry.counter("route_errors_total"),
+        }
+        return merged_prometheus(merged, fleet_gauges)
+
+    def slo_json(self) -> dict:
+        return merge_slo(self._collect("/slo"))
+
+    def fleet_json(self) -> dict:
+        return {
+            "fleet_dir": self.fleet.fleet_dir,
+            "draining": self._draining,
+            "big_edge": self.big_edge,
+            "workers": [w.public() for w in self.fleet.workers()],
+        }
+
+
+def _make_handler(router: RouterServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        timeout = 120  # a submit forward can sit behind a worker compile
+
+        def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+            logger.debug("%s - %s", self.address_string(), format % args)
+
+        def _reply(self, code: int, payload, content_type="application/json",
+                   headers=None):
+            body = (
+                json.dumps(payload).encode("utf-8")
+                if content_type == "application/json"
+                else payload.encode("utf-8")
+            )
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
+            if code >= 400:
+                self.send_header("Connection", "close")
+                self.close_connection = True
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_raw(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > _MAX_BODY:
+                raise ValueError(f"body of {length} bytes exceeds {_MAX_BODY}")
+            return self.rfile.read(length) if length else b"{}"
+
+        def do_POST(self):
+            path = urlparse(self.path).path
+            try:
+                if path == "/jobs":
+                    status, payload = router.route_submit(self._read_raw())
+                    headers = None
+                    if status == 429 and "retry_after_s" in (payload or {}):
+                        headers = {"Retry-After":
+                                   str(int(payload["retry_after_s"]))}
+                    self._reply(status, payload, headers=headers)
+                elif path == "/drain":
+                    self._read_raw()
+                    self._reply(200, router.drain())
+                else:
+                    self._read_raw()
+                    self._reply(404, {"error": f"no such endpoint {path}"})
+            except (ValueError, KeyError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": str(e)})
+
+        def do_DELETE(self):
+            path = urlparse(self.path).path
+            if not path.startswith("/jobs/"):
+                self._reply(404, {"error": f"no such endpoint {path}"})
+                return
+            job_id = path[len("/jobs/"):]
+            self._reply(*router.forward_job("DELETE", job_id))
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            path = parsed.path
+            if path.startswith("/jobs/"):
+                rest = path[len("/jobs/"):]
+                if rest.endswith("/timeline"):
+                    self._reply(*router.forward_job(
+                        "GET", rest[: -len("/timeline")], "timeline"
+                    ))
+                else:
+                    self._reply(*router.forward_job("GET", rest))
+            elif path.startswith("/result/"):
+                self._reply(*router.forward_job(
+                    "GET", path[len("/result/"):], "result"
+                ))
+            elif path == "/metrics":
+                fmt = parse_qs(parsed.query).get("format", ["prometheus"])[0]
+                if fmt == "json":
+                    self._reply(200, router.metrics_json())
+                else:
+                    self._reply(200, router.metrics_prometheus(),
+                                content_type="text/plain; version=0.0.4")
+            elif path == "/slo":
+                self._reply(200, router.slo_json())
+            elif path == "/fleet":
+                self._reply(200, router.fleet_json())
+            elif path == "/healthz":
+                self._reply(200, {
+                    "ok": True,
+                    "router": True,
+                    "draining": router._draining,
+                    "fleet": router.fleet.stats(),
+                })
+            else:
+                self._reply(404, {"error": f"no such endpoint {path}"})
+
+    return Handler

@@ -1,0 +1,581 @@
+"""Fleet membership: worker processes, the partitioned journal, health.
+
+One ``Fleet`` owns N serving workers. A worker is either
+
+- **local** — a ``gol serve`` subprocess this process spawned, bound to its
+  own journal *partition* (``<fleet_dir>/<worker_id>/``). Local workers are
+  supervised: a dead or unresponsive one is SIGKILLed (never leave two
+  writers on one journal) and respawned on the SAME partition, whose
+  replay-on-start (PR 2) finishes every accepted job exactly once; or
+- **attached** — an externally managed ``gol serve`` reached by URL (the
+  multi-host lane: boot workers wherever ``parallel/bootstrap.py`` put the
+  devices, hand the router their URLs). Attached workers are health-checked
+  and routed around, never respawned — their journals are theirs.
+
+The **manifest** (``<fleet_dir>/manifest.json``, written atomically) is the
+router-side membership record: every partition's id, journal subdir, last
+URL, and pid. A restarted router reads it and *reattaches* — workers that
+survived the router keep serving uninterrupted (probed live by URL), dead
+local partitions are respawned and replay themselves. Fleet-wide
+exactly-once needs nothing more: every job lives in exactly one partition,
+and each partition's journal already guarantees exactly-once within it.
+
+Health rides the PR-7 obs/SLO surfaces: liveness is ``GET /healthz``,
+burn-awareness is ``GET /slo`` — a worker whose SLO status is critical (or
+actively shedding 429s) is marked ``backpressure`` and drained of NEW work
+by the router's placement before clients ever see a 429.
+
+Clocks: ``time.perf_counter`` only (the serve/obs wall-clock ban extends to
+this package via tests/test_lint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from gol_tpu.fleet import client
+
+logger = logging.getLogger(__name__)
+
+MANIFEST = "manifest.json"
+_URL_RE = re.compile(rb"serving on (http://\S+)")
+
+
+@dataclasses.dataclass
+class Worker:
+    """One serving worker as the fleet sees it."""
+
+    id: str
+    url: str | None = None
+    journal_dir: str | None = None  # partition dir; None for attached
+    big: bool = False  # the oversized-board lane
+    attached: bool = False  # by-URL: never spawned or respawned here
+    proc: subprocess.Popen | None = None
+    pid: int | None = None  # survives manifest round-trips (proc does not)
+    log_path: str | None = None
+    log_offset: int = 0  # where THIS boot's log starts (the log appends)
+    healthy: bool = True
+    backpressure: bool = False  # SLO-critical / shedding: no NEW work
+    failures: int = 0  # consecutive failed liveness probes
+    restarts: int = 0
+
+    def manifest_record(self) -> dict:
+        return {
+            "id": self.id,
+            "url": self.url,
+            "journal": (os.path.basename(self.journal_dir)
+                        if self.journal_dir else None),
+            "big": self.big,
+            "attached": self.attached,
+            "pid": self.pid,
+        }
+
+    def public(self) -> dict:
+        """What GET /fleet shows (and what tools/fleet_smoke.py kills by)."""
+        return {
+            "id": self.id,
+            "url": self.url,
+            "big": self.big,
+            "attached": self.attached,
+            "healthy": self.healthy,
+            "backpressure": self.backpressure,
+            "pid": self.pid,
+            "restarts": self.restarts,
+        }
+
+
+class Fleet:
+    """Membership + manifest + supervision for one set of workers."""
+
+    def __init__(
+        self,
+        fleet_dir: str,
+        serve_args: tuple | list = (),
+        fail_after: int = 3,
+        boot_timeout: float = 180.0,
+        probe=client.probe,
+        http=client.http_json,
+        spawn_prefix=None,
+    ):
+        self.fleet_dir = fleet_dir
+        os.makedirs(fleet_dir, exist_ok=True)
+        self.serve_args = list(serve_args)
+        # Optional command prefix per worker (callable Worker -> [str]):
+        # e.g. a `taskset -c` core slice so every worker gets an equal,
+        # fixed resource budget on a shared host (the bench suite's
+        # scale-out control; a real fleet gives each worker its own device).
+        self._spawn_prefix = spawn_prefix
+        self.fail_after = fail_after
+        self.boot_timeout = boot_timeout
+        self._probe = probe
+        self._http = http
+        self._lock = threading.Lock()
+        self._workers: dict[str, Worker] = {}
+        self._health_thread: threading.Thread | None = None
+        self._health_stop = threading.Event()
+
+    # -- membership --------------------------------------------------------
+
+    def workers(self) -> list[Worker]:
+        with self._lock:
+            return list(self._workers.values())
+
+    def worker(self, worker_id: str) -> Worker | None:
+        with self._lock:
+            return self._workers.get(worker_id)
+
+    def _add(self, worker: Worker) -> Worker:
+        with self._lock:
+            if worker.id in self._workers:
+                raise ValueError(f"duplicate worker id {worker.id}")
+            self._workers[worker.id] = worker
+        self.write_manifest()
+        return worker
+
+    def _next_id(self, big: bool) -> str:
+        with self._lock:
+            prefix = "big" if big else "w"
+            n = 0
+            while f"{prefix}{n}" in self._workers:
+                n += 1
+            return f"{prefix}{n}"
+
+    def attach(self, url: str, worker_id: str | None = None,
+               big: bool = False) -> Worker:
+        """Adopt an externally managed worker by URL (multi-host lane).
+
+        Idempotent on the URL: a restarted ``gol fleet`` passes the same
+        ``--attach`` flags it was launched with AND recovers the same URLs
+        from the manifest — re-adding would double-count the worker in
+        membership, merged metrics, and round-robin sharding."""
+        url = url.rstrip("/")
+        with self._lock:
+            for worker in self._workers.values():
+                if worker.url == url:
+                    return worker
+        return self._add(Worker(
+            id=worker_id or self._next_id(big),
+            url=url,
+            attached=True,
+            big=big,
+        ))
+
+    def spawn(self, worker_id: str | None = None, big: bool = False) -> Worker:
+        """Spawn one local worker and wait until it serves."""
+        worker = self._launch(Worker(id=worker_id or self._next_id(big),
+                                     big=big))
+        self._add(worker)
+        self._await_ready(worker)
+        self.write_manifest()
+        return worker
+
+    def spawn_fleet(self, n_workers: int, big_lane: bool = False) -> None:
+        """Bring the LOCAL worker count up to ``n_workers`` (+ the big lane),
+        launching every missing process first and then waiting for all —
+        boots overlap, so N workers cost one boot of wall clock."""
+        launched = []
+        with self._lock:
+            locals_ = [w for w in self._workers.values()
+                       if not w.attached and not w.big]
+            have_big = any(w.big for w in self._workers.values())
+        for _ in range(max(0, n_workers - len(locals_))):
+            worker = self._launch(Worker(id=self._next_id(big=False)))
+            self._add(worker)
+            launched.append(worker)
+        if big_lane and not have_big:
+            worker = self._launch(Worker(id=self._next_id(big=True), big=True))
+            self._add(worker)
+            launched.append(worker)
+        for worker in launched:
+            self._await_ready(worker)
+        if launched:
+            self.write_manifest()
+
+    # -- local process management ------------------------------------------
+
+    def _launch(self, worker: Worker) -> Worker:
+        """Start the ``gol serve`` subprocess for one partition (does not
+        wait for readiness — ``_await_ready`` does)."""
+        import gol_tpu
+
+        worker.journal_dir = worker.journal_dir or os.path.join(
+            self.fleet_dir, worker.id
+        )
+        os.makedirs(worker.journal_dir, exist_ok=True)
+        worker.log_path = worker.log_path or os.path.join(
+            self.fleet_dir, f"{worker.id}.log"
+        )
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(gol_tpu.__file__)
+        ))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        prefix = list(self._spawn_prefix(worker)) if self._spawn_prefix else []
+        cmd = [
+            *prefix,
+            sys.executable, "-m", "gol_tpu", "serve",
+            "--port", "0",
+            "--journal-dir", worker.journal_dir,
+            *self.serve_args,
+        ]
+        # Log to a file, not a pipe: nothing to drain, boots can overlap,
+        # and the worker's logs survive it for post-mortems.
+        with open(worker.log_path, "ab") as logf:
+            logf.write(b"\n")  # boot boundary
+            logf.flush()
+            # Parse only THIS boot's output for the URL banner: the log
+            # appends across respawns, and the previous boot's banner names
+            # a port nobody listens on anymore.
+            worker.log_offset = logf.tell()
+            worker.proc = subprocess.Popen(
+                cmd, env=env, stdout=logf, stderr=subprocess.STDOUT
+            )
+        worker.pid = worker.proc.pid
+        worker.url = None  # learned from the boot banner
+        logger.info("fleet: launched worker %s (pid %d) on partition %s",
+                    worker.id, worker.pid, worker.journal_dir)
+        return worker
+
+    def _await_ready(self, worker: Worker) -> None:
+        """Wait for the worker's ``serving on <url>`` banner, then for
+        ``/healthz``. Raises RuntimeError (with a log tail) on a dead boot."""
+        deadline = time.perf_counter() + self.boot_timeout
+        while time.perf_counter() < deadline:
+            if worker.proc is not None and worker.proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker {worker.id} died on boot "
+                    f"(rc={worker.proc.returncode}):\n{self._log_tail(worker)}"
+                )
+            if worker.url is None:
+                matches = _URL_RE.findall(
+                    self._read_log(worker)[worker.log_offset:]
+                )
+                if matches:
+                    worker.url = matches[0].decode("ascii").rstrip("/")
+            if worker.url is not None and self._probe(worker.url) is not None:
+                worker.healthy = True
+                worker.failures = 0
+                return
+            time.sleep(0.1)
+        raise RuntimeError(
+            f"worker {worker.id} did not become healthy within "
+            f"{self.boot_timeout:.0f}s:\n{self._log_tail(worker)}"
+        )
+
+    def _read_log(self, worker: Worker) -> bytes:
+        try:
+            with open(worker.log_path, "rb") as f:
+                return f.read()
+        except OSError:
+            return b""
+
+    def _log_tail(self, worker: Worker, n: int = 3000) -> str:
+        return self._read_log(worker)[-n:].decode("utf-8", "replace")
+
+    @staticmethod
+    def _looks_like_worker(pid: int) -> bool:
+        """Whether the pid is (still) a gol_tpu process. Guards manifest-
+        recovered pids against reuse: after a host reboot the partition's
+        recorded pid may belong to a stranger, and 'never two journal
+        writers' only requires the ORIGINAL worker dead — killing whatever
+        now holds the number would be a supervision bug, not supervision."""
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                return b"gol_tpu" in f.read()
+        except OSError:
+            return False  # gone, or no /proc: never kill blind
+
+    @classmethod
+    def _ensure_dead(cls, pid: int | None, timeout: float = 10.0) -> None:
+        """SIGKILL a (cmdline-verified) worker pid and wait for it to
+        vanish. Called before EVERY respawn of an adopted partition: two
+        live processes appending one partition's journal would weld records
+        and break the exactly-once replay contract — an unresponsive-but-
+        alive worker must die before its successor boots."""
+        if pid is None or not cls._looks_like_worker(pid):
+            return
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return
+            time.sleep(0.05)
+        logger.error("fleet: pid %d survived SIGKILL for %.0fs", pid, timeout)
+
+    def _respawn(self, worker: Worker) -> None:
+        if worker.proc is not None:
+            # Our own child: the Popen handle cannot suffer pid reuse
+            # (the zombie holds the pid until we reap it here).
+            if worker.proc.poll() is None:
+                worker.proc.kill()
+            try:
+                worker.proc.wait(timeout=10)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+        else:
+            # Adopted from the manifest: cmdline-verified kill.
+            self._ensure_dead(worker.pid)
+        worker.restarts += 1
+        worker.healthy = False
+        worker.backpressure = False
+        worker.failures = 0
+        logger.warning(
+            "fleet: respawning worker %s on partition %s (restart #%d); "
+            "its journal replays every unfinished job",
+            worker.id, worker.journal_dir, worker.restarts,
+        )
+        try:
+            self._launch(worker)
+            self._await_ready(worker)
+        except (RuntimeError, OSError) as err:
+            logger.error("fleet: respawn of %s failed (%s); retrying on the "
+                         "next health tick", worker.id, err)
+            return
+        self.write_manifest()
+
+    # -- manifest ----------------------------------------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.fleet_dir, MANIFEST)
+
+    def write_manifest(self) -> None:
+        with self._lock:
+            doc = {
+                "version": 1,
+                "partitions": [w.manifest_record()
+                               for w in self._workers.values()],
+            }
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.manifest_path)
+
+    def load(self) -> int:
+        """Reattach the fleet a previous router left behind (the router-
+        restart lane). For every manifest partition: a worker answering at
+        its recorded URL is adopted live (its jobs were never in danger);
+        a dead LOCAL partition is respawned there and replays its journal;
+        a dead attached worker is kept unhealthy and probed by the health
+        loop until it returns. Returns the number of partitions recovered."""
+        if not os.path.exists(self.manifest_path):
+            return 0
+        with open(self.manifest_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        n = 0
+        for rec in doc.get("partitions", []):
+            worker = Worker(
+                id=rec["id"],
+                url=rec.get("url"),
+                journal_dir=(os.path.join(self.fleet_dir, rec["journal"])
+                             if rec.get("journal") else None),
+                big=bool(rec.get("big")),
+                attached=bool(rec.get("attached")),
+                pid=rec.get("pid"),
+            )
+            alive = worker.url is not None and self._probe(worker.url) is not None
+            if alive:
+                logger.info("fleet: reattached live worker %s at %s",
+                            worker.id, worker.url)
+            elif worker.attached:
+                worker.healthy = False
+                logger.warning("fleet: attached worker %s unreachable at %s; "
+                               "will keep probing", worker.id, worker.url)
+            else:
+                self._add(worker)
+                self._respawn(worker)
+                n += 1
+                continue
+            self._add(worker)
+            n += 1
+        return n
+
+    # -- health ------------------------------------------------------------
+
+    def note_shed(self, worker_id: str) -> None:
+        """The router observed this worker 429 a submit: stop routing new
+        work there until the health loop sees its SLO recover."""
+        worker = self.worker(worker_id)
+        if worker is not None and not worker.backpressure:
+            worker.backpressure = True
+            logger.warning("fleet: worker %s is shedding; draining it of "
+                           "new work", worker_id)
+
+    def check_worker(self, worker: Worker) -> None:
+        """One health tick for one worker: liveness via /healthz, burn via
+        /slo, respawn for dead local processes."""
+        if worker.proc is not None and worker.proc.poll() is not None:
+            logger.warning("fleet: worker %s (pid %s) exited rc=%s",
+                           worker.id, worker.pid, worker.proc.returncode)
+            self._respawn(worker)
+            return
+        if worker.url is None:
+            # A boot that outlived _await_ready's patience (e.g.
+            # --warm-plans compiling on a loaded host) but whose process is
+            # alive: keep looking for its banner every tick — otherwise the
+            # worker serves forever on a port the router never learns and
+            # its partition is stranded.
+            if worker.proc is None or worker.proc.poll() is not None:
+                return
+            matches = _URL_RE.findall(
+                self._read_log(worker)[worker.log_offset:]
+            )
+            if not matches:
+                return
+            worker.url = matches[0].decode("ascii").rstrip("/")
+            self.write_manifest()
+        hz = self._probe(worker.url)
+        if hz is None:
+            worker.failures += 1
+            if worker.failures >= self.fail_after:
+                if worker.healthy:
+                    logger.warning(
+                        "fleet: worker %s failed %d consecutive liveness "
+                        "probes; routing around it", worker.id, worker.failures,
+                    )
+                worker.healthy = False
+                if not worker.attached:
+                    self._respawn(worker)
+            return
+        worker.failures = 0
+        worker.healthy = True
+        slo = self._probe(worker.url, "/slo")
+        if slo is not None:
+            burning = (
+                slo.get("status") == "critical"
+                or bool((slo.get("shed") or {}).get("active"))
+            )
+            if burning and not worker.backpressure:
+                logger.warning("fleet: worker %s SLO burn is critical; "
+                               "draining it of new work", worker.id)
+            if worker.backpressure and not burning:
+                logger.info("fleet: worker %s recovered; routing to it again",
+                            worker.id)
+            worker.backpressure = burning
+
+    def health_tick(self) -> None:
+        for worker in self.workers():
+            self.check_worker(worker)
+
+    def start_health(self, interval: float = 1.0) -> None:
+        if self._health_thread is not None:
+            return
+        self._health_stop.clear()
+
+        def loop():
+            while not self._health_stop.wait(interval):
+                try:
+                    self.health_tick()
+                except Exception:  # noqa: BLE001 - supervision must survive
+                    logger.exception("fleet: health tick failed")
+
+        self._health_thread = threading.Thread(
+            target=loop, name="gol-fleet-health", daemon=True
+        )
+        self._health_thread.start()
+
+    def stop_health(self) -> None:
+        if self._health_thread is None:
+            return
+        self._health_stop.set()
+        self._health_thread.join(timeout=self.boot_timeout + 15)
+        self._health_thread = None
+
+    # -- fleet-wide drain / shutdown ---------------------------------------
+
+    def drain_all(self, timeout: float = 600.0) -> dict:
+        """Cascade POST /drain to every worker concurrently; returns
+        {worker_id: {"drained": bool, ...}} when all are quiescent (or
+        unreachable)."""
+        results: dict[str, dict] = {}
+        lock = threading.Lock()
+
+        def drain_one(worker: Worker):
+            out = {"drained": False}
+            if worker.url is not None:
+                try:
+                    status, payload = self._http(
+                        "POST", worker.url + "/drain", body={},
+                        timeout=timeout,
+                    )
+                    if status == 200 and isinstance(payload, dict):
+                        out = payload
+                    else:
+                        out = {"drained": False, "status": status}
+                except (OSError, ValueError) as err:
+                    out = {"drained": False, "error": str(err)}
+            with lock:
+                results[worker.id] = out
+
+        threads = [
+            threading.Thread(target=drain_one, args=(w,), daemon=True)
+            for w in self.workers()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 10)
+        return results
+
+    def terminate(self, timeout: float = 30.0) -> None:
+        """SIGTERM every LOCAL worker (their own graceful-drain path) and
+        wait; escalate to SIGKILL past the timeout. Attached workers are
+        not ours to stop."""
+        victims = [w for w in self.workers() if not w.attached]
+        for worker in victims:
+            if worker.proc is not None:
+                if worker.proc.poll() is None:
+                    worker.proc.terminate()
+            elif worker.pid is not None and self._looks_like_worker(worker.pid):
+                try:
+                    os.kill(worker.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.perf_counter() + timeout
+        for worker in victims:
+            if worker.proc is not None:
+                try:
+                    worker.proc.wait(
+                        timeout=max(0.1, deadline - time.perf_counter())
+                    )
+                except subprocess.TimeoutExpired:
+                    logger.error("fleet: worker %s ignored SIGTERM; killing",
+                                 worker.id)
+                    worker.proc.kill()
+                    worker.proc.wait(timeout=10)
+            elif worker.pid is not None:
+                while time.perf_counter() < deadline:
+                    try:
+                        os.kill(worker.pid, 0)
+                    except ProcessLookupError:
+                        break
+                    time.sleep(0.1)
+                else:
+                    self._ensure_dead(worker.pid)
+
+    def stats(self) -> dict:
+        workers = self.workers()
+        return {
+            "workers": len(workers),
+            "healthy": sum(w.healthy for w in workers),
+            "backpressured": sum(w.backpressure for w in workers),
+            "big_lane": any(w.big for w in workers),
+            "restarts": sum(w.restarts for w in workers),
+        }

@@ -55,12 +55,23 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
     phists = process.get("histograms") or {}
 
     overall = (slo or {}).get("status", "?")
+    fleet = metrics.get("fleet") or {}
     lines = [
         f"{title} — SLO {_color(overall, overall.upper(), ansi)}"
         + ("" if metrics else "   [/metrics unreachable]")
         + ("" if slo else "   [/slo unreachable]"),
-        "",
     ]
+    if fleet:
+        # A fleet router's payload: the merged series render below exactly
+        # as a single worker's would; this line says what they sum over.
+        lines.append(
+            f"fleet: {int(fleet.get('workers', 0))} workers, "
+            f"{int(fleet.get('healthy', 0))} healthy, "
+            f"{int(fleet.get('backpressured', 0))} backpressured, "
+            f"{int(fleet.get('restarts', 0))} restart(s)"
+            + ("   DRAINING" if fleet.get("draining") else "")
+        )
+    lines.append("")
 
     # -- queue / flow -------------------------------------------------------
     lines.append("queue")
@@ -150,6 +161,40 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
             ratio = gauges.get(f"dispatch_gap_ratio_{bucket}")
             extra = f"   gap {_fmt(ratio)}" if ratio is not None else ""
             lines.append(f"    {bucket:<28} {_fmt(rate):>12}{extra}")
+
+    # -- per-worker columns (fleet router payloads only) --------------------
+    workers = metrics.get("workers") or {}
+    if workers:
+        slo_workers = (slo or {}).get("workers") or {}
+        lines.append("")
+        lines.append(
+            f"  {'worker':<8} {'state':<13} {'queue':>6} {'inflight':>8} "
+            f"{'done':>9} {'failed':>7} {'boards/s':>10} {'slo':>12}"
+        )
+        for wid in sorted(workers):
+            snap = workers[wid] or {}
+            health = snap.get("health") or {}
+            if snap.get("unreachable"):
+                state, state_status = "unreachable", "critical"
+            elif not health.get("healthy", True):
+                state, state_status = "unhealthy", "critical"
+            elif health.get("backpressure"):
+                state, state_status = "backpressured", "warning"
+            else:
+                state, state_status = "ok", "ok"
+            wg = snap.get("gauges") or {}
+            wc = snap.get("counters") or {}
+            wslo = (slo_workers.get(wid) or {}).get("status", "-")
+            lines.append(
+                f"  {wid:<8} "
+                + _color(state_status, f"{state:<13}", ansi)
+                + f" {int(wg.get('queue_depth', 0)):>6}"
+                f" {int(wg.get('inflight_batches', 0)):>8}"
+                f" {int(wc.get('jobs_completed_total', 0)):>9}"
+                f" {int(wc.get('jobs_failed_total', 0)):>7}"
+                f" {_fmt(wg.get('boards_per_sec')):>10} "
+                + _color(wslo, f"{wslo:>12}", ansi)
+            )
 
     return "\n".join(lines) + "\n"
 

@@ -1,0 +1,732 @@
+"""The sharded serving fleet (gol_tpu/fleet/): placement determinism,
+manifest round-trips, merged observability, the router over real in-process
+workers, spillover routing, and the router-restart replay story.
+
+The load-bearing assertions mirror the serve suite one level up: a job
+through the ROUTER ends byte-identical to the oracle, lands on exactly one
+worker's journal partition, and survives a router kill+restart without
+being lost or double-run — fleet-wide exactly-once is the sum of the
+partitions' journals.
+"""
+
+import json
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu import oracle
+from gol_tpu.config import GameConfig
+from gol_tpu.fleet import placement
+from gol_tpu.fleet.router import (
+    RouterServer, merge_metrics, merge_slo, merged_prometheus,
+)
+from gol_tpu.fleet.workers import Fleet
+from gol_tpu.io import text_grid
+from gol_tpu.serve import batcher
+from gol_tpu.serve.server import GolServer
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _wait(predicate, timeout=60.0, interval=0.02):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class TestPlacement:
+    def test_quantum_matches_batcher_builtin(self):
+        """The router rounds extents with the serve batcher's built-in
+        quantum (restated, not imported — the router is jax-free); the two
+        constants must never drift."""
+        assert placement.PLACEMENT_QUANTUM == batcher.PAD_QUANTUM
+
+    def test_key_rounding_and_label(self):
+        k = placement.key_for({"width": 30, "height": 30})
+        assert (k.height, k.width) == (32, 32)
+        assert k.label() == "32x32/c"
+        k = placement.key_for({"width": 33, "height": 65,
+                               "convention": "cuda"})
+        assert (k.height, k.width) == (96, 64)
+        assert k.max_edge == 96
+        nosim = placement.key_for({"width": 8, "height": 8,
+                                   "check_similarity": False})
+        assert "nosim" in nosim.label()
+        # Same serve bucket -> same placement key (the affinity contract).
+        assert placement.key_for({"width": 30, "height": 30}) == \
+            placement.key_for({"width": 32, "height": 29})
+
+    def test_key_rejects_malformed(self):
+        with pytest.raises((ValueError, TypeError)):
+            placement.key_for({"width": 0, "height": 8})
+        with pytest.raises((ValueError, TypeError)):
+            placement.key_for({"width": "x", "height": 8})
+        with pytest.raises(TypeError):
+            placement.key_for({"width": 8, "height": 8,
+                               "check_similarity": "false"})
+        with pytest.raises(KeyError):
+            placement.key_for({"height": 8})
+
+    def test_rank_deterministic_and_spreading(self):
+        ids = ["w0", "w1", "w2"]
+        labels = [f"{32 * i}x{32 * i}/c" for i in range(1, 21)]
+        owners = {placement.rank(lbl, ids)[0] for lbl in labels}
+        # Rendezvous hashing must actually spread buckets across workers.
+        assert len(owners) >= 2
+        for lbl in labels:
+            assert placement.rank(lbl, ids) == placement.rank(lbl, ids)
+            assert sorted(placement.rank(lbl, ids)) == sorted(ids)
+
+    def test_rank_minimal_disruption(self):
+        """Removing one worker must move ONLY that worker's buckets: the
+        relative order of the survivors is unchanged for every bucket (the
+        compile-budget story — a membership change must not reshuffle hot
+        buckets between surviving workers)."""
+        ids = ["w0", "w1", "w2", "w3"]
+        for i in range(1, 30):
+            lbl = f"{32 * i}x{32 * i}/c"
+            full = placement.rank(lbl, ids)
+            without = placement.rank(lbl, [w for w in ids if w != "w2"])
+            assert without == [w for w in full if w != "w2"]
+
+
+class TestManifest:
+    def test_round_trip_and_dead_attached_kept(self, tmp_path):
+        fleet = Fleet(str(tmp_path / "fleet"),
+                      probe=lambda *a, **k: None)  # nothing is reachable
+        fleet.attach("http://127.0.0.1:1/", "wa")
+        fleet.attach("http://127.0.0.1:2", "wb", big=True)
+        doc = json.loads(open(fleet.manifest_path).read())
+        assert {p["id"] for p in doc["partitions"]} == {"wa", "wb"}
+        assert all(p["attached"] for p in doc["partitions"])
+        big = next(p for p in doc["partitions"] if p["id"] == "wb")
+        assert big["big"] is True
+
+        # A fresh fleet (a restarted router) reloads membership; the dead
+        # attached workers are kept unhealthy, not dropped — the health
+        # loop keeps probing them.
+        fleet2 = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        assert fleet2.load() == 2
+        assert {w.id for w in fleet2.workers()} == {"wa", "wb"}
+        assert all(not w.healthy for w in fleet2.workers())
+        assert fleet2.worker("wa").url == "http://127.0.0.1:1"
+
+    def test_load_reattaches_live_workers(self, tmp_path):
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://127.0.0.1:9", "wa")
+        fleet2 = Fleet(str(tmp_path / "fleet"),
+                       probe=lambda url, path="/healthz", **k: {"ok": True})
+        assert fleet2.load() == 1
+        assert fleet2.worker("wa").healthy
+
+    def test_attach_is_idempotent_on_url(self, tmp_path):
+        """A restarted `gol fleet` recovers a URL from the manifest AND is
+        handed the same --attach flag again: one server must stay ONE
+        membership entry (a duplicate would double-count merged metrics
+        and double-weight round-robin sharding)."""
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        a = fleet.attach("http://127.0.0.1:9", "wa")
+        again = fleet.attach("http://127.0.0.1:9/")  # trailing-slash form
+        assert again is a
+        assert len(fleet.workers()) == 1
+
+    def test_slow_boot_worker_is_adopted_by_health_tick(self, tmp_path):
+        """A respawn whose boot outlives _await_ready's patience must not
+        strand the partition: the health tick keeps parsing the boot
+        banner and adopts the URL once it appears."""
+        fleet = Fleet(str(tmp_path / "fleet"),
+                      probe=lambda url, path="/healthz", **k: {"ok": True})
+        log = tmp_path / "w0.log"
+        log.write_bytes(b"warming...\n")
+        from gol_tpu.fleet.workers import Worker
+
+        w = Worker(id="w0", url=None, journal_dir=str(tmp_path / "w0"),
+                   log_path=str(log), log_offset=0,
+                   proc=types.SimpleNamespace(poll=lambda: None, pid=1))
+        fleet._workers["w0"] = w
+        fleet.check_worker(w)
+        assert w.url is None  # no banner yet; still waiting, not stranded
+        log.write_bytes(b"warming...\nserving on http://127.0.0.1:7777\n")
+        fleet.check_worker(w)
+        assert w.url == "http://127.0.0.1:7777"
+        assert w.healthy
+
+
+class TestMerge:
+    def test_metrics_merge_sums_and_bounds(self):
+        merged = merge_metrics({
+            "w0": {"counters": {"jobs_completed_total": 3},
+                   "gauges": {"queue_depth": 2},
+                   "histograms": {"run_latency_seconds":
+                                  {"count": 3, "sum": 1.5, "p50": 0.5,
+                                   "p99": 2.0}}},
+            "w1": {"counters": {"jobs_completed_total": 4,
+                                "jobs_failed_total": 1},
+                   "gauges": {"queue_depth": 5},
+                   "histograms": {"run_latency_seconds":
+                                  {"count": 1, "sum": 9.0, "p50": 1.5,
+                                   "p99": 1.0}}},
+        })
+        assert merged["counters"] == {"jobs_completed_total": 7,
+                                      "jobs_failed_total": 1}
+        assert merged["gauges"] == {"queue_depth": 7}
+        hist = merged["histograms"]["run_latency_seconds"]
+        assert hist["count"] == 4 and hist["sum"] == 10.5
+        # Quantiles merge as the WORST worker: a conservative upper bound.
+        assert hist["p50"] == 1.5 and hist["p99"] == 2.0
+
+    def test_ratio_gauges_merge_by_max_not_sum(self):
+        """Intensive gauges (ratios/occupancies, [0,1] per worker) must not
+        sum: four workers at 0.9 are NOT at 3.6 of the roofline."""
+        merged = merge_metrics({
+            "w0": {"gauges": {"dispatch_gap_ratio": 0.9,
+                              "ring_slot_occupancy": 0.5,
+                              "boards_per_sec": 10.0}},
+            "w1": {"gauges": {"dispatch_gap_ratio": 0.4,
+                              "ring_slot_occupancy": 0.75,
+                              "boards_per_sec": 20.0}},
+        })
+        assert merged["gauges"]["dispatch_gap_ratio"] == 0.9
+        assert merged["gauges"]["ring_slot_occupancy"] == 0.75
+        assert merged["gauges"]["boards_per_sec"] == 30.0
+
+    def test_prometheus_text_shape(self):
+        merged = merge_metrics({"w0": {"counters": {"jobs_accepted_total": 2},
+                                       "gauges": {}, "histograms": {}}})
+        text = merged_prometheus(merged, {"workers": 3})
+        assert "gol_serve_jobs_accepted_total 2" in text
+        assert "gol_fleet_workers 3" in text
+
+    def test_slo_merge_worst_wins_and_prefixes(self):
+        merged = merge_slo({
+            "w0": {"status": "ok", "windows_s": [60, 300],
+                   "shed": {"enabled": False, "active": False},
+                   "objectives": [{"name": "latency_p99_high",
+                                   "status": "ok", "burn": 0.1}]},
+            "w1": {"status": "critical", "windows_s": [60, 300],
+                   "shed": {"enabled": True, "active": True},
+                   "objectives": [{"name": "error_rate",
+                                   "status": "critical", "burn": 4.0}]},
+            "w2": None,
+        })
+        assert merged["status"] == "critical"
+        assert merged["shed"] == {"enabled": True, "active": True}
+        assert {o["name"] for o in merged["objectives"]} == {
+            "w0:latency_p99_high", "w1:error_rate"}
+        assert merged["unreachable"] == ["w2"]
+        assert merged["workers"]["w2"]["status"] == "unreachable"
+
+    def test_slo_merge_unreachable_degrades_headline(self):
+        """A fleet serving nothing must never show green: all workers
+        unreachable -> critical; some unreachable -> at least warning."""
+        ok = {"status": "ok", "windows_s": [60],
+              "shed": {"enabled": False, "active": False}, "objectives": []}
+        assert merge_slo({"w0": None, "w1": None})["status"] == "critical"
+        assert merge_slo({"w0": ok, "w1": None})["status"] == "warning"
+        assert merge_slo({"w0": ok, "w1": dict(ok, status="critical")}
+                         )["status"] == "critical"
+
+
+class TestTopFleetRendering:
+    def test_per_worker_columns_and_fleet_line(self):
+        from gol_tpu.obs import top as obs_top
+
+        metrics = {
+            "counters": {"jobs_accepted_total": 4},
+            "gauges": {"queue_depth": 1},
+            "histograms": {},
+            "fleet": {"workers": 2, "healthy": 1, "backpressured": 1,
+                      "restarts": 3, "draining": False},
+            "workers": {
+                "w0": {"health": {"healthy": True, "backpressure": False},
+                       "gauges": {"queue_depth": 1, "boards_per_sec": 9.5},
+                       "counters": {"jobs_completed_total": 3}},
+                "w1": {"unreachable": True, "health": {"healthy": False}},
+            },
+        }
+        slo = {"status": "warning",
+               "workers": {"w0": {"status": "ok"},
+                           "w1": {"status": "unreachable"}}}
+        frame = obs_top.render_frame(metrics, slo, ansi=False)
+        assert "fleet: 2 workers, 1 healthy, 1 backpressured" in frame
+        assert "w0" in frame and "w1" in frame
+        assert "unreachable" in frame
+        # A single-server payload renders with no fleet section at all.
+        solo = obs_top.render_frame({"counters": {}, "gauges": {},
+                                     "histograms": {}}, None, ansi=False)
+        assert "fleet:" not in solo and "worker" not in solo
+
+
+class _Rig(types.SimpleNamespace):
+    pass
+
+
+@pytest.fixture
+def rig(tmp_path):
+    """Two real in-process workers attached by URL behind a real router —
+    the integration surface without subprocess boot costs."""
+    workers = {}
+    for wid in ("w0", "w1"):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / wid),
+                        flush_age=0.01)
+        srv.start()
+        workers[wid] = srv
+    fleet = Fleet(str(tmp_path / "fleet"))
+    for wid, srv in workers.items():
+        fleet.attach(srv.url, wid)
+    router = RouterServer(fleet, port=0)
+    router.start()
+    r = _Rig(router=router, fleet=fleet, workers=workers, tmp=tmp_path)
+    yield r
+    router.shutdown(cascade=False)
+    for srv in workers.values():
+        srv.shutdown()
+
+
+def _submit(base, board, gen_limit=12, **extra):
+    status, payload = _http("POST", f"{base}/jobs", {
+        "width": board.shape[1], "height": board.shape[0],
+        "cells": text_grid.encode(board).decode("ascii"),
+        "gen_limit": gen_limit, **extra,
+    })
+    return status, payload
+
+
+class TestRouter:
+    def test_routed_jobs_bucket_affinity_results_and_timeline(self, rig):
+        base = rig.router.url
+        boards, ids, owners = {}, {}, {}
+        for i in range(8):
+            side = 32 if i % 2 == 0 else 30
+            board = text_grid.generate(side, side, seed=500 + i)
+            status, payload = _submit(base, board)
+            assert status == 202, payload
+            assert payload["worker"] in rig.workers
+            boards[payload["id"]] = board
+            ids[payload["id"]] = side
+            owners.setdefault(side, set()).add(payload["worker"])
+        # Bucket -> worker affinity: every job of one bucket lands on ONE
+        # worker (the compiled program stays hot there).
+        for side, who in owners.items():
+            assert len(who) == 1, owners
+
+        def all_done():
+            return all(
+                _http("GET", f"{base}/jobs/{j}")[1].get("state") == "done"
+                for j in boards
+            )
+        assert _wait(all_done)
+        for job_id, board in boards.items():
+            status, result = _http("GET", f"{base}/result/{job_id}")
+            assert status == 200
+            want = oracle.run(board, GameConfig(gen_limit=12))
+            got = text_grid.decode(result["grid"].encode("ascii"),
+                                   result["width"], result["height"])
+            np.testing.assert_array_equal(np.asarray(got), want.grid)
+            assert result["generations"] == want.generations
+            # The per-job ops surface forwards too.
+            status, tl = _http("GET", f"{base}/jobs/{job_id}/timeline")
+            assert status == 200 and tl["segments"]
+
+    def test_merged_observability(self, rig):
+        base = rig.router.url
+        board = text_grid.generate(32, 32, seed=1)
+        status, payload = _submit(base, board)
+        assert status == 202
+        job_id = payload["id"]
+        assert _wait(lambda: _http("GET", f"{base}/jobs/{job_id}")[1]
+                     .get("state") == "done")
+        status, snap = _http("GET", f"{base}/metrics?format=json")
+        assert status == 200
+        assert snap["counters"]["jobs_completed_total"] == 1
+        assert set(snap["workers"]) == {"w0", "w1"}
+        assert snap["fleet"]["workers"] == 2
+        assert all("health" in w for w in snap["workers"].values())
+        req = urllib.request.Request(f"{base}/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+        assert "gol_serve_jobs_completed_total 1" in text
+        assert "gol_fleet_workers 2" in text
+        status, slo = _http("GET", f"{base}/slo")
+        assert status == 200 and slo["status"] in ("ok", "warning",
+                                                   "critical")
+        names = {o["name"] for o in slo["objectives"]}
+        assert any(n.startswith("w0:") for n in names)
+        assert any(n.startswith("w1:") for n in names)
+        status, fl = _http("GET", f"{base}/fleet")
+        assert status == 200
+        assert {w["id"] for w in fl["workers"]} == {"w0", "w1"}
+        status, hz = _http("GET", f"{base}/healthz")
+        assert status == 200 and hz["router"] and hz["fleet"]["workers"] == 2
+
+    def test_unknown_job_and_bad_submit(self, rig):
+        base = rig.router.url
+        assert _http("GET", f"{base}/jobs/nope")[0] == 404
+        assert _http("GET", f"{base}/result/nope")[0] == 404
+        assert _http("DELETE", f"{base}/jobs/nope")[0] == 404
+        assert _http("POST", f"{base}/jobs", {"width": 8})[0] == 400
+        assert _http("POST", f"{base}/jobs",
+                     {"width": 0, "height": 8, "cells": ""})[0] == 400
+        assert _http("GET", f"{base}/nope")[0] == 404
+
+    def test_drain_cascades_and_refuses_new_work(self, rig):
+        base = rig.router.url
+        status, payload = _http("POST", f"{base}/drain", {})
+        assert status == 200 and payload["drained"], payload
+        assert set(payload["workers"]) == {"w0", "w1"}
+        for srv in rig.workers.values():
+            assert srv.scheduler.draining
+        board = text_grid.generate(32, 32, seed=2)
+        status, payload = _submit(base, board)
+        assert status == 429  # the router's own admission gate
+
+
+class TestRouterRestart:
+    def test_restart_replays_exactly_once(self, tmp_path):
+        """The satellite acceptance: kill the router mid-load with workers
+        alive, restart it over the same manifest, and prove fleet-wide that
+        no accepted job is lost and none is double-run (exactly one done
+        record per id across ALL partition journals)."""
+        workers = {}
+        for wid in ("w0", "w1"):
+            srv = GolServer(port=0, journal_dir=str(tmp_path / wid),
+                            flush_age=0.01)
+            srv.start()
+            workers[wid] = srv
+        fleet = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            fleet.attach(srv.url, wid)
+        router = RouterServer(fleet, port=0)
+        router.start()
+
+        boards = {}
+        for i in range(12):
+            side = 32 if i % 2 == 0 else 30
+            board = text_grid.generate(side, side, seed=700 + i)
+            # Mixed fates at restart time: half the jobs are long enough
+            # to still be in flight when the router dies.
+            status, payload = _submit(board=board, base=router.url,
+                                      gen_limit=12 if i % 3 else 400)
+            assert status == 202, payload
+            boards[payload["id"]] = (board, 12 if i % 3 else 400)
+
+        # Kill the router abruptly: NO drain, NO worker shutdown — the
+        # workers never notice (they keep computing their queues).
+        router.shutdown(cascade=False)
+
+        fleet2 = Fleet(str(tmp_path / "fleet"))
+        assert fleet2.load() == 2  # reattached live by URL probe
+        router2 = RouterServer(fleet2, port=0)
+        router2.start()
+        base = router2.url
+        try:
+            def all_done():
+                return all(
+                    _http("GET", f"{base}/jobs/{j}")[1].get("state") == "done"
+                    for j in boards
+                )
+            assert _wait(all_done, timeout=120)
+            # Results are fetchable through the NEW router (broadcast
+            # rebuilds the id->worker map from the workers' own state).
+            for job_id, (board, gens) in boards.items():
+                status, result = _http("GET", f"{base}/result/{job_id}")
+                assert status == 200
+                want = oracle.run(board, GameConfig(gen_limit=gens))
+                got = text_grid.decode(result["grid"].encode("ascii"),
+                                       result["width"], result["height"])
+                np.testing.assert_array_equal(np.asarray(got), want.grid)
+        finally:
+            router2.shutdown(cascade=False)
+            for srv in workers.values():
+                srv.shutdown()
+
+        # Fleet-wide exactly-once, from the partitioned journals.
+        done = {}
+        for wid in workers:
+            path = tmp_path / wid / "journal.jsonl"
+            for line in path.read_bytes().split(b"\n"):
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("event") == "done":
+                    done.setdefault(rec["id"], []).append(wid)
+        assert set(done) == set(boards)  # none lost, none invented
+        dupes = {k: v for k, v in done.items() if len(v) != 1}
+        assert not dupes  # none double-run, fleet-wide
+
+
+class TestSpilloverAndBigLane:
+    def _fake_fleet(self, tmp_path, ids=("wa", "wb"), big=()):
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        for wid in ids:
+            fleet.attach(f"http://{wid}.invalid", wid, big=wid in big)
+        return fleet
+
+    def test_shedding_worker_spills_before_clients_see_429(self, tmp_path):
+        body = json.dumps({"width": 32, "height": 32}).encode()
+        key = placement.key_for(json.loads(body))
+        first, second = placement.rank(key.label(), ["wa", "wb"])
+
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            wid = url.split("//")[1].split(".")[0]
+            if wid == first:
+                return 429, {"error": "shedding load"}
+            return 202, {"id": "j1", "state": "queued"}
+
+        fleet = self._fake_fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(body)
+            assert status == 202
+            assert payload["worker"] == second
+            # The shedding worker is drained of NEW work from now on...
+            assert fleet.worker(first).backpressure
+            assert router.registry.counter("route_sheds_total") == 1
+            # ...so the next submit of the same bucket goes straight to
+            # the spillover worker, first try.
+            assert router.candidates(key)[0].id == second
+        finally:
+            router.httpd.server_close()
+
+    def test_unreachable_worker_spills(self, tmp_path):
+        body = json.dumps({"width": 32, "height": 32}).encode()
+        key = placement.key_for(json.loads(body))
+        first, second = placement.rank(key.label(), ["wa", "wb"])
+
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            wid = url.split("//")[1].split(".")[0]
+            if wid == first:
+                raise ConnectionRefusedError("down")
+            return 202, {"id": "j2", "state": "queued"}
+
+        fleet = self._fake_fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(body)
+            assert status == 202 and payload["worker"] == second
+            assert router.registry.counter("route_errors_total") == 1
+        finally:
+            router.httpd.server_close()
+
+    def test_ambiguous_submit_failure_does_not_spill(self, tmp_path):
+        """A forward that times out AFTER the bytes went out may have been
+        accepted (first-dispatch compiles outlive timeouts): spilling
+        would run the board twice under two ids. The router must surface
+        504 'outcome unknown' instead — only connection-REFUSED (nothing
+        delivered) spills."""
+        calls = []
+
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            calls.append(url)
+            raise TimeoutError("timed out mid-exchange")
+
+        fleet = self._fake_fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 504
+            assert "outcome unknown" in payload["error"]
+            assert len(calls) == 1  # ONE worker tried; no second delivery
+        finally:
+            router.httpd.server_close()
+
+    def test_dns_and_unreachable_failures_do_spill(self, tmp_path):
+        """DNS failure and host-unreachable guarantee nothing was
+        delivered — they must spill like connection-refused, not take the
+        ambiguous 504 path (a dead multi-host worker would otherwise
+        error out jobs on a fleet with healthy capacity)."""
+        import socket as _socket
+
+        body = json.dumps({"width": 32, "height": 32}).encode()
+        key = placement.key_for(json.loads(body))
+        first, second = placement.rank(key.label(), ["wa", "wb"])
+
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            wid = url.split("//")[1].split(".")[0]
+            if wid == first:
+                raise urllib.error.URLError(
+                    _socket.gaierror(-2, "Name or service not known")
+                )
+            return 202, {"id": "j3", "state": "queued"}
+
+        fleet = self._fake_fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(body)
+            assert status == 202 and payload["worker"] == second
+        finally:
+            router.httpd.server_close()
+
+    def test_all_workers_shedding_propagates_429(self, tmp_path):
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            return 429, {"error": "shedding load", "retry_after_s": 5}
+
+        fleet = self._fake_fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 429 and "retry_after_s" in payload
+        finally:
+            router.httpd.server_close()
+
+    def test_oversized_boards_route_to_big_lane(self, tmp_path):
+        fleet = self._fake_fleet(tmp_path, ids=("wa", "wb", "big0"),
+                                 big=("big0",))
+        router = RouterServer(fleet, port=0, big_edge=1024)
+        try:
+            big_key = placement.key_for({"width": 2048, "height": 64})
+            order = router.candidates(big_key)
+            assert order[0].id == "big0"  # the dedicated lane owns it
+            assert {w.id for w in order} == {"wa", "wb", "big0"}  # spillover
+            small_key = placement.key_for({"width": 64, "height": 64})
+            assert all(not w.big for w in router.candidates(small_key)[:2])
+        finally:
+            router.httpd.server_close()
+
+    def test_job_map_evicts_on_terminal_fetch_and_caps(self, tmp_path):
+        """The router's id->worker map is memory-only and must stay
+        bounded: fetching a result (or tombstone) evicts the entry, and
+        the FIFO cap is the backstop for never-collected jobs."""
+        counter = {"n": 0}
+
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            if method == "POST" and url.endswith("/jobs"):
+                counter["n"] += 1
+                return 202, {"id": f"j{counter['n']}", "state": "queued"}
+            if "/result/" in url:
+                return 200, {"id": url.rsplit("/", 1)[1], "grid": ""}
+            return 404, {}
+
+        fleet = self._fake_fleet(tmp_path, ids=("wa",))
+        router = RouterServer(fleet, port=0, http=stub_http)
+        router._jobs_cap = 4
+        try:
+            body = json.dumps({"width": 32, "height": 32}).encode()
+            status, payload = router.route_submit(body)
+            assert status == 202 and payload["id"] in router._jobs
+            status, _ = router.forward_job("GET", payload["id"], "result")
+            assert status == 200
+            assert payload["id"] not in router._jobs  # evicted on fetch
+            for _ in range(8):
+                router.route_submit(body)
+            assert len(router._jobs) == 4  # FIFO cap holds
+        finally:
+            router.httpd.server_close()
+
+    def test_unhealthy_workers_sink_to_the_tail(self, tmp_path):
+        fleet = self._fake_fleet(tmp_path, ids=("wa", "wb"))
+        key = placement.key_for({"width": 32, "height": 32})
+        first = placement.rank(key.label(), ["wa", "wb"])[0]
+        fleet.worker(first).healthy = False
+        router = RouterServer(fleet, port=0)
+        try:
+            order = router.candidates(key)
+            assert order[0].id != first and order[-1].id == first
+        finally:
+            router.httpd.server_close()
+
+
+class TestShardAcross:
+    def test_submit_shard_across_fleet_round_robin(self, rig, tmp_path,
+                                                   capsys):
+        """`gol submit --shard-across` reads GET /fleet and fans boards
+        directly over the workers round-robin; results come back whole."""
+        from gol_tpu import cli
+
+        inputs = []
+        for i in range(4):
+            board = text_grid.generate(32, 32, seed=900 + i)
+            path = tmp_path / f"in{i}.txt"
+            path.write_bytes(text_grid.encode(board))
+            inputs.append(str(path))
+        rc = cli.main([
+            "submit", "32", "32", *inputs,
+            "--server", rig.router.url, "--shard-across",
+            "--gen-limit", "8", "--output-dir", str(tmp_path / "out"),
+        ])
+        assert rc == 0
+        out = capsys.readouterr()
+        assert "sharding 4 board(s) across 2 fleet worker(s)" in out.err
+        for i in range(4):
+            assert (tmp_path / "out" / f"in{i}.txt.out").exists()
+        # Round-robin put jobs on BOTH workers directly.
+        for srv in rig.workers.values():
+            assert srv.metrics.counter("jobs_accepted_total") == 2
+
+    def test_collect_results_survives_one_dead_target(self, tmp_path,
+                                                      capsys):
+        """One dead sharded target (a worker respawned on a new port)
+        abandons only ITS jobs after the timeout; jobs on the live target
+        still complete — previously the first unreachable target aborted
+        the whole collection."""
+        import argparse
+
+        from gol_tpu import cli
+
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=42)
+            status, payload = _submit(srv.url, board, gen_limit=8)
+            assert status == 202
+            path = tmp_path / "live.txt"
+            path.write_bytes(text_grid.encode(board))
+            pending = {
+                payload["id"]: (str(path), srv.url),
+                "deadjob": ("dead.txt", "http://127.0.0.1:1"),
+            }
+            outdir = tmp_path / "out"
+            outdir.mkdir()
+            args = argparse.Namespace(poll_interval=0.05, server_timeout=0.5)
+            rc = cli._collect_results(pending, args, str(outdir))
+            assert rc == 1  # the dead target's job was abandoned...
+            out = capsys.readouterr()
+            assert "giving up on 1 job(s) there" in out.err
+            # ...but the live worker's result landed regardless.
+            assert (outdir / "live.txt.out").exists()
+        finally:
+            srv.shutdown()
+
+    def test_submit_shard_across_single_server_is_noop(self, tmp_path,
+                                                       capsys):
+        from gol_tpu import cli
+
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=77)
+            path = tmp_path / "in.txt"
+            path.write_bytes(text_grid.encode(board))
+            rc = cli.main([
+                "submit", "32", "32", str(path),
+                "--server", srv.url, "--shard-across", "--gen-limit", "8",
+            ])
+            assert rc == 0
+            assert "sharding" not in capsys.readouterr().err
+            assert srv.metrics.counter("jobs_accepted_total") == 1
+        finally:
+            srv.shutdown()

@@ -101,6 +101,20 @@ def test_no_wall_clock_in_tune():
         )
 
 
+def test_no_wall_clock_in_fleet():
+    """Same rule for gol_tpu/fleet/: boot/health deadlines, drain
+    timeouts, and respawn supervision all subtract clock readings — a
+    stepped wall clock would declare a healthy worker dead (and SIGKILL
+    it) or hang a drain. ``time.perf_counter()`` only."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "fleet", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/fleet/ (use "
+            f"time.perf_counter() for every deadline/health path): "
+            f"{offenders}"
+        )
+
+
 def test_no_wall_clock_in_engine():
     """Same rule for the engine module itself, which PR 6 made part of the
     serve hot path (the batched/ring runners and their staging live there):

@@ -57,8 +57,15 @@ def _is_config(path: str) -> bool:
             or any(h in low for h in CONFIG_HINTS))
 
 
-def compare(old: dict, new: dict, tolerance: float):
-    """(report lines, regressed?) for two parsed artifacts."""
+def compare(old: dict, new: dict, tolerance: float, metric: str | None = None):
+    """(report lines, regressed?) for two parsed artifacts.
+
+    ``metric`` selects a flattened nested leaf (dot path, e.g.
+    ``lanes.fleet_n4.jobs_per_sec``) as the GATED value instead of the
+    default headline ``value`` — for suites whose contract is a non-headline
+    number (fleet CI gates on aggregate jobs/sec while the headline is a
+    scaling ratio). Direction is inferred from the leaf path the same way
+    it is from the metric name."""
     lines = []
     metric_old = old.get("metric", "?")
     metric_new = new.get("metric", "?")
@@ -67,13 +74,30 @@ def compare(old: dict, new: dict, tolerance: float):
             f"artifacts measure different things: {metric_old!r} vs "
             f"{metric_new!r} — compare runs of the SAME suite"
         )
-    unit = str(new.get("unit", old.get("unit", "")))
     regressed = False
-    try:
-        v_old, v_new = float(old["value"]), float(new["value"])
-    except (KeyError, TypeError, ValueError):
-        raise ValueError("both artifacts need a numeric headline 'value'")
-    lower = lower_is_better(str(metric_old), unit)
+    if metric is not None:
+        flat_old_g, flat_new_g = flatten(old), flatten(new)
+        missing = [name for name, flat in
+                   (("OLD", flat_old_g), ("NEW", flat_new_g))
+                   if metric not in flat]
+        if missing:
+            raise ValueError(
+                f"--metric {metric!r} is not a numeric leaf of the "
+                f"{'/'.join(missing)} artifact(s); leaves look like "
+                f"{sorted(flat_new_g)[:6]} ..."
+            )
+        v_old, v_new = flat_old_g[metric], flat_new_g[metric]
+        unit = ""
+        gated_name = metric
+        lower = lower_is_better(metric, "")
+    else:
+        unit = str(new.get("unit", old.get("unit", "")))
+        try:
+            v_old, v_new = float(old["value"]), float(new["value"])
+        except (KeyError, TypeError, ValueError):
+            raise ValueError("both artifacts need a numeric headline 'value'")
+        gated_name = str(metric_old)
+        lower = lower_is_better(str(metric_old), unit)
     rel = (v_new - v_old) / abs(v_old) if v_old else 0.0
     bad = rel > tolerance if lower else rel < -tolerance
     better = rel < -tolerance if lower else rel > tolerance
@@ -82,12 +106,13 @@ def compare(old: dict, new: dict, tolerance: float):
     if bad:
         regressed = True
     lines.append(
-        f"headline {metric_old} ({'lower' if lower else 'higher'} is "
+        f"{'gated' if metric is not None else 'headline'} {gated_name} "
+        f"({'lower' if lower else 'higher'} is "
         f"better): {v_old:g} -> {v_new:g} {unit} ({rel:+.1%}) — {verdict}"
     )
 
     flat_old, flat_new = flatten(old), flatten(new)
-    shared = sorted(set(flat_old) & set(flat_new) - {"value"})
+    shared = sorted(set(flat_old) & set(flat_new) - {"value", metric})
     drifted = []
     for path in shared:
         if _is_config(path):
@@ -123,6 +148,13 @@ def main(argv=None) -> int:
         "--tolerance", type=float, default=0.10,
         help="relative noise threshold (default 0.10 = 10%%)",
     )
+    parser.add_argument(
+        "--metric", default=None, metavar="DOT.PATH",
+        help="gate on this flattened nested leaf (e.g. "
+        "lanes.fleet_n4.jobs_per_sec) instead of the headline 'value'; "
+        "direction is inferred from the path (seconds/latency = lower is "
+        "better)",
+    )
     args = parser.parse_args(argv)
     if args.tolerance < 0:
         print(f"bench-diff: tolerance must be >= 0, got {args.tolerance}",
@@ -137,7 +169,8 @@ def main(argv=None) -> int:
             print(f"bench-diff: cannot read {path}: {err}", file=sys.stderr)
             return 2
     try:
-        lines, regressed = compare(docs[0], docs[1], args.tolerance)
+        lines, regressed = compare(docs[0], docs[1], args.tolerance,
+                                   metric=args.metric)
     except ValueError as err:
         print(f"bench-diff: {err}", file=sys.stderr)
         return 2

@@ -1,0 +1,303 @@
+"""Fleet crash/rebalance smoke: kill a worker mid-batch, verify exactly-once.
+
+The `make fleet-smoke` harness, exercising the sharded-fleet acceptance
+end-to-end against real OS processes:
+
+1. boot ``gol fleet --workers 3`` on a fresh ``--fleet-dir`` (3 journal
+   partitions + the membership manifest);
+2. submit N jobs (default 100) across THREE bucket shapes (32x32 exact-fit
+   packed, 30x30 masked, 64x64 packed) through the router — every accepted
+   id is remembered along with the worker that took it;
+3. SIGKILL one worker that accepted work, while work is in flight: the
+   router's health loop must detect it, respawn it on the SAME partition,
+   and its journal must replay the partition's unfinished jobs (new jobs
+   spill to other workers in the meantime — the rebalance lane);
+4. wait until every accepted job reports DONE through the router;
+5. verify every result against the NumPy oracle (byte-identical to a solo
+   run, through the kill);
+6. SIGTERM the fleet process: the cascaded graceful drain must complete,
+   every worker process must exit, and the fleet must exit rc 0;
+7. verify across ALL partition journals that every accepted id has EXACTLY
+   one done record fleet-wide (none lost, none double-run, no partition
+   holds a duplicate of another's).
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/fleet_smoke.py [--jobs 100] [--gen-limit 300]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu import oracle  # noqa: E402
+from gol_tpu.config import GameConfig  # noqa: E402
+from gol_tpu.io import text_grid  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _start_fleet(port: int, fleet_dir: str, workers: int = 3):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "fleet",
+            "--port", str(port),
+            "--workers", str(workers),
+            "--fleet-dir", fleet_dir,
+            "--flush-age", "0.05",
+            "--health-interval", "0.5",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.perf_counter() + 300
+    base = f"http://127.0.0.1:{port}"
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(
+                f"fleet died on boot rc={proc.returncode}:\n{out[-4000:]}"
+            )
+        try:
+            status, payload = _http("GET", f"{base}/healthz", timeout=2)
+            if status == 200 and payload.get("fleet", {}).get("workers") == 3:
+                return proc
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("fleet did not become healthy within 300s")
+
+
+def _fleet_workers(base: str) -> list:
+    status, payload = _http("GET", f"{base}/fleet")
+    if status != 200:
+        raise RuntimeError(f"GET /fleet -> {status}: {payload}")
+    return payload["workers"]
+
+
+def _count_done(fleet_dir: str) -> dict:
+    """id -> [(partition, record)] across every partition journal."""
+    done: dict = {}
+    for name in sorted(os.listdir(fleet_dir)):
+        path = os.path.join(fleet_dir, name, "journal.jsonl")
+        if not os.path.isfile(path):
+            continue
+        with open(path, "rb") as f:
+            for line in f.read().split(b"\n"):
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "done":
+                    done.setdefault(rec["id"], []).append((name, rec))
+    return done
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=100)
+    parser.add_argument("--gen-limit", type=int, default=300)
+    parser.add_argument(
+        "--kill-after", type=float, default=0.8,
+        help="seconds after the last submit to SIGKILL the victim worker",
+    )
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-fleet-smoke-")
+    fleet_dir = os.path.join(workdir, "fleet")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    cfg = GameConfig(gen_limit=args.gen_limit)
+    sides = (32, 30, 64)  # 3 buckets: exact-fit packed, masked, bigger packed
+
+    rc = 1
+    proc = None
+    try:
+        proc = _start_fleet(port, fleet_dir)
+        print(f"fleet-smoke: 3-worker fleet up on {base}, dir {fleet_dir}")
+
+        accepted = {}  # id -> (board, worker_id)
+        for i in range(args.jobs):
+            side = sides[i % 3]
+            board = text_grid.generate(side, side, seed=2000 + i)
+            status, payload = _http("POST", f"{base}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": args.gen_limit,
+            })
+            if status != 202:
+                print(f"fleet-smoke: submit {i} rejected HTTP {status}: "
+                      f"{payload}")
+                return 1
+            accepted[payload["id"]] = (board, payload.get("worker"))
+        by_worker: dict = {}
+        for _, (_, wid) in accepted.items():
+            by_worker[wid] = by_worker.get(wid, 0) + 1
+        print(f"fleet-smoke: accepted {len(accepted)} jobs across 3 buckets; "
+              f"placement {by_worker}")
+
+        # Pick a victim that actually took work, and SIGKILL it mid-batch.
+        time.sleep(args.kill_after)
+        victim_id = max(by_worker, key=lambda k: by_worker[k])
+        victim = next(w for w in _fleet_workers(base)
+                      if w["id"] == victim_id)
+        print(f"fleet-smoke: SIGKILL worker {victim['id']} "
+              f"(pid {victim['pid']}, {by_worker[victim_id]} jobs placed)")
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        # Every accepted job must reach DONE through the router — the
+        # victim's partition replays after the health loop respawns it.
+        deadline = time.perf_counter() + 600
+        pending = set(accepted)
+        while pending and time.perf_counter() < deadline:
+            for job_id in list(pending):
+                try:
+                    status, payload = _http("GET", f"{base}/jobs/{job_id}",
+                                            timeout=10)
+                except (urllib.error.URLError, OSError):
+                    break  # router busy; retry the sweep
+                if status >= 500:
+                    continue  # the respawn window; keep polling
+                if status != 200:
+                    print(f"fleet-smoke: job {job_id} LOST "
+                          f"(HTTP {status}: {payload})")
+                    return 1
+                state = payload["state"]
+                if state == "done":
+                    pending.discard(job_id)
+                elif state in ("failed", "cancelled"):
+                    print(f"fleet-smoke: job {job_id} ended {state}: "
+                          f"{payload}")
+                    return 1
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            print(f"fleet-smoke: {len(pending)} job(s) never completed")
+            return 1
+
+        # The respawn must be visible in the membership (restarts >= 1).
+        workers = _fleet_workers(base)
+        restarts = sum(w["restarts"] for w in workers)
+        if restarts < 1:
+            print(f"fleet-smoke: expected a respawned worker, saw none: "
+                  f"{workers}")
+            return 1
+        print(f"fleet-smoke: all jobs DONE through the kill "
+              f"({restarts} worker restart(s))")
+
+        # Results byte-identical to the oracle, fetched through the router.
+        mismatches = 0
+        for job_id, (board, _) in accepted.items():
+            status, result = _http("GET", f"{base}/result/{job_id}")
+            if status != 200:
+                print(f"fleet-smoke: result {job_id} HTTP {status}")
+                return 1
+            want = oracle.run(board, cfg)
+            got = text_grid.decode(
+                result["grid"].encode("ascii"),
+                result["width"], result["height"],
+            )
+            if (not np.array_equal(np.asarray(got), want.grid)
+                    or result["generations"] != want.generations):
+                mismatches += 1
+        if mismatches:
+            print(f"fleet-smoke: {mismatches} result(s) diverge from the "
+                  "oracle")
+            return 1
+        print("fleet-smoke: every result oracle-identical")
+
+        # Cascaded graceful drain: SIGTERM the fleet; it must drain every
+        # worker, stop them, and exit 0; every worker pid must be gone.
+        pids = [w["pid"] for w in workers if w["pid"]]
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            print("fleet-smoke: fleet ignored SIGTERM")
+            proc.kill()
+            return 1
+        if proc.returncode != 0:
+            print(f"fleet-smoke: fleet exited rc={proc.returncode}:\n"
+                  f"{out[-3000:]}")
+            return 1
+        proc = None
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+                print(f"fleet-smoke: worker pid {pid} survived the drain")
+                return 1
+            except ProcessLookupError:
+                pass
+        print("fleet-smoke: cascaded SIGTERM drain exited clean, "
+              "all workers stopped")
+
+        # Fleet-wide exactly-once: every accepted id has exactly one done
+        # record across ALL partitions.
+        done = _count_done(fleet_dir)
+        lost = set(accepted) - set(done)
+        extra = set(done) - set(accepted)
+        dup = {k: [p for p, _ in v] for k, v in done.items() if len(v) != 1}
+        if lost or extra or dup:
+            print(f"fleet-smoke: lost={lost} unknown={extra} "
+                  f"duplicated={dup}")
+            return 1
+        print(
+            f"fleet-smoke: PASS — {len(accepted)} accepted across "
+            f"{len({p for v in done.values() for p, _ in v})} partitions, "
+            "worker SIGKILL replayed/rebalanced to exactly-once, results "
+            "oracle-identical, cascaded drain clean"
+        )
+        rc = 0
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        if rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"fleet-smoke: artifacts kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

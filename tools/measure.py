@@ -643,6 +643,25 @@ def config5(size: int = 65536, gens: int = 10000) -> None:
 _R3_STEPS = {"compare32k": compare32k, "h2d": h2d, "d2h": d2h,
              "config5": config5}
 
+# The historical per-round entry points (measure_r3.py .. measure_block_r5.py)
+# map onto this tool's argv here, in ONE table — the shims themselves carry
+# no argument plumbing anymore, just `shim_main(__file__)`.
+_SHIM_ARGS = {
+    "measure_r3": ["--rev", "3"],
+    "measure_r4": ["--rev", "4"],
+    "measure_r5": ["--rev", "5"],
+    "measure_block_r5": ["block"],
+}
+
+
+def shim_main(shim_path: str, argv: list[str] | None = None) -> int:
+    """Entry point for the legacy shim filenames: prepend the shim's
+    recorded arguments (the ``--rev`` / subcommand it historically pinned)
+    and run ``main``."""
+    name = os.path.splitext(os.path.basename(shim_path))[0]
+    prepend = _SHIM_ARGS[name]
+    return main([*prepend, *(sys.argv[1:] if argv is None else list(argv))])
+
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)

@@ -1,9 +1,9 @@
 """Thin shim: the termination-block A/B lives in tools/measure.py (`block`).
 
 Kept so the documented command (`python tools/measure_block_r5.py [size]
-[gens] [blocks...]`) keeps working. The A/B now builds each block size
-through the engine's per-runner plan parameter
-(gol_tpu/tune/space.EnginePlan) instead of mutating engine's module global.
+[gens] [blocks...]`) keeps working; the argument mapping lives in
+measure.py's ``_SHIM_ARGS`` table. The A/B builds each block size through
+the engine's per-runner plan parameter (gol_tpu/tune/space.EnginePlan).
 """
 
 from __future__ import annotations
@@ -13,7 +13,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from measure import main  # noqa: E402
+from measure import shim_main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main(["block", *sys.argv[1:]]))
+    sys.exit(shim_main(__file__))

@@ -1,7 +1,7 @@
 """Thin shim: the r3 measurement battery lives in tools/measure.py (--rev 3).
 
 Kept so documented commands (`python tools/measure_r3.py h2d` etc.) keep
-working; new work goes through `python tools/measure.py --rev 3 <step>`.
+working; the argument mapping lives in measure.py's ``_SHIM_ARGS`` table.
 """
 
 from __future__ import annotations
@@ -11,7 +11,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from measure import main  # noqa: E402
+from measure import shim_main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main(["--rev", "3", *sys.argv[1:]]))
+    sys.exit(shim_main(__file__))

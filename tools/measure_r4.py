@@ -1,8 +1,8 @@
 """Thin shim: the r4 measurement battery lives in tools/measure.py (--rev 4).
 
 Kept so documented commands (`python tools/measure_r4.py compare 16384` etc.)
-keep working — artifacts still land as *_r4.json; new work goes through
-`python tools/measure.py --rev 4 <step>`.
+keep working — artifacts still land as *_r4.json; the argument mapping lives
+in measure.py's ``_SHIM_ARGS`` table.
 """
 
 from __future__ import annotations
@@ -12,7 +12,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from measure import main  # noqa: E402
+from measure import shim_main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main(["--rev", "4", *sys.argv[1:]]))
+    sys.exit(shim_main(__file__))

@@ -2,7 +2,8 @@
 
 Kept so documented commands (`python tools/measure_r5.py compare 16384` etc.)
 keep working; `--rev 5` is also measure.py's default, so the plain
-`python tools/measure.py <step>` form is equivalent.
+`python tools/measure.py <step>` form is equivalent. The argument mapping
+lives in measure.py's ``_SHIM_ARGS`` table.
 """
 
 from __future__ import annotations
@@ -12,7 +13,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from measure import main  # noqa: E402
+from measure import shim_main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main(["--rev", "5", *sys.argv[1:]]))
+    sys.exit(shim_main(__file__))
