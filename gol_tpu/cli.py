@@ -1154,7 +1154,10 @@ def _collect_results(pending: dict, args, outdir) -> int:
         _time.sleep(args.poll_interval)
         stale_this_sweep = set()  # targets already found down this sweep
         for job_id in list(pending):
-            path, job_base = pending[job_id]
+            entry = pending.get(job_id)
+            if entry is None:
+                continue  # removed mid-sweep by target_down on its base
+            path, job_base = entry
             if job_base in stale_this_sweep:
                 continue
 

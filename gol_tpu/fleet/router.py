@@ -78,6 +78,111 @@ def _delivery_impossible(err: BaseException) -> bool:
 
 # -- pure merge helpers (unit-tested without HTTP) --------------------------
 
+class MonotonicCounters:
+    """Per-worker high-water offsets so fleet-merged cumulative series —
+    counters AND histogram ``count``/``sum`` — never go backwards across
+    worker respawns.
+
+    A respawned worker restarts its cumulative series at zero, so summing
+    raw per-worker values makes the fleet-merged "counter" DECREASE
+    exactly during the restart windows operators are watching — Prometheus
+    ``rate()``/``increase()`` then report spurious resets and spikes. The
+    router banks, per (worker, series), the total a previous incarnation
+    reached (a value going backwards — or a lazily-created key vanishing —
+    is the respawn signal) and adds it back before merging, keeping the
+    merged series monotonic — including through the outage window itself,
+    when the dead worker answers no scrape at all and its last-known
+    totals stand in. Gauges and histogram quantiles are instantaneous and
+    pass through untouched: only LIVE workers contribute those."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (worker id, series key) -> float; a series key is ("c", name)
+        # for a counter or ("h", name, "count"|"sum") for a histogram.
+        self._last: dict[tuple, float] = {}
+        self._base: dict[tuple, float] = {}
+        self._incarnation: dict[str, int] = {}  # worker id -> restarts seen
+
+    @staticmethod
+    def _series(snap: dict) -> dict[tuple, float]:
+        series: dict[tuple, float] = {}
+        for name, value in (snap.get("counters") or {}).items():
+            series[("c", name)] = float(value)
+        for name, summary in (snap.get("histograms") or {}).items():
+            for field in ("count", "sum"):
+                series[("h", name, field)] = float(summary.get(field) or 0)
+        return series
+
+    def _floor(self, wid: str, series: dict[tuple, float]) -> dict:
+        """Bank resets and return every known-or-present series floored.
+        A known key absent from this scrape reads as zero — registries
+        create series lazily, so a fresh incarnation that has not counted
+        an event yet omits the key entirely: the same reset signal."""
+        known = {skey for (w, skey) in self._last if w == wid}
+        floored = {}
+        for skey in known | set(series):
+            value = series.get(skey, 0.0)
+            key = (wid, skey)
+            last = self._last.get(key, 0.0)
+            if value < last:  # the worker respawned: bank its old run
+                self._base[key] = self._base.get(key, 0.0) + last
+            self._last[key] = value
+            floored[skey] = self._base.get(key, 0.0) + value
+        return floored
+
+    @staticmethod
+    def _rebuild(snap: dict, floored: dict[tuple, float]) -> dict:
+        counters: dict[str, float] = {}
+        hists = {name: dict(summary)
+                 for name, summary in (snap.get("histograms") or {}).items()}
+        for skey, value in floored.items():
+            if skey[0] == "c":
+                counters[skey[1]] = value
+            else:
+                hists.setdefault(skey[1], {})[skey[2]] = value
+        return {**snap, "counters": counters, "histograms": hists}
+
+    def adjust(self, snapshots: dict[str, dict],
+               incarnations: dict[str, int] | None = None) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        with self._lock:
+            # Bank on KNOWN respawns first (the fleet's restart
+            # generation): a new incarnation that already overtook the
+            # old total by the next scrape shows no value regression,
+            # and inferring resets from value order alone would silently
+            # drop the old run from the merge. Attached workers (the
+            # fleet never respawns them) still rely on the value-
+            # regression fallback below.
+            for wid, gen in (incarnations or {}).items():
+                seen = self._incarnation.get(wid)
+                self._incarnation[wid] = gen
+                if seen is None or gen == seen:
+                    continue
+                for (w, skey), last in list(self._last.items()):
+                    if w == wid and last > 0:
+                        self._base[(w, skey)] = (
+                            self._base.get((w, skey), 0.0) + last
+                        )
+                        self._last[(w, skey)] = 0.0
+            for wid, snap in snapshots.items():
+                out[wid] = self._rebuild(snap, self._floor(wid,
+                                                           self._series(snap)))
+            # A worker missing from this scrape ENTIRELY (dead, mid-
+            # respawn, network blip) still contributes its last-known
+            # totals: the events it counted happened, and dropping them
+            # dips the merged series for the whole outage window. No
+            # banking here — a worker back from a blip with its series
+            # intact just continues them.
+            known_wids = {w for (w, _) in self._last}
+            for wid in known_wids - set(snapshots):
+                floored = {
+                    skey: self._base.get((w, skey), 0.0) + last
+                    for (w, skey), last in self._last.items() if w == wid
+                }
+                out[wid] = self._rebuild({}, floored)
+        return out
+
+
 def merge_metrics(snapshots: dict[str, dict]) -> dict:
     """Merge per-worker /metrics JSON snapshots into one fleet view.
 
@@ -112,10 +217,11 @@ def merge_metrics(snapshots: dict[str, dict]) -> dict:
     return {"counters": counters, "gauges": gauges, "histograms": hists}
 
 
-def merged_prometheus(merged: dict, fleet_gauges: dict) -> str:
+def merged_prometheus(merged: dict, fleet_gauges: dict,
+                      fleet_counters: dict | None = None) -> str:
     """Prometheus text for the merged snapshot, in the worker registry's
     exposition shape (same ``gol_serve_`` series names, sum semantics) plus
-    ``gol_fleet_*`` membership gauges."""
+    ``gol_fleet_*`` membership gauges and router counters."""
     lines: list[str] = []
     for name, value in sorted(merged.get("counters", {}).items()):
         lines.append(f"# TYPE gol_serve_{name} counter")
@@ -131,6 +237,9 @@ def merged_prometheus(merged: dict, fleet_gauges: dict) -> str:
                 lines.append(f'gol_serve_{name}{{quantile="{q}"}} {_fmt(v)}')
         lines.append(f"gol_serve_{name}_sum {_fmt(summary['sum'])}")
         lines.append(f"gol_serve_{name}_count {_fmt(summary['count'])}")
+    for name, value in sorted((fleet_counters or {}).items()):
+        lines.append(f"# TYPE gol_fleet_{name} counter")
+        lines.append(f"gol_fleet_{name} {_fmt(value)}")
     for name, value in sorted(fleet_gauges.items()):
         lines.append(f"# TYPE gol_fleet_{name} gauge")
         lines.append(f"gol_fleet_{name} {_fmt(value)}")
@@ -200,6 +309,13 @@ class RouterServer:
         self.http = http
         self.submit_timeout = submit_timeout
         self.registry = Registry(prefix="gol_fleet")
+        self._counter_floors = MonotonicCounters()
+        # Single-flight scrape state (all guarded by the condition).
+        self._scrape_done = threading.Condition()
+        self._scrape_busy = False
+        self._scrape_epoch = 0
+        self._scrape_cache: tuple[dict, dict] | None = None
+        self._scrape_cache_epoch = 0  # epoch that produced the cache
         # job id -> worker id, memory only (the partitions are the truth;
         # a miss rebuilds by broadcast). Bounded: entries evict when their
         # result/cancellation is fetched, with a FIFO cap as the backstop
@@ -287,6 +403,13 @@ class RouterServer:
         order = [w for w in ranked if w.healthy and not w.backpressure]
         order += [w for w in ranked if w.healthy and w.backpressure]
         order += [w for w in ranked if not w.healthy]
+        # Small jobs normally never touch the big lane (its compile budget
+        # and rings are reserved for mesh-sharded boards), but a healthy
+        # big worker beats a fleet-wide 503 when every normal worker is
+        # unreachable — workers re-bucket jobs themselves, so spillover
+        # there is correctness-safe. Tail it as the true last resort.
+        in_order = {w.id for w in order}
+        order += [w for w in bigs if w.healthy and w.id not in in_order]
         return order
 
     def route_submit(self, raw: bytes):
@@ -302,7 +425,20 @@ class RouterServer:
         if not order:
             return 503, {"error": "fleet has no routable workers"}
         last = (503, {"error": "no worker accepted the job"})
+        small = key.max_edge <= self.big_edge
+        shed_seen = False  # any 429: keep it as the client's answer
+        normal_shed = False  # a NORMAL worker shed: skip big-lane tails
         for worker in order:
+            if worker.big and small and normal_shed:
+                # The big lane is the last resort for small jobs ONLY
+                # against unreachable normals. A normal worker's 429
+                # means the fleet is alive and load-shedding on purpose:
+                # the client must see that backpressure, not have its
+                # overflow silently compiled onto the lane reserved for
+                # mesh-sharded boards. (A 429 from a BIG worker sets no
+                # such signal — when bigs are the pool, or the tail is
+                # mid-walk, the next big still gets its try.)
+                continue
             try:
                 status, payload = self.http(
                     "POST", worker.url + "/jobs", raw=raw,
@@ -322,10 +458,13 @@ class RouterServer:
                                  "submit in time; outcome unknown — the "
                                  "job may have been accepted there",
                     }
-                # Nothing was delivered: spilling is safe.
-                last = (503, {
-                    "error": f"worker {worker.id} unreachable: {err}",
-                })
+                # Nothing was delivered: spilling is safe. A 429 already
+                # seen stays the answer — Retry-After is actionable,
+                # "unreachable" is not.
+                if not shed_seen:
+                    last = (503, {
+                        "error": f"worker {worker.id} unreachable: {err}",
+                    })
                 continue
             if status == 429:
                 # The worker is shedding (SLO burn) or full: drain it of
@@ -333,6 +472,8 @@ class RouterServer:
                 # client only sees a 429 when the WHOLE fleet sheds.
                 self.fleet.note_shed(worker.id)
                 self.registry.inc("route_sheds_total")
+                shed_seen = True
+                normal_shed = normal_shed or not worker.big
                 last = (status, payload)
                 continue
             if status == 202 and isinstance(payload, dict) and "id" in payload:
@@ -436,11 +577,67 @@ class RouterServer:
             t.start()
         for t in threads:
             t.join(timeout=10)
-        return out
+        # Copy under the lock: a straggler fetch outliving its join
+        # timeout still writes to `out` — the caller's dict (cached and
+        # shared across scraper threads) must never mutate underfoot.
+        with lock:
+            return dict(out)
+
+    def _merged_snapshot(self) -> tuple[dict, dict]:
+        """Collect + floor + merge, SINGLE-FLIGHT: concurrent scrapes
+        (gol top's JSON view and the Prometheus text view run on separate
+        HTTP threads) must not feed MonotonicCounters out of snapshot
+        order — a pre-respawn snapshot adjusted AFTER a newer post-respawn
+        one would bank the old incarnation's total twice and inflate the
+        merged series forever. Scrapes therefore never overlap, but a
+        late arrival does not queue its OWN full fan-out behind the
+        in-flight one (which lasts up to a dead worker's connect timeout
+        — exactly the frozen-`gol top`-mid-outage latency the concurrent
+        _collect exists to avoid): it waits for the in-flight scrape and
+        shares its result."""
+        with self._scrape_done:
+            while self._scrape_busy:
+                epoch = self._scrape_epoch
+                self._scrape_done.wait(timeout=30)
+                # Share a result only if the scrape we waited on SET it:
+                # a scrape that raised bumps the epoch without updating
+                # the cache, and serving an arbitrarily old snapshot as
+                # if fresh would silently freeze /metrics — fall through
+                # and scrape (and likely surface the same error).
+                if (self._scrape_epoch != epoch
+                        and self._scrape_cache_epoch == self._scrape_epoch
+                        and self._scrape_cache is not None):
+                    return self._scrape_cache
+            # not busy (anymore): this thread does the scrape
+            self._scrape_busy = True
+        result = None
+        try:
+            # Restart generations are read BEFORE collecting: a respawn
+            # completing in between yields (old generation, fresh
+            # snapshot) — the value-regression fallback banks it. The
+            # reverse pairing (new generation, stale snapshot) would
+            # bank the old run twice.
+            incarnations = {w.id: w.restarts for w in self.fleet.workers()}
+            snaps = self._collect("/metrics?format=json")
+            merged = merge_metrics(self._counter_floors.adjust(
+                {k: v for k, v in snaps.items() if v}, incarnations
+            ))
+            result = (snaps, merged)
+            return result
+        finally:
+            with self._scrape_done:
+                self._scrape_busy = False
+                self._scrape_epoch += 1
+                if result is not None:
+                    self._scrape_cache = result
+                    self._scrape_cache_epoch = self._scrape_epoch
+                self._scrape_done.notify_all()
 
     def metrics_json(self) -> dict:
-        snaps = self._collect("/metrics?format=json")
-        merged = merge_metrics({k: v for k, v in snaps.items() if v})
+        snaps, merged = self._merged_snapshot()
+        # The snapshot may be shared with concurrent scrapers: never
+        # mutate it in place.
+        merged = dict(merged)
         health = {w.id: w.public() for w in self.fleet.workers()}
         workers = {}
         for wid, snap in snaps.items():
@@ -456,19 +653,20 @@ class RouterServer:
         return merged
 
     def metrics_prometheus(self) -> str:
-        snaps = self._collect("/metrics?format=json")
-        merged = merge_metrics({k: v for k, v in snaps.items() if v})
+        _, merged = self._merged_snapshot()
         stats = self.fleet.stats()
         fleet_gauges = {
             "workers": stats["workers"],
             "workers_healthy": stats["healthy"],
             "workers_backpressured": stats["backpressured"],
+        }
+        fleet_counters = {
             "worker_restarts": stats["restarts"],
             "jobs_routed_total": self.registry.counter("jobs_routed_total"),
             "route_sheds_total": self.registry.counter("route_sheds_total"),
             "route_errors_total": self.registry.counter("route_errors_total"),
         }
-        return merged_prometheus(merged, fleet_gauges)
+        return merged_prometheus(merged, fleet_gauges, fleet_counters)
 
     def slo_json(self) -> dict:
         return merge_slo(self._collect("/slo"))

@@ -67,6 +67,7 @@ class Worker:
     backpressure: bool = False  # SLO-critical / shedding: no NEW work
     failures: int = 0  # consecutive failed liveness probes
     restarts: int = 0
+    respawning: bool = False  # a background respawn is in flight
 
     def manifest_record(self) -> dict:
         return {
@@ -122,6 +123,8 @@ class Fleet:
         self._workers: dict[str, Worker] = {}
         self._health_thread: threading.Thread | None = None
         self._health_stop = threading.Event()
+        self._respawns: dict[str, threading.Thread] = {}
+        self._manifest_lock = threading.Lock()
 
     # -- membership --------------------------------------------------------
 
@@ -347,6 +350,34 @@ class Fleet:
             return
         self.write_manifest()
 
+    def _respawn_async(self, worker: Worker) -> None:
+        """Respawn off the health thread: ``_respawn`` blocks in
+        ``_await_ready`` for up to ``boot_timeout``, and a tick stalled
+        there would leave every OTHER worker unprobed — a second
+        concurrent death (or a drain/shed recovery) unhandled for
+        minutes. The ``respawning`` flag keeps later ticks off the worker
+        until its respawn resolves (one respawner per partition: never
+        two writers on one journal)."""
+        if worker.respawning:
+            return
+        worker.respawning = True
+
+        def run():
+            try:
+                # A shutdown that began after this thread was scheduled
+                # must not boot a fresh worker terminate() never sees.
+                if not self._health_stop.is_set():
+                    self._respawn(worker)
+            finally:
+                worker.respawning = False
+
+        thread = threading.Thread(
+            target=run, name=f"gol-fleet-respawn-{worker.id}", daemon=True
+        )
+        with self._lock:
+            self._respawns[worker.id] = thread
+        thread.start()
+
     # -- manifest ----------------------------------------------------------
 
     @property
@@ -354,19 +385,24 @@ class Fleet:
         return os.path.join(self.fleet_dir, MANIFEST)
 
     def write_manifest(self) -> None:
-        with self._lock:
-            doc = {
-                "version": 1,
-                "partitions": [w.manifest_record()
-                               for w in self._workers.values()],
-            }
-        tmp = self.manifest_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.manifest_path)
+        # Serialized end to end: concurrent background respawns (and the
+        # health thread's banner adoption) share one .tmp path — two
+        # interleaved truncate/write/replace sequences would publish a
+        # garbled manifest and break the router-restart recovery lane.
+        with self._manifest_lock:
+            with self._lock:
+                doc = {
+                    "version": 1,
+                    "partitions": [w.manifest_record()
+                                   for w in self._workers.values()],
+                }
+            tmp = self.manifest_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.manifest_path)
 
     def load(self) -> int:
         """Reattach the fleet a previous router left behind (the router-
@@ -421,10 +457,12 @@ class Fleet:
     def check_worker(self, worker: Worker) -> None:
         """One health tick for one worker: liveness via /healthz, burn via
         /slo, respawn for dead local processes."""
+        if worker.respawning:
+            return  # a background respawn owns this worker right now
         if worker.proc is not None and worker.proc.poll() is not None:
             logger.warning("fleet: worker %s (pid %s) exited rc=%s",
                            worker.id, worker.pid, worker.proc.returncode)
-            self._respawn(worker)
+            self._respawn_async(worker)
             return
         if worker.url is None:
             # A boot that outlived _await_ready's patience (e.g.
@@ -452,7 +490,7 @@ class Fleet:
                     )
                 worker.healthy = False
                 if not worker.attached:
-                    self._respawn(worker)
+                    self._respawn_async(worker)
             return
         worker.failures = 0
         worker.healthy = True
@@ -492,11 +530,17 @@ class Fleet:
         self._health_thread.start()
 
     def stop_health(self) -> None:
-        if self._health_thread is None:
-            return
         self._health_stop.set()
-        self._health_thread.join(timeout=self.boot_timeout + 15)
-        self._health_thread = None
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=self.boot_timeout + 15)
+            self._health_thread = None
+        # In-flight background respawns must resolve before terminate():
+        # a worker launched after the kill sweep would outlive the fleet.
+        with self._lock:
+            respawns = list(self._respawns.values())
+            self._respawns.clear()
+        for thread in respawns:
+            thread.join(timeout=self.boot_timeout + 15)
 
     # -- fleet-wide drain / shutdown ---------------------------------------
 

@@ -22,7 +22,8 @@ from gol_tpu import oracle
 from gol_tpu.config import GameConfig
 from gol_tpu.fleet import placement
 from gol_tpu.fleet.router import (
-    RouterServer, merge_metrics, merge_slo, merged_prometheus,
+    MonotonicCounters, RouterServer, merge_metrics, merge_slo,
+    merged_prometheus,
 )
 from gol_tpu.fleet.workers import Fleet
 from gol_tpu.io import text_grid
@@ -169,6 +170,61 @@ class TestManifest:
         assert w.url == "http://127.0.0.1:7777"
         assert w.healthy
 
+    def test_dead_worker_respawn_does_not_block_the_tick(self, tmp_path):
+        """_respawn waits in _await_ready for up to boot_timeout; run
+        synchronously inside the health tick that would leave every OTHER
+        worker unprobed while one boots — a second concurrent death
+        unhandled for minutes. The tick must hand the respawn to a
+        background thread and move on, and never start a second respawn
+        for the same partition (one journal writer)."""
+        import threading
+
+        from gol_tpu.fleet.workers import Worker
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        started, release = threading.Event(), threading.Event()
+        calls = []
+
+        def slow_respawn(worker):
+            calls.append(worker.id)
+            started.set()
+            release.wait(timeout=30)
+
+        fleet._respawn = slow_respawn
+        dead = types.SimpleNamespace(poll=lambda: 1, returncode=1, pid=11)
+        w = Worker(id="w0", proc=dead, pid=11)
+        fleet._workers["w0"] = w
+        t0 = time.perf_counter()
+        fleet.check_worker(w)
+        assert time.perf_counter() - t0 < 1.0  # the tick did not wait
+        assert started.wait(timeout=10)
+        assert w.respawning
+        fleet.check_worker(w)  # next tick: respawn already in flight...
+        assert calls == ["w0"]  # ...exactly one respawner
+        release.set()
+        assert _wait(lambda: not w.respawning, timeout=10)
+        # Shutdown joins stragglers so terminate() can't race a boot.
+        fleet.stop_health()
+
+    def test_concurrent_manifest_writes_stay_parseable(self, tmp_path):
+        """Background respawn threads write the manifest concurrently
+        with the health thread; the shared .tmp path must be serialized
+        or a half-truncated file can be renamed into place — which a
+        restarted router's load() would choke on."""
+        import threading
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        for i in range(4):
+            fleet.attach(f"http://127.0.0.1:{9000 + i}", f"w{i}")
+        threads = [threading.Thread(target=fleet.write_manifest)
+                   for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        doc = json.loads(open(fleet.manifest_path).read())
+        assert len(doc["partitions"]) == 4
+
 
 class TestMerge:
     def test_metrics_merge_sums_and_bounds(self):
@@ -214,6 +270,152 @@ class TestMerge:
         text = merged_prometheus(merged, {"workers": 3})
         assert "gol_serve_jobs_accepted_total 2" in text
         assert "gol_fleet_workers 3" in text
+
+    def test_prometheus_router_counters_typed_counter(self):
+        """The router's own *_total series must expose as TYPE counter,
+        not gauge — Prometheus counter functions (rate/increase) reject
+        or misread gauge-typed series."""
+        text = merged_prometheus(
+            {"counters": {}, "gauges": {}, "histograms": {}},
+            {"workers": 3},
+            {"jobs_routed_total": 5},
+        )
+        assert "# TYPE gol_fleet_jobs_routed_total counter" in text
+        assert "gol_fleet_jobs_routed_total 5" in text
+        assert "# TYPE gol_fleet_workers gauge" in text
+
+    def test_merged_counters_stay_monotonic_across_respawn(self):
+        """A respawned worker restarts its counters at zero; the router's
+        high-water offsets must keep the fleet-merged counter from
+        DECREASING — a non-monotonic 'counter' makes Prometheus
+        rate()/increase() report spurious resets exactly during the
+        restart windows operators are watching."""
+        floors = MonotonicCounters()
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 10}},
+            "w1": {"counters": {"jobs_completed_total": 5}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 15
+        # w1 respawns: its counter resets to 0...
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 11}},
+            "w1": {"counters": {"jobs_completed_total": 0}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 16  # not 11
+        # ...and climbs again; the banked pre-respawn total stays in.
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 11}},
+            "w1": {"counters": {"jobs_completed_total": 2}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 18
+
+    def test_monotonic_counters_survive_lazily_absent_keys(self):
+        """Registries create counters on first inc: a respawned worker's
+        snapshot omits a counter entirely until its first event, which
+        must read as a reset-to-zero — the banked pre-respawn total stays
+        in the merge rather than vanishing with the key."""
+        floors = MonotonicCounters()
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 5}},
+            "w1": {"counters": {"jobs_completed_total": 10}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 15
+        # w1 respawns; its fresh registry has no such counter yet.
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 5}},
+            "w1": {"counters": {}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 15  # not 5
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 5}},
+            "w1": {"counters": {"jobs_completed_total": 3}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 18
+
+    def test_monotonic_counters_span_the_outage_window(self):
+        """While a worker is DEAD it answers no scrape at all — its
+        last-known totals must stand in or the merged counter dips for
+        the whole outage (caught live: killing a worker halved the
+        fleet-merged jobs_completed_total until the respawn finished)."""
+        floors = MonotonicCounters()
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 4}},
+            "w1": {"counters": {"jobs_completed_total": 4}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 8
+        # w0 is down: absent from the scrape entirely.
+        merged = merge_metrics(floors.adjust({
+            "w1": {"counters": {"jobs_completed_total": 5}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 9  # not 5
+        # Back after a respawn (reset) — banked; and after a mere
+        # network blip (counters intact) — continued, never double.
+        merged = merge_metrics(floors.adjust({
+            "w0": {"counters": {"jobs_completed_total": 1}},
+            "w1": {"counters": {"jobs_completed_total": 5}},
+        }))
+        assert merged["counters"]["jobs_completed_total"] == 10
+
+    def test_monotonic_counters_bank_on_known_respawn_overtake(self):
+        """A respawned worker can OVERTAKE its old total before the next
+        scrape (journal replay plus new load across a long scrape
+        interval) — no value regression ever shows, and the old run
+        would silently vanish from the merge. The router passes the
+        fleet's restart generation so a KNOWN respawn banks at once."""
+        floors = MonotonicCounters()
+        merged = merge_metrics(floors.adjust(
+            {"w1": {"counters": {"jobs_completed_total": 100}}},
+            incarnations={"w1": 0},
+        ))
+        assert merged["counters"]["jobs_completed_total"] == 100
+        # Respawned; the fresh run already reads 120 by the next scrape.
+        merged = merge_metrics(floors.adjust(
+            {"w1": {"counters": {"jobs_completed_total": 120}}},
+            incarnations={"w1": 1},
+        ))
+        assert merged["counters"]["jobs_completed_total"] == 220
+        # Steady state afterwards: no double-banking.
+        merged = merge_metrics(floors.adjust(
+            {"w1": {"counters": {"jobs_completed_total": 125}}},
+            incarnations={"w1": 1},
+        ))
+        assert merged["counters"]["jobs_completed_total"] == 225
+
+    def test_monotonic_histogram_count_and_sum(self):
+        """Histogram count/sum are cumulative like counters and expose as
+        Prometheus summary _count/_sum series: they must ride the same
+        high-water offsets across respawns and outages. Quantiles are
+        instantaneous — only live workers contribute them."""
+        floors = MonotonicCounters()
+        merged = merge_metrics(floors.adjust({
+            "w0": {"histograms": {"lat": {"count": 3, "sum": 1.5,
+                                          "p50": 0.5}}},
+            "w1": {"histograms": {"lat": {"count": 2, "sum": 1.0,
+                                          "p50": 0.2}}},
+        }))
+        h = merged["histograms"]["lat"]
+        assert h["count"] == 5 and h["sum"] == 2.5
+        # w1 down: its count/sum stand in; its quantile does not.
+        merged = merge_metrics(floors.adjust({
+            "w0": {"histograms": {"lat": {"count": 3, "sum": 1.5,
+                                          "p50": 0.5}}},
+        }))
+        h = merged["histograms"]["lat"]
+        assert h["count"] == 5 and h["sum"] == 2.5
+        assert h["p50"] == 0.5
+        # Respawned with a fresh (empty) registry: banked, not dropped.
+        merged = merge_metrics(floors.adjust({
+            "w0": {"histograms": {"lat": {"count": 3, "sum": 1.5}}},
+            "w1": {"histograms": {}},
+        }))
+        h = merged["histograms"]["lat"]
+        assert h["count"] == 5 and h["sum"] == 2.5
+        merged = merge_metrics(floors.adjust({
+            "w0": {"histograms": {"lat": {"count": 3, "sum": 1.5}}},
+            "w1": {"histograms": {"lat": {"count": 1, "sum": 0.25}}},
+        }))
+        h = merged["histograms"]["lat"]
+        assert h["count"] == 6 and h["sum"] == 2.75
 
     def test_slo_merge_worst_wins_and_prefixes(self):
         merged = merge_slo({
@@ -591,6 +793,93 @@ class TestSpilloverAndBigLane:
         finally:
             router.httpd.server_close()
 
+    def test_shedding_normals_still_propagate_429_despite_big_lane(
+            self, tmp_path):
+        """The big lane is the last resort for small jobs ONLY against
+        UNREACHABLE normals. Normals shedding 429s means the fleet is
+        alive and backpressuring on purpose — the client must see the
+        429 + Retry-After, not have its overflow silently compiled onto
+        the mesh-sharded lane's reserved budget."""
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            if "big0" in url:
+                return 202, {"id": "jb", "state": "queued"}
+            return 429, {"error": "shedding load", "retry_after_s": 5}
+
+        fleet = self._fake_fleet(tmp_path, ids=("wa", "wb", "big0"),
+                                 big=("big0",))
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 429 and "retry_after_s" in payload
+        finally:
+            router.httpd.server_close()
+
+    def test_big_lane_429_does_not_block_other_bigs(self, tmp_path):
+        """A 429 from a BIG worker is that worker being full, not the
+        small-lane backpressure signal: in a bigs-only fleet (bigs ARE
+        the routing pool) the next big still gets its try — a client
+        must only see 429 when every routable worker shed."""
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            if "biga" in url:
+                return 429, {"error": "shedding load", "retry_after_s": 5}
+            return 202, {"id": "jb", "state": "queued"}
+
+        fleet = self._fake_fleet(tmp_path, ids=("biga", "bigb"),
+                                 big=("biga", "bigb"))
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 202 and payload["worker"] == "bigb"
+        finally:
+            router.httpd.server_close()
+
+    def test_unreachable_normals_walk_the_whole_big_tail(self, tmp_path):
+        """With every normal unreachable, a shedding FIRST big must not
+        end the tail walk: the next big takes the job."""
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            if "biga" in url:
+                return 429, {"error": "shedding load", "retry_after_s": 5}
+            if "bigb" in url:
+                return 202, {"id": "jb", "state": "queued"}
+            raise ConnectionRefusedError("down")
+
+        fleet = self._fake_fleet(tmp_path, ids=("wa", "biga", "bigb"),
+                                 big=("biga", "bigb"))
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 202 and payload["worker"] == "bigb"
+        finally:
+            router.httpd.server_close()
+
+    def test_mixed_shed_and_unreachable_normals_propagate_429(
+            self, tmp_path):
+        """One normal shedding + one unreachable: a live shed signal
+        anywhere still wins over big-lane spillover."""
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            if "big0" in url:
+                return 202, {"id": "jb", "state": "queued"}
+            if "wa" in url:
+                raise ConnectionRefusedError("down")
+            return 429, {"error": "shedding load", "retry_after_s": 5}
+
+        fleet = self._fake_fleet(tmp_path, ids=("wa", "wb", "big0"),
+                                 big=("big0",))
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 429 and "retry_after_s" in payload
+        finally:
+            router.httpd.server_close()
+
     def test_oversized_boards_route_to_big_lane(self, tmp_path):
         fleet = self._fake_fleet(tmp_path, ids=("wa", "wb", "big0"),
                                  big=("big0",))
@@ -632,6 +921,88 @@ class TestSpilloverAndBigLane:
             for _ in range(8):
                 router.route_submit(body)
             assert len(router._jobs) == 4  # FIFO cap holds
+        finally:
+            router.httpd.server_close()
+
+    def test_small_jobs_spill_to_big_lane_as_true_last_resort(self, tmp_path):
+        """A fleet whose normal workers are ALL unreachable must not 503
+        small jobs while a healthy big-lane worker sits idle — workers
+        re-bucket jobs themselves, so spillover there is correctness-safe.
+        But the big lane stays LAST in the order: small jobs only reach it
+        when every normal worker (even unhealthy ones) already failed."""
+        fleet = self._fake_fleet(tmp_path, ids=("wa", "wb", "big0"),
+                                 big=("big0",))
+        router = RouterServer(fleet, port=0, big_edge=1024)
+        try:
+            small_key = placement.key_for({"width": 64, "height": 64})
+            order = router.candidates(small_key)
+            assert [w.id for w in order[:2]] != ["big0"]  # normals first
+            assert order[-1].id == "big0"
+            for wid in ("wa", "wb"):
+                fleet.worker(wid).healthy = False
+            assert router.candidates(small_key)[-1].id == "big0"
+            # An unhealthy big lane is no resort at all.
+            fleet.worker("big0").healthy = False
+            assert all(w.id != "big0"
+                       for w in router.candidates(small_key))
+        finally:
+            router.httpd.server_close()
+
+    def test_route_submit_lands_on_big_lane_when_normals_unreachable(
+            self, tmp_path):
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            if "big0" in url:
+                return 202, {"id": "jb", "state": "queued"}
+            raise ConnectionRefusedError("down")
+
+        fleet = self._fake_fleet(tmp_path, ids=("wa", "wb", "big0"),
+                                 big=("big0",))
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 202 and payload["worker"] == "big0"
+        finally:
+            router.httpd.server_close()
+
+    def test_concurrent_scrapes_single_flight(self, tmp_path):
+        """Concurrent /metrics scrapes must neither overlap (out-of-order
+        snapshots would double-bank a respawn in MonotonicCounters) nor
+        queue full fan-outs behind each other (a dead worker's connect
+        timeout per queued scrape re-freezes `gol top` mid-outage): a
+        late arrival shares the in-flight scrape's result."""
+        import threading
+
+        calls = []
+        gate = threading.Event()
+
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            calls.append(url)
+            gate.wait(timeout=10)
+            return 200, {"counters": {"jobs_completed_total": 1},
+                         "gauges": {}, "histograms": {}}
+
+        fleet = self._fake_fleet(tmp_path, ids=("wa",))
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            results = []
+            threads = [
+                threading.Thread(
+                    target=lambda: results.append(router.metrics_json())
+                )
+                for _ in range(3)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # one scrape in flight, the others waiting
+            gate.set()
+            for t in threads:
+                t.join(timeout=15)
+            assert len(results) == 3
+            assert len(calls) == 1  # ONE fan-out served all three
+            for r in results:
+                assert r["counters"]["jobs_completed_total"] == 1
         finally:
             router.httpd.server_close()
 
@@ -706,6 +1077,43 @@ class TestShardAcross:
             out = capsys.readouterr()
             assert "giving up on 1 job(s) there" in out.err
             # ...but the live worker's result landed regardless.
+            assert (outdir / "live.txt.out").exists()
+        finally:
+            srv.shutdown()
+
+    def test_collect_results_dead_target_holding_two_jobs(self, tmp_path,
+                                                          capsys):
+        """A dead sharded target holding TWO pending jobs: target_down()
+        deletes every job on that base, and the sweep's stale snapshot
+        then revisits the second one — the lookup must tolerate the
+        mid-sweep eviction (previously a KeyError crashed the whole
+        client, losing collection on healthy targets too)."""
+        import argparse
+
+        from gol_tpu import cli
+
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=43)
+            status, payload = _submit(srv.url, board, gen_limit=8)
+            assert status == 202
+            path = tmp_path / "live.txt"
+            path.write_bytes(text_grid.encode(board))
+            # The dead jobs FIRST: the first one's timeout evicts both,
+            # and the snapshot still holds the second.
+            pending = {
+                "deadjob1": ("dead1.txt", "http://127.0.0.1:1"),
+                "deadjob2": ("dead2.txt", "http://127.0.0.1:1"),
+                payload["id"]: (str(path), srv.url),
+            }
+            outdir = tmp_path / "out"
+            outdir.mkdir()
+            args = argparse.Namespace(poll_interval=0.05, server_timeout=0.5)
+            rc = cli._collect_results(pending, args, str(outdir))
+            assert rc == 1
+            assert "giving up on 2 job(s) there" in capsys.readouterr().err
             assert (outdir / "live.txt.out").exists()
         finally:
             srv.shutdown()
