@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -23,12 +23,17 @@ bench:
 
 # Regression gate over two BENCH_*.json artifacts of the same suite
 # (tools/bench_diff.py): nonzero exit when the headline metric moved in the
-# bad direction beyond TOLERANCE (default 10%), so it is CI-able.
+# bad direction beyond TOLERANCE (default 10%), so it is CI-able. METRIC
+# gates on a flattened nested leaf instead of the headline — the cache
+# suite's CI gate rides the warm-hit jobs/sec leaf so hit-path regressions
+# fail even when the cold lane moves too:
 #   make bench-diff OLD=BENCH_r08.json NEW=/tmp/BENCH_r08.json [TOLERANCE=0.1]
+#   make bench-diff OLD=BENCH_r11.json NEW=/tmp/BENCH_r11.json \
+#       METRIC=lanes.warm.jobs_per_sec
 bench-diff:
 	@test -n "$(OLD)" && test -n "$(NEW)" || \
-		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1]"; exit 2; }
-	python3 tools/bench_diff.py $(OLD) $(NEW) $(if $(TOLERANCE),--tolerance $(TOLERANCE))
+		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1] [METRIC=dot.path]"; exit 2; }
+	python3 tools/bench_diff.py $(OLD) $(NEW) $(if $(TOLERANCE),--tolerance $(TOLERANCE)) $(if $(METRIC),--metric $(METRIC))
 
 # Serving restart-safety smoke (tools/serve_smoke.py): boots `gol serve` on a
 # free port, submits 50 jobs across 2 bucket shapes, SIGKILLs it mid-batch,
@@ -78,6 +83,13 @@ slo-smoke:
 # results oracle-identical), and a cascaded SIGTERM drain exits clean.
 fleet-smoke:
 	python3 tools/fleet_smoke.py
+
+# Result-cache smoke (tools/cache_smoke.py): a real `gol serve
+# --result-cache` session is killed and restarted — the resubmitted board
+# must hit the on-disk CAS tier byte-identically to a cache-disabled run,
+# and a corrupted CAS entry must evict loudly and re-run correctly.
+cache-smoke:
+	python3 tools/cache_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

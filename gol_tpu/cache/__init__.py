@@ -1,0 +1,47 @@
+"""Content-addressed result cache: repeat traffic answered in O(1).
+
+At millions-of-users traffic the same boards recur constantly (pattern
+libraries, homework soups, benchmark loads), yet the engine's cost is
+O(work) per submission regardless — the Casper framing (PAPERS.md): don't
+move compute to data you already hold the answer for. This package keys
+every finished result by a decomposition-independent fingerprint of the
+*question* — ``fingerprint(board, convention, gen_limit, similarity
+config)`` — and serves repeats from a tiered data plane:
+
+1. **in-process LRU** (``store.MemoryLRU``) — bounded, O(1), dies with the
+   process;
+2. **on-disk CAS** (``store.DiskCAS``) — content-addressed files committed
+   with the tree's atomic staging discipline (temp + fsync + ``os.replace``,
+   as ``tune/plans.py``), CRC-gated on read: a torn or corrupted entry is
+   loudly evicted and the engine re-runs — a poisoned cache can never serve
+   bytes that fail their checksum. An optional TensorStore lane
+   (``io/ts_store.py``) packs large exact-fit payloads 8x.
+3. **fleet tier** — no new storage: the PR-8 router can rank workers by the
+   *fingerprint* instead of the padding bucket (``gol fleet
+   --cache-route``), so every repeat of a board lands on the one worker
+   whose tiers already hold its answer — hot patterns are O(1) fleet-wide
+   and spread across workers by fingerprint.
+
+Durability contract: the cache is an **accelerator, never a source of
+truth**. A cache hit is journaled as a normal DONE record (exactly-once and
+replay semantics unchanged); losing any cache tier costs re-computation,
+never correctness — journal replay always wins.
+"""
+
+from gol_tpu.cache.fingerprint import (  # noqa: F401
+    board_digest,
+    body_fingerprint,
+    result_fingerprint,
+)
+from gol_tpu.cache.store import CacheEntry, DiskCAS, MemoryLRU  # noqa: F401
+from gol_tpu.cache.tiered import ResultCache  # noqa: F401
+
+__all__ = [
+    "CacheEntry",
+    "DiskCAS",
+    "MemoryLRU",
+    "ResultCache",
+    "board_digest",
+    "body_fingerprint",
+    "result_fingerprint",
+]

@@ -1,0 +1,121 @@
+"""Result fingerprints: the cache key of one simulation question.
+
+A result is reusable iff the *question* matches exactly: the board, the
+loop-accounting convention, the generation limit, and the similarity-exit
+configuration. Everything else — padding bucket, batch slot, kernel flavor,
+pipeline depth, which worker ran it — is decomposition: the engine contract
+(test-pinned since PR 2) makes the answer bit-identical across all of them,
+so none of it may reach the key. Two properties follow:
+
+- **decomposition independence** — the board digest reuses the checkpoint
+  identity's positional limb math (``resilience/checkpoint.positional_
+  digest``: each cell contributes ``value * mix(row, col)``, summed mod
+  2^64), so the SAME board digests identically whether it arrives as a
+  plain ndarray, a C- or F-ordered view, or a sharded jax array — and a
+  job padded into different buckets under different tuned plans still hits.
+- **collision hardening** — a 64-bit positional sum alone is too weak to
+  gate byte-identity on, so the digest also folds in the CRC32 of the
+  canonical row-major cell bytes. The CAS layer re-verifies a stored
+  payload's CRC on every read regardless (a colliding OR corrupted entry
+  is evicted loudly and the engine re-runs).
+
+``body_fingerprint`` computes the same key from a raw ``POST /jobs`` JSON
+body — jax-free on purpose, so the fleet router (which owns no device) can
+rank workers by fingerprint without loading an engine.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.resilience.checkpoint import positional_digest, state_blocks
+
+SCHEMA_VERSION = 1
+
+
+def board_digest(board) -> str:
+    """Decomposition-independent digest of a board's cells.
+
+    ``board`` may be a numpy array or a (single-process) jax array — sharded
+    forms digest block-by-block through the same positional math, so the
+    digest never depends on how the caller happened to lay the cells out.
+    """
+    blocks = state_blocks(board)
+    positional = positional_digest(blocks)
+    # Canonical row-major bytes for the CRC fold: reassemble sharded forms.
+    if len(blocks) == 1 and blocks[0][0][0] == 0 and blocks[0][0][2] == 0:
+        cells = blocks[0][1]
+    else:
+        h, w = board.shape
+        cells = np.zeros((h, w), np.uint8)
+        for (r0, r1, c0, c1), piece in blocks:
+            cells[r0:r1, c0:c1] = piece
+    crc = zlib.crc32(np.ascontiguousarray(cells, dtype=np.uint8).tobytes())
+    return f"{positional & ((1 << 64) - 1):016x}{crc:08x}"
+
+
+def result_fingerprint(
+    board,
+    convention: str = Convention.C,
+    gen_limit: int = GameConfig().gen_limit,
+    check_similarity: bool = True,
+    similarity_frequency: int = GameConfig().similarity_frequency,
+) -> str:
+    """The cache key: board digest + every config axis that changes the
+    answer. Geometry is part of the key (two boards with equal digests but
+    different declared extents must never alias); the schema version makes
+    any future key-rule change a clean fleet-wide miss."""
+    h, w = board.shape
+    sim = f"s{int(similarity_frequency)}" if check_similarity else "nosim"
+    return (
+        f"v{SCHEMA_VERSION}-{board_digest(board)}-{h}x{w}"
+        f"-{convention}-g{int(gen_limit)}-{sim}"
+    )
+
+
+def job_fingerprint(job) -> str:
+    """``result_fingerprint`` of a serve ``Job`` (the scheduler's consult)."""
+    return result_fingerprint(
+        job.board,
+        convention=job.convention,
+        gen_limit=job.gen_limit,
+        check_similarity=job.check_similarity,
+        similarity_frequency=job.similarity_frequency,
+    )
+
+
+def body_fingerprint(body: dict) -> str:
+    """The same key from a raw ``POST /jobs`` body (router-side, jax-free).
+
+    Applies the worker's own field defaults (``Job`` / ``GameConfig``) so
+    router and worker derive identical keys for identical submissions.
+    Raises ``ValueError``/``TypeError``/``KeyError`` on bodies too
+    malformed to key — callers fall back to bucket routing (the worker's
+    full validation still answers the client).
+    """
+    from gol_tpu.io import text_grid
+
+    width, height = int(body["width"]), int(body["height"])
+    if width <= 0 or height <= 0:
+        raise ValueError(f"dimensions must be positive, got {height}x{width}")
+    check = body.get("check_similarity", True)
+    if not isinstance(check, bool):
+        raise TypeError(
+            f"check_similarity must be a JSON boolean, got "
+            f"{type(check).__name__}"
+        )
+    board = text_grid.decode(
+        str(body["cells"]).encode("ascii"), width, height
+    )
+    return result_fingerprint(
+        board,
+        convention=str(body.get("convention", Convention.C)),
+        gen_limit=int(body.get("gen_limit", GameConfig().gen_limit)),
+        check_similarity=check,
+        similarity_frequency=int(
+            body.get("similarity_frequency", GameConfig().similarity_frequency)
+        ),
+    )

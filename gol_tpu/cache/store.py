@@ -1,0 +1,260 @@
+"""The cache's storage tiers: bounded in-process LRU + on-disk CAS.
+
+``MemoryLRU`` answers the hot set in O(1) per lookup and dies with the
+process. ``DiskCAS`` is the durable tier: one content-addressed file per
+fingerprint, committed with the tree's staging discipline (temp file in the
+final directory + fsync + ``os.replace`` — exactly ``tune/plans.py``), so a
+crash mid-write leaves either no entry or a whole one, never a torn file
+that parses. Reads are CRC-gated over the *decoded cells*: an entry whose
+payload fails its checksum — disk corruption, a torn foreign write, a
+digest collision — is evicted loudly and the caller re-runs the engine.
+The CAS is an accelerator, never a source of truth: every entry is
+reconstructible by re-running the (pure) simulation, so eviction is always
+safe and recovery is never required.
+
+Payload encodings (the meta JSON is always the commit point):
+
+- ``text`` (default): the grid rides inside the meta file in the tree's
+  text-grid encoding — the same bytes the journal stores, one file per
+  entry, zero extra dependencies.
+- ``ts`` (optional): exact-fit payloads whose width packs (W % 32 == 0)
+  write their bitpacked words to a TensorStore zarr beside the meta
+  (``io/ts_store.py``) — 8x smaller than text for big boards. Anything the
+  lane cannot take (unpackable width, TensorStore missing) falls back to
+  ``text`` loudly; on read the CRC gate covers both encodings identically.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import os
+import sys
+import tempfile
+import threading
+import zlib
+
+import numpy as np
+
+from gol_tpu.io import text_grid
+from gol_tpu.resilience import STAGING_SUFFIX
+
+logger = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+_META_SUFFIX = ".json"
+_STORE_SUFFIX = ".zarr"
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One cached answer (mirrors the engine's per-board result)."""
+
+    grid: np.ndarray  # uint8 {0,1}, (height, width)
+    generations: int
+    exit_reason: str
+
+    def canonical_bytes(self) -> bytes:
+        """The whole decoded answer, canonically: row-major uint8 cell
+        bytes plus the scalar fields. The CRC gate covers ALL of it — a
+        poisoned ``generations`` or ``exit_reason`` is as wrong an answer
+        as a poisoned cell."""
+        scalars = f"|{int(self.generations)}|{self.exit_reason}".encode()
+        return (
+            np.ascontiguousarray(self.grid, dtype=np.uint8).tobytes()
+            + scalars
+        )
+
+
+class MemoryLRU:
+    """Bounded thread-safe LRU of fingerprint -> CacheEntry."""
+
+    def __init__(self, max_entries: int = 1024):
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.max_entries = max_entries
+        self.evictions = 0
+        self._lock = threading.Lock()
+        self._entries: collections.OrderedDict[str, CacheEntry] = (
+            collections.OrderedDict()
+        )
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, fp: str) -> CacheEntry | None:
+        with self._lock:
+            entry = self._entries.get(fp)
+            if entry is not None:
+                self._entries.move_to_end(fp)
+            return entry
+
+    def put(self, fp: str, entry: CacheEntry) -> None:
+        with self._lock:
+            self._entries[fp] = entry
+            self._entries.move_to_end(fp)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def pop(self, fp: str) -> None:
+        with self._lock:
+            self._entries.pop(fp, None)
+
+
+class DiskCAS:
+    """Content-addressed on-disk store: one entry per fingerprint.
+
+    Layout: ``<dir>/<fp[:2]>/<fp>.json`` (+ ``<fp>.zarr`` on the ts lane).
+    Writes are idempotent by construction — the same fingerprint always
+    encodes the same bytes, so concurrent/repeated puts race harmlessly to
+    identical content. ``on_evict(fp, reason)`` fires when a read finds a
+    torn/corrupt/mismatched entry (the caller's loud-evict counter).
+    """
+
+    def __init__(self, directory: str, payload: str = "text", on_evict=None):
+        if payload not in ("text", "ts"):
+            raise ValueError(f"payload must be 'text' or 'ts', got {payload!r}")
+        self.directory = directory
+        self.payload = payload
+        self.on_evict = on_evict
+        os.makedirs(directory, exist_ok=True)
+
+    # -- paths --------------------------------------------------------------
+
+    def _subdir(self, fp: str) -> str:
+        return os.path.join(self.directory, fp[:2])
+
+    def meta_path(self, fp: str) -> str:
+        return os.path.join(self._subdir(fp), fp + _META_SUFFIX)
+
+    def store_path(self, fp: str) -> str:
+        return os.path.join(self._subdir(fp), fp + _STORE_SUFFIX)
+
+    # -- writes -------------------------------------------------------------
+
+    def put(self, fp: str, entry: CacheEntry) -> None:
+        """Write one entry durably; the meta JSON commit is the atomic step
+        (a crash mid-payload leaves no meta — invisible garbage, exactly
+        the checkpoint manifests' write-ahead rule)."""
+        height, width = (int(x) for x in entry.grid.shape)
+        meta = {
+            "schema": SCHEMA_VERSION,
+            "fingerprint": fp,
+            "generations": int(entry.generations),
+            "exit_reason": str(entry.exit_reason),
+            "height": height,
+            "width": width,
+            "crc": zlib.crc32(entry.canonical_bytes()),
+        }
+        subdir = self._subdir(fp)
+        os.makedirs(subdir, exist_ok=True)
+        if self.payload == "ts" and width % 32 == 0 \
+                and sys.byteorder == "little":
+            try:
+                self._write_ts(fp, entry, width)
+                meta["payload"] = "ts"
+            except Exception as err:  # noqa: BLE001 - optional lane
+                logger.warning(
+                    "cache CAS: TensorStore payload for %s failed (%s: %s); "
+                    "falling back to text", fp, type(err).__name__, err,
+                )
+        if "payload" not in meta:
+            meta["payload"] = "text"
+            meta["grid"] = text_grid.encode(entry.grid).decode("ascii")
+        fd, tmp = tempfile.mkstemp(
+            dir=subdir, prefix=fp + ".", suffix=STAGING_SUFFIX
+        )
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(meta, f, separators=(",", ":"))
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.meta_path(fp))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _write_ts(self, fp: str, entry: CacheEntry, width: int) -> None:
+        import jax.numpy as jnp
+
+        from gol_tpu.io import bitpack, ts_store
+
+        words = bitpack.pack_words(
+            np.ascontiguousarray(entry.grid, dtype=np.uint8)
+        )
+        ts_store.write_words(self.store_path(fp), jnp.asarray(words), width)
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, fp: str) -> CacheEntry | None:
+        """Read + verify one entry; any defect evicts it loudly and answers
+        None (the engine re-runs — correctness never rests on the cache)."""
+        path = self.meta_path(fp)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                meta = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as err:
+            self._evict(fp, f"unreadable meta ({type(err).__name__}: {err})")
+            return None
+        try:
+            if meta["schema"] != SCHEMA_VERSION:
+                raise ValueError(f"schema {meta['schema']}")
+            if meta["fingerprint"] != fp:
+                raise ValueError(
+                    f"fingerprint mismatch (stored {meta['fingerprint']!r})"
+                )
+            width, height = int(meta["width"]), int(meta["height"])
+            if meta["payload"] == "ts":
+                grid = self._read_ts(fp, width, height)
+            else:
+                grid = text_grid.decode(
+                    meta["grid"].encode("ascii"), width, height
+                )
+            if grid.shape != (height, width):
+                raise ValueError(f"payload shape {grid.shape}")
+            entry = CacheEntry(
+                grid=grid,
+                generations=int(meta["generations"]),
+                exit_reason=str(meta["exit_reason"]),
+            )
+            if zlib.crc32(entry.canonical_bytes()) != int(meta["crc"]):
+                raise ValueError("payload CRC mismatch")
+        except Exception as err:  # noqa: BLE001 - every defect = evict+rerun
+            self._evict(fp, f"{type(err).__name__}: {err}")
+            return None
+        return entry
+
+    def _read_ts(self, fp: str, width: int, height: int) -> np.ndarray:
+        from gol_tpu.io import bitpack, ts_store
+
+        words = np.asarray(ts_store.read_words(self.store_path(fp),
+                                               width, height))
+        return np.ascontiguousarray(bitpack.unpack_words(words, width))
+
+    def _evict(self, fp: str, reason: str) -> None:
+        logger.warning(
+            "cache CAS: evicting corrupt entry %s (%s); the engine re-runs "
+            "— a poisoned cache entry can never be served", fp, reason,
+        )
+        for path in (self.meta_path(fp),):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        store = self.store_path(fp)
+        if os.path.isdir(store):
+            import shutil
+
+            shutil.rmtree(store, ignore_errors=True)
+        if self.on_evict is not None:
+            self.on_evict(fp, reason)

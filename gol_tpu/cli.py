@@ -764,6 +764,17 @@ def _serve(args) -> int:
         raise ValueError(
             f"--slo-latency-p99 must be > 0, got {args.slo_latency_p99}"
         )
+    if args.cache_entries < 1:
+        raise ValueError(
+            f"--cache-entries must be >= 1, got {args.cache_entries}"
+        )
+    # --result-cache with a journal but no explicit --cache-dir puts the
+    # CAS tier beside the journal: restarts (and fleet worker partitions,
+    # which forward --result-cache verbatim) keep their durable tier with
+    # zero extra flags. No journal and no --cache-dir = memory-only.
+    cache_dir = args.cache_dir
+    if args.result_cache and cache_dir is None and args.journal_dir:
+        cache_dir = os.path.join(args.journal_dir, "cache")
     server = GolServer(
         host=args.host,
         port=args.port,
@@ -777,6 +788,10 @@ def _serve(args) -> int:
         slo_shed=args.slo_shed,
         slo_latency_target=args.slo_latency_p99,
         sample_interval=args.sample_interval,
+        result_cache=args.result_cache,
+        cache_dir=cache_dir,
+        cache_entries=args.cache_entries,
+        cache_payload=args.cache_payload,
     )
     stop = {"signaled": False}
 
@@ -856,6 +871,12 @@ def _fleet(args) -> int:
         serve_args += ["--compile-cache", args.compile_cache]
     if args.slo_shed:
         serve_args += ["--slo-shed"]
+    if args.result_cache:
+        # Each worker's CAS tier lands on its own journal partition
+        # (--result-cache + --journal-dir defaults --cache-dir to
+        # <partition>/cache): with --cache-route, a fingerprint's HRW owner
+        # IS the worker whose partition holds its cache shard.
+        serve_args += ["--result-cache"]
 
     fleet = Fleet(args.fleet_dir, serve_args=serve_args)
     recovered = fleet.load()
@@ -871,7 +892,8 @@ def _fleet(args) -> int:
         )
     fleet.start_health(args.health_interval)
     router = RouterServer(fleet, host=args.host, port=args.port,
-                          big_edge=args.big_edge)
+                          big_edge=args.big_edge,
+                          cache_route=args.cache_route)
     stop = {"signaled": False}
 
     def _on_signal(signum, frame):
@@ -1115,6 +1137,10 @@ def _submit(args) -> int:
         }
         if args.deadline is not None:
             body["deadline_s"] = args.deadline
+        if args.no_cache:
+            # Per-job result-cache opt-out (Job.no_cache); servers without
+            # a cache ignore the field after type validation.
+            body["no_cache"] = True
         status, payload = _http_json("POST", f"{target}/jobs", body)
         if status != 202:
             print(f"gol submit: {path}: HTTP {status}: "
@@ -1233,8 +1259,14 @@ def _collect_results(pending: dict, args, outdir) -> int:
                 result["grid"].encode("ascii"), result["width"], result["height"]
             )
             text_grid.write_grid(out_path, grid)
+            # The cache marker: present only when the server answered from
+            # its result cache (or coalesced the run) — old servers' result
+            # payloads lack the key and the line degrades to nothing,
+            # exactly like the timeline columns after it.
+            cached = result.get("cached")
+            marker = f"\tcached:{cached}" if cached else ""
             print(f"{path}\tGenerations:\t{result['generations']}\t"
-                  f"{result['exit_reason']}\t-> {out_path}"
+                  f"{result['exit_reason']}\t-> {out_path}{marker}"
                   f"{_submit_latency_note(job_base, job_id)}")
     return rc
 
@@ -1654,6 +1686,31 @@ def build_parser() -> argparse.ArgumentParser:
         "either way",
     )
     srv.add_argument(
+        "--result-cache", action="store_true",
+        help="serve repeat boards from the content-addressed result cache "
+        "(gol_tpu/cache): identical submissions complete at admission in "
+        "O(1), identical in-flight submissions run the engine once. Hits "
+        "are journaled as normal DONE records (exactly-once unchanged); "
+        "per-job no_cache opts out. With --journal-dir the on-disk CAS "
+        "tier defaults to <journal-dir>/cache",
+    )
+    srv.add_argument(
+        "--cache-dir", default=None, metavar="DIR",
+        help="on-disk CAS tier for the result cache (implies "
+        "--result-cache): content-addressed CRC-gated entries that "
+        "survive restarts; corrupt entries evict loudly and re-run",
+    )
+    srv.add_argument(
+        "--cache-entries", type=int, default=1024, metavar="N",
+        help="in-process result-cache LRU bound (default 1024 entries)",
+    )
+    srv.add_argument(
+        "--cache-payload", choices=("text", "ts"), default="text",
+        help="CAS payload encoding: 'text' (default, self-contained) or "
+        "'ts' (TensorStore zarr via io/ts_store.py for exact-fit packed "
+        "payloads, 8x smaller; falls back to text where unavailable)",
+    )
+    srv.add_argument(
         "--warm-plans", action="store_true",
         help="pre-compile the bucket programs of every serve shape recorded "
         "by `gol tune` before accepting traffic",
@@ -1746,6 +1803,21 @@ def build_parser() -> argparse.ArgumentParser:
         "at boot (per-worker plan warm-up from the shared plan cache)",
     )
     flt.add_argument("--compile-cache", default=None, metavar="DIR")
+    flt.add_argument(
+        "--result-cache", action="store_true",
+        help="each worker mounts the tiered result cache (LRU + a CAS tier "
+        "on its own journal partition) — repeat boards complete at "
+        "admission; see `gol serve --result-cache`",
+    )
+    flt.add_argument(
+        "--cache-route", action="store_true",
+        help="route submissions by result FINGERPRINT instead of padding "
+        "bucket (the fleet cache tier): every repeat of a board lands on "
+        "the one worker whose cache holds its answer, and hot patterns "
+        "spread across workers by fingerprint. Trade: a bucket's programs "
+        "may compile on several workers (one-time, bought back by every "
+        "repeat). Pair with --result-cache",
+    )
     flt.add_argument("--slo-shed", action="store_true")
     flt.add_argument("--slo-latency-p99", type=float, default=60.0,
                      metavar="S")
@@ -1870,6 +1942,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="dispatch-ordering deadline, seconds from acceptance")
     sbm.add_argument("--no-wait", dest="wait", action="store_false",
                      help="submit and print job ids without polling")
+    sbm.add_argument(
+        "--no-cache", action="store_true",
+        help="opt these submissions out of the server's result cache "
+        "(always a fresh engine run); result lines from cache-served "
+        "repeats carry a 'cached:<tier>' marker otherwise",
+    )
     sbm.add_argument("--poll-interval", type=float, default=0.2)
     sbm.add_argument(
         "--server-timeout", type=float, default=60.0, metavar="S",

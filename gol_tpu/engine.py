@@ -1318,22 +1318,20 @@ def resolve_batch_mode(
 def _pack_board_words(stacked: np.ndarray) -> np.ndarray:
     """(B, H, W) uint8 cells -> (B, H, W/32) uint32 words on the host.
 
-    Same bit convention as ops/packed_math.encode (bit j of word w = column
-    32w+j): np.packbits little bit-order fills byte k with columns
-    8k..8k+7, and the little-endian uint32 view makes byte k bits 8k..8k+7
-    of its word. Packing on the host shrinks the device transfer 32x and
-    keeps encode/decode out of the compiled program entirely.
+    The bit convention (bit j of word w = column 32w+j, matching
+    ops/packed_math.encode) lives ONCE in ``io/bitpack.py`` — shared with
+    the result cache's packed payload lane so the two can never drift.
     """
-    b, h, w = stacked.shape
-    packed = np.packbits(stacked, axis=-1, bitorder="little")
-    return np.ascontiguousarray(packed).view(np.uint32).reshape(b, h, w // 32)
+    from gol_tpu.io import bitpack
+
+    return bitpack.pack_words(stacked)
 
 
 def _unpack_board_words(words: np.ndarray) -> np.ndarray:
     """Inverse of ``_pack_board_words``: words -> (B, H, W) uint8 cells."""
-    b, h, nw = words.shape
-    as_bytes = np.ascontiguousarray(words).view(np.uint8).reshape(b, h, nw * 4)
-    return np.unpackbits(as_bytes, axis=-1, bitorder="little")
+    from gol_tpu.io import bitpack
+
+    return bitpack.unpack_words(words)
 
 
 @functools.lru_cache(maxsize=256)

@@ -6,7 +6,9 @@ without modification — and adds the fleet surfaces:
 
 - ``POST /jobs``      — placed by padding bucket (``fleet/placement``:
   rendezvous-hashed, so a bucket's compiled programs and resident rings
-  stay hot on ONE worker), forwarded verbatim. A worker that 429s or is
+  stay hot on ONE worker), forwarded verbatim. With ``--cache-route`` the
+  HRW key is the job's result FINGERPRINT instead (gol_tpu/cache), so
+  repeats land on the worker whose cache tiers hold the answer. A worker that 429s or is
   unreachable spills to the next-ranked worker before the client sees an
   error; oversized boards (padded edge > ``big_edge``) go to the dedicated
   big-lane worker when the fleet has one. The 202 payload gains a
@@ -298,6 +300,7 @@ class RouterServer:
         big_edge: int = 1024,
         http=client.http_json,
         submit_timeout: float = 120.0,
+        cache_route: bool = False,
     ):
         if big_edge < placement.PLACEMENT_QUANTUM:
             raise ValueError(
@@ -308,6 +311,18 @@ class RouterServer:
         self.big_edge = big_edge
         self.http = http
         self.submit_timeout = submit_timeout
+        # The fleet cache tier (gol_tpu/cache): rank workers by the job's
+        # RESULT FINGERPRINT instead of its padding bucket, so every repeat
+        # of a board lands on the one worker whose cache tiers hold its
+        # answer — the cache shard for a fingerprint lives on its HRW
+        # owner, deterministically across router restarts, and hot patterns
+        # spread across the fleet by fingerprint instead of hammering one
+        # bucket owner. The trade (documented in README): one padding
+        # bucket's boards may now compile on several workers — a one-time
+        # cost per (bucket, worker), bought back by every repeat that
+        # skips its engine run. ``no_cache`` submissions keep bucket
+        # routing; spillover/health/big-lane ordering is identical.
+        self.cache_route = cache_route
         self.registry = Registry(prefix="gol_fleet")
         self._counter_floors = MonotonicCounters()
         # Single-flight scrape state (all guarded by the condition).
@@ -380,12 +395,16 @@ class RouterServer:
 
     # -- placement + forwarding --------------------------------------------
 
-    def candidates(self, key: placement.PlacementKey) -> list[Worker]:
+    def candidates(self, key: placement.PlacementKey,
+                   rank_label: str | None = None) -> list[Worker]:
         """Ranked forwarding order for one bucket: the rendezvous owner
         first, spillover next; workers the health loop marked unhealthy or
         backpressured sink to the tail (tried only when nothing better is
         left — routing around a worker must not turn into rejecting jobs
-        the moment the last healthy worker wobbles)."""
+        the moment the last healthy worker wobbles). ``rank_label``
+        overrides the HRW key (the cache tier ranks by fingerprint; the
+        health/big-lane ordering is identical either way)."""
+        label = rank_label if rank_label is not None else key.label()
         workers = {w.id: w for w in self.fleet.workers() if w.url}
         if not workers:
             return []
@@ -393,11 +412,11 @@ class RouterServer:
         bigs = [w for w in workers.values() if w.big]
         pool = normal or list(workers.values())
         ranked = [workers[wid] for wid in placement.rank(
-            key.label(), [w.id for w in pool]
+            label, [w.id for w in pool]
         )]
         if bigs and key.max_edge > self.big_edge:
             big_ranked = [workers[wid] for wid in placement.rank(
-                key.label(), [w.id for w in bigs]
+                label, [w.id for w in bigs]
             )]
             ranked = big_ranked + [w for w in ranked if not w.big]
         order = [w for w in ranked if w.healthy and not w.backpressure]
@@ -421,7 +440,21 @@ class RouterServer:
         if not isinstance(body, dict):
             raise ValueError("request body must be a JSON object")
         key = placement.key_for(body)  # raises -> handler's 400
-        order = self.candidates(key)
+        rank_label = None
+        if self.cache_route and not body.get("no_cache"):
+            # Fleet cache tier: repeats of a board must land where its
+            # answer is cached, so the HRW key is the result fingerprint
+            # (jax-free; gol_tpu/cache/fingerprint.py). A body the
+            # fingerprinter rejects falls back to bucket routing — the
+            # worker's full validation still answers the client.
+            from gol_tpu.cache.fingerprint import body_fingerprint
+
+            try:
+                rank_label = "fp:" + body_fingerprint(body)
+                self.registry.inc("jobs_cache_routed_total")
+            except (ValueError, TypeError, KeyError):
+                rank_label = None
+        order = self.candidates(key, rank_label=rank_label)
         if not order:
             return 503, {"error": "fleet has no routable workers"}
         last = (503, {"error": "no worker accepted the job"})
@@ -676,6 +709,7 @@ class RouterServer:
             "fleet_dir": self.fleet.fleet_dir,
             "draining": self._draining,
             "big_edge": self.big_edge,
+            "cache_route": self.cache_route,
             "workers": [w.public() for w in self.fleet.workers()],
         }
 

@@ -89,6 +89,23 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
         f"  shed {int(counters.get('jobs_shed_total', 0))}"
         f"  batches {int(counters.get('batches_total', 0))}"
     )
+    # Result cache (only when a cache is mounted — the counters exist then).
+    # The ratio is "consults that avoided an engine run": coalesced
+    # submissions are counted inside misses (every tier missed) AND here,
+    # so (hits + coalesced) / (hits + misses) is well-formed.
+    hits = counters.get("cache_hits_total")
+    misses = counters.get("cache_misses_total")
+    if hits is not None or misses is not None:
+        hits, misses = hits or 0, misses or 0
+        coalesced = counters.get("cache_inflight_coalesced_total", 0)
+        consults = hits + misses
+        ratio = (hits + coalesced) / consults if consults else 0.0
+        lines.append(
+            f"  cache: hit ratio {_bar(ratio)} {ratio:.2f}"
+            f"   hits {int(hits)} (mem {int(counters.get('cache_hits_total_memory', 0))}"
+            f"/disk {int(counters.get('cache_hits_total_disk', 0))})"
+            f"  coalesced {int(coalesced)}  misses {int(misses)}"
+        )
 
     # -- rings / dispatch gap ----------------------------------------------
     ring_occ = pgauges.get("ring_slot_occupancy")

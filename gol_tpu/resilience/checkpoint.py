@@ -121,6 +121,42 @@ def _merge_fingerprint_limbs(everyone) -> int:
     return sum(int(s) << (_LIMB_BITS * i) for i, s in enumerate(sums)) & _MASK64
 
 
+def positional_digest(blocks) -> int:
+    """The positional-hash core of ``run_fingerprint``, numpy-only: each
+    cell of each ``((r0, r1, c0, c1), block)`` piece contributes
+    ``value * mix(global_row, global_col)``, summed mod 2^64. Commutative
+    and per-cell, so the SAME state digests identically under ANY block
+    decomposition. Split out (jax-free) so the result cache
+    (gol_tpu/cache/fingerprint.py) keys boards with the exact same limb
+    math the checkpoint identity uses — including in the jax-free fleet
+    router."""
+    local = np.uint64(0)
+    for (r0, r1, c0, c1), block in blocks:
+        rr = np.arange(r0, r1, dtype=np.uint64)[:, None]
+        cc = np.arange(c0, c1, dtype=np.uint64)[None, :]
+        mix = (rr + np.uint64(1)) * np.uint64(0x9E3779B97F4A7C15) \
+            ^ (cc + np.uint64(1)) * np.uint64(0xC2B2AE3D27D4EB4F)
+        with np.errstate(over="ignore"):
+            local += (block.astype(np.uint64) * mix).sum(dtype=np.uint64)
+    return int(local)
+
+
+def state_blocks(state):
+    """``(index ranges, ndarray)`` pieces of a (possibly sharded) 2-D state
+    — the decomposition ``positional_digest`` and the CRC pass consume."""
+    h, w = state.shape
+    shards = getattr(state, "addressable_shards", None)
+    if shards is None:
+        return [((0, h, 0, w), np.ascontiguousarray(np.asarray(state)))]
+    blocks = []
+    for shard in shards:
+        rows, cols = shard.index[0], shard.index[1]
+        r0, r1, _ = rows.indices(h)
+        c0, c1, _ = cols.indices(w)
+        blocks.append(((r0, r1, c0, c1), np.asarray(shard.data)))
+    return blocks
+
+
 def run_fingerprint(state, tag: str = "") -> str:
     """Cluster-stable fingerprint of a run's identity, computed from its
     INITIAL state as a positional hash: each cell contributes
@@ -135,26 +171,7 @@ def run_fingerprint(state, tag: str = "") -> str:
     ``tag`` folds in non-derivable config identity (convention)."""
     import jax
 
-    h, w = state.shape
-    shards = getattr(state, "addressable_shards", None)
-    if shards is None:
-        blocks = [((0, h, 0, w), np.ascontiguousarray(np.asarray(state)))]
-    else:
-        blocks = []
-        for shard in shards:
-            rows, cols = shard.index[0], shard.index[1]
-            r0, r1, _ = rows.indices(h)
-            c0, c1, _ = cols.indices(w)
-            blocks.append(((r0, r1, c0, c1), np.asarray(shard.data)))
-    local = np.uint64(0)
-    for (r0, r1, c0, c1), block in blocks:
-        rr = np.arange(r0, r1, dtype=np.uint64)[:, None]
-        cc = np.arange(c0, c1, dtype=np.uint64)[None, :]
-        mix = (rr + np.uint64(1)) * np.uint64(0x9E3779B97F4A7C15) \
-            ^ (cc + np.uint64(1)) * np.uint64(0xC2B2AE3D27D4EB4F)
-        with np.errstate(over="ignore"):
-            local += (block.astype(np.uint64) * mix).sum(dtype=np.uint64)
-    total = int(local)
+    total = positional_digest(state_blocks(state))
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

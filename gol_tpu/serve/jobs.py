@@ -59,8 +59,11 @@ TERMINAL_STATES = frozenset({DONE, FAILED, CANCELLED})
 # Batch retries happen while jobs are held in RUNNING (the RetryPolicy wraps
 # the dispatch; nothing ever re-queues a claimed job), so RUNNING's only
 # exits are terminal.
+# QUEUED -> DONE is the result-cache path (gol_tpu/cache): a hit — or a
+# coalesced duplicate completed by its in-flight leader — finishes without
+# ever being claimed by a batch.
 _TRANSITIONS = {
-    QUEUED: {SCHEDULED, CANCELLED, FAILED},
+    QUEUED: {SCHEDULED, CANCELLED, FAILED, DONE},
     SCHEDULED: {RUNNING, FAILED},
     RUNNING: {DONE, FAILED},
     DONE: set(),
@@ -76,6 +79,11 @@ class JobResult:
     grid: np.ndarray  # uint8 {0,1}, (height, width)
     generations: int
     exit_reason: str  # engine.EXIT_REASONS member
+    # How the answer was produced: None = the engine ran it; "memory"/"disk"
+    # = a result-cache tier served it; "coalesced" = an identical in-flight
+    # submission's engine run completed it. Journaled in the done record so
+    # restarted servers keep reporting it (clients print the marker).
+    cached: str | None = None
 
 
 @dataclasses.dataclass
@@ -92,7 +100,12 @@ class Job:
     similarity_frequency: int = GameConfig().similarity_frequency
     priority: int = 0  # higher dispatches first within a bucket
     deadline_s: float | None = None  # seconds from acceptance; orders dispatch
+    no_cache: bool = False  # opt this submission out of the result cache
     state: str = QUEUED
+    # The result-cache key (gol_tpu/cache/fingerprint.py), computed by the
+    # scheduler at admission when a cache is mounted; None otherwise (and
+    # for no_cache jobs). Process-local — replayed jobs re-derive it.
+    fingerprint: str | None = None
     # perf_counter stamps, process-local (never journaled).
     accepted_at: float = 0.0
     started_at: float | None = None
@@ -120,6 +133,15 @@ class Job:
             raise TypeError(
                 f"check_similarity must be a JSON boolean, got "
                 f"{type(self.check_similarity).__name__}"
+            )
+        # Same strictness for the cache opt-out: bool("false") is True, and
+        # a truthy-string no_cache would silently bypass the cache (the
+        # harmless direction) while {"no_cache": 0} meaning "do cache"
+        # already works — a non-bool is a client error either way.
+        if not isinstance(self.no_cache, bool):
+            raise TypeError(
+                f"no_cache must be a JSON boolean, got "
+                f"{type(self.no_cache).__name__}"
             )
         self.priority = int(self.priority)
         if self.deadline_s is not None:
@@ -184,6 +206,9 @@ class Job:
             "priority": self.priority,
             "deadline_s": self.deadline_s,
             "cells": text_grid.encode(self.board).decode("ascii"),
+            # Only when set: default-path submit records stay byte-stable,
+            # and old journals replay with the default (cache allowed).
+            **({"no_cache": True} if self.no_cache else {}),
         }
 
     @classmethod
@@ -204,6 +229,7 @@ class Job:
             ),
             priority=rec.get("priority", 0),
             deadline_s=rec.get("deadline_s"),
+            no_cache=rec.get("no_cache", False),
             accepted_at=time.perf_counter(),
         )
 
@@ -293,6 +319,9 @@ class JobJournal:
             "width": int(r.grid.shape[1]),
             "height": int(r.grid.shape[0]),
             "grid": text_grid.encode(r.grid).decode("ascii"),
+            # Only on cache/coalesced completions: engine-path records stay
+            # byte-stable, old journals replay as engine results.
+            **({"cached": r.cached} if r.cached else {}),
         }
 
     def record_done(self, job: Job) -> None:
@@ -361,6 +390,7 @@ class JobJournal:
                             grid=grid,
                             generations=rec["generations"],
                             exit_reason=rec["exit_reason"],
+                            cached=rec.get("cached"),
                         )
                         pending.pop(rec["id"], None)
                     elif event == "failed":

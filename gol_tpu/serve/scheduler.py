@@ -29,6 +29,18 @@ Graceful drain: ``drain()`` stops admission, flushes every queued bucket,
 and returns when the last in-flight batch completes — the SIGTERM story for
 ``gol serve``.
 
+**Result cache** (``cache=ResultCache(...)``, ``gol serve
+--result-cache``): the scheduler consults the tiered content-addressed
+cache (gol_tpu/cache) BEFORE enqueueing work. A hit completes the job at
+admission — journaled as a completely normal DONE record, so exactly-once
+and replay semantics are unchanged (a crash between the submit and done
+records re-runs the job idempotently, exactly like a lost engine-path
+record). A miss registers the job's fingerprint as *in flight*: further
+identical submissions coalesce behind that leader and are all completed —
+each with its own journaled DONE — by the leader's single engine run.
+Engine results write through to every tier; ``no_cache`` jobs bypass all
+of it. The cache is an accelerator, never a source of truth.
+
 **Pipelined dispatch** (``pipeline_depth`` >= 2, ``gol serve
 --pipeline-depth``): the single synchronous worker — stage, compute,
 readback, journal strictly in series, host idle while the device computes
@@ -55,6 +67,8 @@ import threading
 import time
 from typing import Any
 
+from gol_tpu.cache.fingerprint import job_fingerprint
+from gol_tpu.cache.store import CacheEntry
 from gol_tpu.obs import trace as obs_trace
 from gol_tpu.obs.registry import metric_label
 from gol_tpu.resilience.retry import RetryPolicy, is_transient_io
@@ -62,7 +76,7 @@ from gol_tpu.serve import batcher
 from gol_tpu.serve.batcher import BucketKey, bucket_for, pad_batch
 from gol_tpu.serve.jobs import (
     CANCELLED, DONE, FAILED, QUEUED, RUNNING, SCHEDULED,
-    Job, JobJournal, priority_class,
+    Job, JobJournal, JobResult, priority_class,
 )
 from gol_tpu.serve.metrics import Metrics
 
@@ -118,6 +132,7 @@ class Scheduler:
         retryable=is_transient_io,
         run_batch=batcher.run_batch,
         split_batch=None,
+        cache=None,
         clock=time.perf_counter,
     ):
         if max_queue_depth < 1:
@@ -193,6 +208,13 @@ class Scheduler:
         self._journal_window = None
         self._journal_thread = None
         self._clock = clock
+        # The tiered result cache (gol_tpu/cache.ResultCache) or None.
+        # _inflight_fp maps a fingerprint to its LEADER job (queued or
+        # running); _followers holds identical submissions coalescing
+        # behind it. Both are guarded by _cv.
+        self.cache = cache
+        self._inflight_fp: dict[str, Job] = {}
+        self._followers: dict[str, list[Job]] = {}
         self._cv = threading.Condition()
         self._jobs: dict[str, Job] = {}
         self._buckets: dict[BucketKey, list[Job]] = {}
@@ -308,6 +330,21 @@ class Scheduler:
         can legitimately exceed ``max_queue_depth`` by the jobs that were
         in flight when the process died)."""
         key = bucket_for(job)  # raises on un-runnable jobs before admission
+        # Fingerprint + tier consult OUTSIDE the lock: hashing the board and
+        # a CAS read are real work, and workers must not stall behind them.
+        # The race this opens (a leader completing between our miss and our
+        # lock) costs at most one redundant — idempotent — engine run.
+        # The admission gates are pre-checked FIRST (racy, lock-free reads;
+        # the authoritative checks re-run under the lock below): a
+        # submission that will be 429'd must not amplify overload with a
+        # CAS disk read, nor count a consult in the hit/miss series.
+        fp = hit = None
+        if self.cache is not None and not job.no_cache and not (
+            record and (self._draining
+                        or self._queued >= self.max_queue_depth)
+        ):
+            fp = job_fingerprint(job)
+            hit = self.cache.get(fp)
         with self._cv:
             if record and self._draining:
                 self.metrics.inc("jobs_rejected_total")
@@ -330,15 +367,63 @@ class Scheduler:
             job.accepted_at = self._clock()
             job.timeline["accepted"] = job.accepted_at
             self._jobs[job.id] = job
-            self._buckets.setdefault(key, []).append(job)
-            self._queued += 1
             self.metrics.inc("jobs_accepted_total")
-            self.metrics.set_gauge("queue_depth", self._queued)
-            self._cv.notify_all()
+            if hit is not None:
+                # Cache hit: complete at admission — never enqueued, never
+                # batched. State flips under the lock; the (fsynced) done
+                # record is appended after it, on this thread, so its
+                # ledger ordering after the submit record holds.
+                entry, tier = hit
+                self._complete_from_cache_locked(job, entry, tier)
+            elif fp is not None and fp in self._inflight_fp:
+                # An identical board is already queued/running: coalesce.
+                # The leader's ONE engine run completes every follower,
+                # each with its own journaled DONE record.
+                job.fingerprint = fp
+                self._followers.setdefault(fp, []).append(job)
+                self._queued += 1
+                self.metrics.inc("cache_inflight_coalesced_total")
+                self.metrics.set_gauge("queue_depth", self._queued)
+                self._fold_urgency_locked(self._inflight_fp[fp], job)
+            else:
+                if fp is not None:
+                    job.fingerprint = fp
+                    self._inflight_fp[fp] = job
+                self._buckets.setdefault(key, []).append(job)
+                self._queued += 1
+                self.metrics.set_gauge("queue_depth", self._queued)
+                self._cv.notify_all()
         # Flow START: with tracing on, the job's lifecycle becomes a Perfetto
         # arrow chain from here to its finish inside a batch span.
         obs_trace.flow("job", job.id, "s", bucket=key.label())
+        if hit is not None:
+            self._journal_terminal(JobJournal.record_done, job)
+            obs_trace.flow("job", job.id, "f", state="cached")
         return job
+
+    def _complete_from_cache_locked(self, job: Job, entry: CacheEntry,
+                                    tier: str) -> None:
+        """Finish a job from a cache entry (caller holds the lock and
+        journals the done record afterwards). Engine-work counters
+        (batches/boards/cell-updates) are deliberately NOT fed — a hit did
+        no engine work, and claiming otherwise would corrupt the
+        dispatch-gap monitor's achieved-rate numerator."""
+        finished = self._clock()
+        job.finished_at = finished
+        job.timeline["done"] = finished
+        job.result = JobResult(
+            grid=entry.grid,
+            generations=entry.generations,
+            exit_reason=entry.exit_reason,
+            cached=tier,
+        )
+        job.transition(DONE)
+        self.metrics.inc("jobs_completed_total")
+        latency = finished - job.accepted_at
+        self.metrics.observe("job_latency_seconds", latency)
+        self.metrics.observe(
+            "job_latency_seconds_" + priority_class(job.priority), latency
+        )
 
     def resubmit_replayed(self, replayed: list[Job]) -> int:
         """Queue journal-replayed jobs (already durable; not re-recorded)."""
@@ -355,13 +440,29 @@ class Scheduler:
             return self._jobs.get(job_id)
 
     def cancel(self, job_id: str) -> bool:
-        """Cancel a job that has not been claimed by a batch yet."""
+        """Cancel a job that has not been claimed by a batch yet.
+
+        A coalesced follower cancels out of its leader's wait list; a
+        QUEUED *leader* with followers hands the bucket slot (and the
+        in-flight registration) to its first follower, so the remaining
+        duplicates still run exactly once."""
         with self._cv:
             job = self._jobs.get(job_id)
             if job is None or job.state != QUEUED:
                 return False
             key = bucket_for(job)
-            self._buckets[key].remove(job)
+            bucket = self._buckets.get(key, [])
+            followers = (self._followers.get(job.fingerprint, [])
+                         if job.fingerprint is not None else [])
+            if job in bucket:
+                bucket.remove(job)
+                self._promote_follower_locked(job, bucket)
+            elif job in followers:
+                followers.remove(job)
+            else:
+                # QUEUED but in neither structure: another thread is
+                # completing it right now (cache/coalesce handoff window).
+                return False
             self._queued -= 1
             job.transition(CANCELLED)
             self.metrics.inc("jobs_cancelled_total")
@@ -370,6 +471,53 @@ class Scheduler:
         if self.journal is not None:
             self.journal.record_cancelled(job)
         return True
+
+    def _fold_urgency_locked(self, leader: Job, follower: Job) -> None:
+        """Fold a follower's dispatch urgency into its still-QUEUED leader.
+
+        Followers never sit in a bucket, so ``_claim_locked`` and
+        ``_bucket_due_at`` only ever see the leader — without this fold, a
+        high-priority or tight-deadline duplicate would inherit its
+        leader's (possibly lowest) urgency, breaking the priority/deadline
+        ordering guarantee for exactly the repeat traffic the cache
+        targets. The leader's priority class (SLO histograms) follows the
+        bump deliberately: its one engine run IS serving the most urgent
+        request coalesced behind it. Once claimed, dispatch order is
+        already decided — nothing to fold."""
+        if leader.state != QUEUED:
+            return
+        changed = False
+        if follower.priority > leader.priority:
+            leader.priority = follower.priority
+            changed = True
+        if follower.deadline_s is not None:
+            follower_due = follower.accepted_at + follower.deadline_s
+            leader_due = (leader.accepted_at + leader.deadline_s
+                          if leader.deadline_s is not None else None)
+            if leader_due is None or follower_due < leader_due:
+                leader.deadline_s = follower_due - leader.accepted_at
+                changed = True
+        if changed:
+            # The leader's bucket may have become due earlier than the
+            # wait a worker computed from the old urgency.
+            self._cv.notify_all()
+
+    def _promote_follower_locked(self, leader: Job, bucket: list) -> None:
+        """A queued leader left the bucket (cancel): its first follower —
+        if any — takes over as the fingerprint's leader and engine run,
+        inheriting the remaining followers' folded urgency."""
+        fp = leader.fingerprint
+        if fp is None or self._inflight_fp.get(fp) is not leader:
+            return
+        followers = self._followers.get(fp, [])
+        if followers:
+            promoted = followers.pop(0)
+            self._inflight_fp[fp] = promoted
+            bucket.append(promoted)  # same board => same bucket key
+            for waiting in followers:
+                self._fold_urgency_locked(promoted, waiting)
+        else:
+            del self._inflight_fp[fp]
 
     # -- batch forming -----------------------------------------------------
 
@@ -482,7 +630,9 @@ class Scheduler:
             "batch %s (%d jobs) failed: %s: %s",
             key.label(), len(batch), type(err).__name__, err,
         )
-        for job in batch:
+        # Followers coalesced behind these leaders share their fate: the
+        # one engine run they were waiting on is not coming.
+        for job in batch + self._take_followers(batch):
             job.finished_at = finished
             job.timeline["done"] = finished
             job.error = f"{type(err).__name__}: {err}"
@@ -490,6 +640,27 @@ class Scheduler:
             self.metrics.inc("jobs_failed_total")
             obs_trace.flow("job", job.id, "f", state="failed")
             self._journal_terminal(JobJournal.record_failed, job)
+
+    def _take_followers(self, batch: list[Job]) -> list[Job]:
+        """Atomically claim every follower coalesced behind these jobs and
+        retire their in-flight registrations. Called AFTER the leaders'
+        results are in the cache (finish) or known unobtainable (fail), so
+        a submit racing this pop either still coalesces or hits the
+        fresh cache entry — never falls through to a third path that
+        loses the result."""
+        taken: list[Job] = []
+        with self._cv:
+            for job in batch:
+                if job.fingerprint is None:
+                    continue
+                if self._inflight_fp.get(job.fingerprint) is job:
+                    del self._inflight_fp[job.fingerprint]
+                taken.extend(self._followers.pop(job.fingerprint, []))
+            if taken:
+                self._queued -= len(taken)
+                self.metrics.set_gauge("queue_depth", self._queued)
+                self._cv.notify_all()
+        return taken
 
     def _finish_batch(self, key: BucketKey, batch: list[Job], results,
                       started: float) -> None:
@@ -526,13 +697,58 @@ class Scheduler:
         self.metrics.inc(
             "serve_cell_updates_total_" + metric_label(key.label()), cells
         )
+        # Write-through BEFORE retiring the in-flight registrations: a
+        # submit racing the handoff either still coalesces behind the
+        # leader or hits the tier the result just landed in — there is no
+        # window where it would redundantly re-run. A no_cache job never
+        # acquired a fingerprint, so it never writes.
+        if self.cache is not None:
+            for job in batch:
+                if job.fingerprint is not None:
+                    r = job.result
+                    self.cache.put(job.fingerprint, CacheEntry(
+                        grid=r.grid,
+                        generations=r.generations,
+                        exit_reason=r.exit_reason,
+                    ))
+        followers = self._take_followers(batch)
+        for f in followers:
+            leader = self._inflight_result(f, batch)
+            f.finished_at = finished
+            f.timeline["done"] = finished
+            f.result = JobResult(
+                grid=leader.grid,
+                generations=leader.generations,
+                exit_reason=leader.exit_reason,
+                cached="coalesced",
+            )
+            f.transition(DONE)
+            self.metrics.inc("jobs_completed_total")
+            latency = finished - f.accepted_at
+            self.metrics.observe("job_latency_seconds", latency)
+            self.metrics.observe(
+                "job_latency_seconds_" + priority_class(f.priority), latency
+            )
+            obs_trace.flow("job", f.id, "f", state="coalesced")
         # One journal append + fsync for the whole batch's done records
         # (identical lines to per-job appends — replay is oblivious): the
         # per-record fsync was the last per-*job* serial host cost on the
         # hot path. Durability contract unchanged: a crash before the
         # append re-runs the batch idempotently after replay, exactly like
         # a single lost record.
-        self._journal_terminal(JobJournal.record_done_many, batch)
+        self._journal_terminal(JobJournal.record_done_many, batch + followers)
+
+    @staticmethod
+    def _inflight_result(follower: Job, batch: list[Job]) -> JobResult:
+        """The leader result a follower coalesced behind (same fingerprint,
+        same batch — leaders complete with their own batch)."""
+        for job in batch:
+            if job.fingerprint == follower.fingerprint:
+                return job.result
+        raise RuntimeError(
+            f"follower {follower.id} has no leader in its batch "
+            f"(fingerprint {follower.fingerprint})"
+        )
 
     def _execute(self, key: BucketKey, batch: list[Job]) -> None:
         started = self._clock()
@@ -798,6 +1014,9 @@ class Scheduler:
         with self._cv:
             out = {
                 "queued": self._queued,
+                "coalesced_waiting": sum(
+                    len(v) for v in self._followers.values()
+                ),
                 "inflight_batches": self._inflight,
                 "buckets": {
                     k.label(): len(v) for k, v in self._buckets.items() if v

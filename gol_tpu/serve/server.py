@@ -4,12 +4,16 @@ Endpoints (all JSON unless noted):
 
 - ``POST /jobs``      — submit a job; body ``{"width", "height", "cells",
   "convention"?, "gen_limit"?, "check_similarity"?, "similarity_frequency"?,
-  "priority"?, "deadline_s"?}`` where ``cells`` is the text-grid encoding
-  (the same bytes the CLI reads/writes). 202 + ``{"id", "state"}`` on
-  acceptance, 429 when the queue is full or draining, 400 on a bad request.
+  "priority"?, "deadline_s"?, "no_cache"?}`` where ``cells`` is the
+  text-grid encoding (the same bytes the CLI reads/writes). 202 + ``{"id",
+  "state"}`` on acceptance, 429 when the queue is full or draining, 400 on
+  a bad request. With the result cache mounted (``--result-cache``) a
+  repeat board completes at admission; ``no_cache: true`` opts out.
 - ``GET /jobs/<id>``  — lifecycle state + timings.
 - ``GET /result/<id>``— final grid (text-grid string), generations, exit
-  reason; 409 while the job is not DONE, 410 for FAILED/CANCELLED.
+  reason; 409 while the job is not DONE, 410 for FAILED/CANCELLED. A
+  result served by the cache (or a coalesced duplicate) carries
+  ``"cached": "memory"|"disk"|"coalesced"``.
 - ``DELETE /jobs/<id>`` — cancel a still-QUEUED job; 409 once it has been
   claimed by a batch (dispatch is not interruptible), 404 if unknown.
 - ``GET /jobs/<id>/timeline`` — the job's milestone/segment decomposition
@@ -98,12 +102,31 @@ class GolServer:
         slo_shed: bool = False,
         slo_latency_target: float = 60.0,
         sample_interval: float = 1.0,
+        result_cache: bool = False,
+        cache_dir: str | None = None,
+        cache_entries: int = 1024,
+        cache_payload: str = "text",
         **scheduler_kwargs,
     ):
         self.metrics = metrics or Metrics()
         journal = JobJournal(journal_dir) if journal_dir else None
+        # The tiered result cache (gol_tpu/cache): --result-cache mounts the
+        # in-process LRU, --cache-dir adds the on-disk CAS tier (and implies
+        # enablement). Counters ride the serving registry so hit ratios
+        # merge fleet-wide like any other serving series.
+        cache = None
+        if result_cache or cache_dir:
+            from gol_tpu.cache import ResultCache
+
+            cache = ResultCache(
+                memory_entries=cache_entries,
+                cas_dir=cache_dir,
+                metrics=self.metrics,
+                payload=cache_payload,
+            )
         self.scheduler = scheduler or Scheduler(
-            journal=journal, metrics=self.metrics, **scheduler_kwargs
+            journal=journal, metrics=self.metrics, cache=cache,
+            **scheduler_kwargs
         )
         # The SLO engine evaluates the scheduler's own metrics registry;
         # observe-only unless slo_shed (the pinned default). An injected
@@ -202,7 +225,7 @@ class GolServer:
         kwargs = {}
         for field in (
             "convention", "gen_limit", "check_similarity",
-            "similarity_frequency", "priority",
+            "similarity_frequency", "priority", "no_cache",
         ):
             if field in body:
                 kwargs[field] = body[field]
@@ -274,6 +297,9 @@ class GolServer:
                 "width": int(result.grid.shape[1]),
                 "height": int(result.grid.shape[0]),
                 "grid": text_grid.encode(result.grid).decode("ascii"),
+                # Only on cache/coalesced completions (clients print the
+                # marker; old-server payloads simply lack the key).
+                **({"cached": result.cached} if result.cached else {}),
             }
         if job is None:
             if job_id in self._replay_failed:

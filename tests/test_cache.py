@@ -1,0 +1,691 @@
+"""Content-addressed result cache (gol_tpu/cache) + its serve/fleet tiers.
+
+Covers the ISSUE 9 acceptance surface:
+
+- fingerprint stability: same board under different layouts/shardings and
+  different QoS/padding decompositions -> same key; every answer-changing
+  config axis -> different key; the router's jax-free ``body_fingerprint``
+  agrees with the worker's ``job_fingerprint``.
+- tiers: LRU bound + recency, CAS round-trip, torn/corrupt/mismatched
+  entries evicted loudly (and re-runnable), the optional TensorStore
+  payload lane, disk->memory promotion.
+- scheduler: hits byte-identical to engine results and journaled as normal
+  DONE records (replay-after-hit exactly-once), in-flight dedup (one
+  engine run, N journaled completions), cancel semantics for leaders and
+  followers, no_cache opt-out, corrupt-entry re-run.
+- fleet tier: deterministic fingerprint-HRW routing (repeats land on the
+  owner), fallbacks for no_cache and unfingerprintable bodies.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.cache import CacheEntry, DiskCAS, MemoryLRU, ResultCache
+from gol_tpu.cache.fingerprint import (
+    board_digest,
+    body_fingerprint,
+    job_fingerprint,
+    result_fingerprint,
+)
+from gol_tpu.io import text_grid
+from gol_tpu.serve import batcher
+from gol_tpu.serve.jobs import CANCELLED, DONE, FAILED, JobJournal, new_job
+from gol_tpu.serve.metrics import Metrics
+from gol_tpu.serve.scheduler import Scheduler
+
+
+def _board(seed: int, shape=(16, 16)) -> np.ndarray:
+    return np.random.default_rng(seed).integers(
+        0, 2, size=shape, dtype=np.uint8
+    )
+
+
+def _entry(seed: int = 0, shape=(16, 16)) -> CacheEntry:
+    return CacheEntry(grid=_board(seed, shape), generations=seed + 1,
+                      exit_reason="gen_limit")
+
+
+def _wait_done(jobs, timeout=30.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if all(j.state in (DONE, FAILED, CANCELLED) for j in jobs):
+            return
+        time.sleep(0.005)
+    raise AssertionError(
+        f"jobs not terminal: {[(j.id, j.state) for j in jobs]}"
+    )
+
+
+# ---------------------------------------------------------------------------
+class TestFingerprint:
+    def test_stable_across_layouts(self):
+        g = _board(3)
+        assert board_digest(g) == board_digest(g.copy())
+        assert board_digest(g) == board_digest(np.asfortranarray(g))
+        assert board_digest(g) == board_digest(g.astype(np.int64))
+
+    def test_sharding_independent(self):
+        # Same shard-faking scheme as the checkpoint fingerprint tests:
+        # the digest must not depend on how the cells are decomposed.
+        g = _board(4, (8, 8))
+
+        def sharded(cuts):
+            shards = [
+                type("S", (), {"data": g[rs, cs], "index": (rs, cs)})()
+                for rs, cs in cuts
+            ]
+            return type("A", (), {"shape": g.shape,
+                                  "addressable_shards": shards})()
+
+        rows = sharded([(slice(0, 4), slice(0, 8)),
+                        (slice(4, 8), slice(0, 8))])
+        quads = sharded([
+            (slice(0, 4), slice(0, 4)), (slice(0, 4), slice(4, 8)),
+            (slice(4, 8), slice(0, 4)), (slice(4, 8), slice(4, 8)),
+        ])
+        assert board_digest(rows) == board_digest(g)
+        assert board_digest(quads) == board_digest(g)
+
+    def test_decomposition_fields_do_not_enter_the_key(self):
+        # Priority, deadline, and padding/batching are decomposition — the
+        # engine contract makes the answer identical across them, so two
+        # jobs differing only there MUST share a key (that is the hit).
+        g = _board(5, (30, 30))
+        a = new_job(30, 30, g, gen_limit=8)
+        b = new_job(30, 30, g, gen_limit=8, priority=7, deadline_s=1.0)
+        assert job_fingerprint(a) == job_fingerprint(b)
+
+    def test_answer_axes_change_the_key(self):
+        g = _board(6)
+        base = result_fingerprint(g, "c", 8, True, 3)
+        assert result_fingerprint(g, "cuda", 8, True, 3) != base
+        assert result_fingerprint(g, "c", 9, True, 3) != base
+        assert result_fingerprint(g, "c", 8, False, 3) != base
+        assert result_fingerprint(g, "c", 8, True, 4) != base
+        assert result_fingerprint(_board(7), "c", 8, True, 3) != base
+
+    def test_geometry_is_part_of_the_key(self):
+        # All-dead boards digest identically at any shape (zero cells
+        # contribute zero; equal byte counts CRC equally) — the declared
+        # extents in the key are what keeps 8x16 and 4x32 from aliasing.
+        a, b = np.zeros((8, 16), np.uint8), np.zeros((4, 32), np.uint8)
+        assert board_digest(a) == board_digest(b)
+        assert result_fingerprint(a) != result_fingerprint(b)
+
+    def test_body_fingerprint_matches_job_fingerprint(self):
+        g = _board(8, (30, 30))
+        job = new_job(30, 30, g, gen_limit=12, convention="cuda",
+                      similarity_frequency=5)
+        body = {
+            "width": 30, "height": 30,
+            "cells": text_grid.encode(g).decode("ascii"),
+            "convention": "cuda", "gen_limit": 12,
+            "similarity_frequency": 5,
+        }
+        assert body_fingerprint(body) == job_fingerprint(job)
+        # Defaults applied router-side match the worker's defaults.
+        job_d = new_job(30, 30, g)
+        assert body_fingerprint({
+            "width": 30, "height": 30,
+            "cells": text_grid.encode(g).decode("ascii"),
+        }) == job_fingerprint(job_d)
+
+
+# ---------------------------------------------------------------------------
+class TestMemoryLRU:
+    def test_bound_and_recency(self):
+        lru = MemoryLRU(max_entries=2)
+        for i in range(3):
+            lru.put(f"k{i}", _entry(i))
+        assert len(lru) == 2
+        assert lru.get("k0") is None  # oldest evicted
+        assert lru.evictions == 1
+        # A get refreshes recency: k1 survives the next insert, k2 goes.
+        assert lru.get("k1") is not None
+        lru.put("k3", _entry(3))
+        assert lru.get("k1") is not None and lru.get("k2") is None
+
+    def test_min_bound(self):
+        with pytest.raises(ValueError):
+            MemoryLRU(max_entries=0)
+
+
+# ---------------------------------------------------------------------------
+class TestDiskCAS:
+    def test_round_trip(self, tmp_path):
+        cas = DiskCAS(str(tmp_path))
+        e = _entry(1)
+        cas.put("fp1", e)
+        back = cas.get("fp1")
+        assert back is not None
+        assert np.array_equal(back.grid, e.grid)
+        assert (back.generations, back.exit_reason) == (2, "gen_limit")
+        assert cas.get("missing") is None
+
+    def test_torn_entry_evicts_and_reruns(self, tmp_path):
+        evicted = []
+        cas = DiskCAS(str(tmp_path), on_evict=lambda fp, r: evicted.append(fp))
+        cas.put("fp1", _entry(1))
+        path = cas.meta_path("fp1")
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[: len(raw) // 2])  # torn mid-line
+        assert cas.get("fp1") is None
+        assert evicted == ["fp1"]
+        import os
+
+        assert not os.path.exists(path)  # evicted, not left to re-fail
+        cas.put("fp1", _entry(1))  # re-run repopulates cleanly
+        assert cas.get("fp1") is not None
+
+    def test_corrupt_payload_fails_crc(self, tmp_path):
+        cas = DiskCAS(str(tmp_path))
+        cas.put("fp1", _entry(1))
+        path = cas.meta_path("fp1")
+        meta = json.load(open(path))
+        meta["generations"] = 999  # poison a scalar: CRC covers it too
+        with open(path, "w") as f:
+            json.dump(meta, f)
+        assert cas.get("fp1") is None
+
+    def test_foreign_entry_fingerprint_mismatch(self, tmp_path):
+        import shutil
+
+        cas = DiskCAS(str(tmp_path))
+        cas.put("fp1", _entry(1))
+        other = cas.meta_path("fp9")
+        import os
+
+        os.makedirs(os.path.dirname(other), exist_ok=True)
+        shutil.copy(cas.meta_path("fp1"), other)
+        assert cas.get("fp9") is None  # stored fingerprint disagrees
+
+    def test_ts_payload_round_trip(self, tmp_path):
+        # Exact-fit packable width -> the TensorStore zarr lane.
+        cas = DiskCAS(str(tmp_path), payload="ts")
+        e = _entry(2, shape=(16, 32))
+        cas.put("fp32", e)
+        meta = json.load(open(cas.meta_path("fp32")))
+        assert meta["payload"] == "ts" and "grid" not in meta
+        back = cas.get("fp32")
+        assert back is not None and np.array_equal(back.grid, e.grid)
+
+    def test_ts_lane_falls_back_for_unpackable_width(self, tmp_path):
+        cas = DiskCAS(str(tmp_path), payload="ts")
+        e = _entry(3, shape=(16, 30))  # 30 % 32 != 0
+        cas.put("fp30", e)
+        meta = json.load(open(cas.meta_path("fp30")))
+        assert meta["payload"] == "text"
+        back = cas.get("fp30")
+        assert back is not None and np.array_equal(back.grid, e.grid)
+
+
+# ---------------------------------------------------------------------------
+class TestTiered:
+    def test_disk_hit_promotes_to_memory(self, tmp_path):
+        m = Metrics()
+        warm = ResultCache(cas_dir=str(tmp_path), metrics=m)
+        warm.put("fp", _entry(1))
+        cold = ResultCache(cas_dir=str(tmp_path), metrics=m)
+        entry, tier = cold.get("fp")
+        assert tier == "disk"
+        entry, tier = cold.get("fp")
+        assert tier == "memory"  # promoted
+        snap = m.snapshot()["counters"]
+        assert snap["cache_hits_total"] == 2
+        assert snap["cache_hits_total_disk"] == 1
+        assert snap["cache_hits_total_memory"] == 1
+
+    def test_miss_counted(self):
+        m = Metrics()
+        c = ResultCache(metrics=m)
+        assert c.get("nope") is None
+        assert m.snapshot()["counters"]["cache_misses_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+class TestSchedulerCache:
+    def _scheduler(self, tmp_path=None, journal=None, **kw):
+        m = kw.pop("metrics", Metrics())
+        cache = kw.pop("cache", ResultCache(
+            cas_dir=str(tmp_path / "cas") if tmp_path is not None else None,
+            metrics=m,
+        ))
+        s = Scheduler(journal=journal, metrics=m, cache=cache,
+                      flush_age=0.01, **kw)
+        return s, m
+
+    def test_hit_is_byte_identical_and_marked(self, tmp_path):
+        g = _board(11, (32, 32))
+        # Reference: a cache-DISABLED scheduler's engine answer.
+        ref = Scheduler(metrics=Metrics(), flush_age=0.01)
+        ref.start()
+        r = ref.submit(new_job(32, 32, g, gen_limit=8))
+        _wait_done([r])
+        ref.stop()
+
+        s, m = self._scheduler(tmp_path)
+        s.start()
+        first = s.submit(new_job(32, 32, g, gen_limit=8))
+        _wait_done([first])
+        assert first.result.cached is None
+        hit = s.submit(new_job(32, 32, g, gen_limit=8))
+        # Completed AT admission: no waiting, no batch.
+        assert hit.state == DONE and hit.result.cached == "memory"
+        for got in (first, hit):
+            assert np.array_equal(got.result.grid, r.result.grid)
+            assert got.result.generations == r.result.generations
+            assert got.result.exit_reason == r.result.exit_reason
+        s.stop()
+        snap = m.snapshot()["counters"]
+        assert snap["cache_hits_total"] == 1
+        assert snap["cache_misses_total"] == 1
+
+    def test_replay_after_hit_exactly_once(self, tmp_path):
+        g = _board(12, (32, 32))
+        journal = JobJournal(str(tmp_path / "j"))
+        s, _ = self._scheduler(tmp_path, journal=journal)
+        s.start()
+        first = s.submit(new_job(32, 32, g, gen_limit=8))
+        _wait_done([first])
+        hit = s.submit(new_job(32, 32, g, gen_limit=8))
+        assert hit.state == DONE and hit.result.cached == "memory"
+        s.stop()
+        journal.close()
+
+        # The hit is a completely normal DONE record: replay serves both
+        # results and re-queues NOTHING (exactly-once across restart).
+        journal2 = JobJournal(str(tmp_path / "j"))
+        replay = journal2.replay()
+        assert not replay.pending
+        assert set(replay.results) == {first.id, hit.id}
+        assert replay.results[hit.id].cached == "memory"
+        assert np.array_equal(replay.results[hit.id].grid,
+                              replay.results[first.id].grid)
+        # One submit + one done record per id, by raw line audit.
+        events = {}
+        for line in open(journal2.path, "rb").read().splitlines():
+            rec = json.loads(line)
+            events.setdefault(rec["event"], []).append(
+                rec.get("id") or rec["job"]["id"]
+            )
+        assert sorted(events["submit"]) == sorted([first.id, hit.id])
+        assert sorted(events["done"]) == sorted([first.id, hit.id])
+        journal2.close()
+
+    def test_cas_tier_survives_restart(self, tmp_path):
+        g = _board(13, (32, 32))
+        s1, _ = self._scheduler(tmp_path)
+        s1.start()
+        first = s1.submit(new_job(32, 32, g, gen_limit=8))
+        _wait_done([first])
+        s1.stop()
+        # Fresh process-equivalent: new scheduler, new memory tier, same
+        # CAS directory.
+        s2, m2 = self._scheduler(tmp_path)
+        s2.start()
+        hit = s2.submit(new_job(32, 32, g, gen_limit=8))
+        assert hit.state == DONE and hit.result.cached == "disk"
+        assert np.array_equal(hit.result.grid, first.result.grid)
+        s2.stop()
+        assert m2.snapshot()["counters"]["cache_hits_total_disk"] == 1
+
+    def test_corrupt_cas_entry_reruns_correctly(self, tmp_path):
+        g = _board(14, (32, 32))
+        s1, _ = self._scheduler(tmp_path)
+        s1.start()
+        first = s1.submit(new_job(32, 32, g, gen_limit=8))
+        _wait_done([first])
+        s1.stop()
+        cas = DiskCAS(str(tmp_path / "cas"))
+        fp = job_fingerprint(first)
+        meta = json.load(open(cas.meta_path(fp)))
+        meta["grid"] = meta["grid"][::-1]
+        with open(cas.meta_path(fp), "w") as f:
+            json.dump(meta, f)
+        s2, m2 = self._scheduler(tmp_path)
+        s2.start()
+        rerun = s2.submit(new_job(32, 32, g, gen_limit=8))
+        _wait_done([rerun])  # loud evict -> engine path
+        assert rerun.result.cached is None
+        assert np.array_equal(rerun.result.grid, first.result.grid)
+        s2.stop()
+        snap = m2.snapshot()["counters"]
+        assert snap["cache_corrupt_evictions_total"] == 1
+        assert snap.get("cache_hits_total", 0) == 0
+
+    def test_inflight_dedup_runs_engine_once(self, tmp_path):
+        g = _board(15, (32, 32))
+        release = threading.Event()
+        calls = []
+
+        def gated(key, jobs):
+            calls.append([j.id for j in jobs])
+            release.wait(10)
+            return batcher.run_batch(key, jobs)
+
+        journal = JobJournal(str(tmp_path / "j"))
+        m = Metrics()
+        s = Scheduler(journal=journal, metrics=m,
+                      cache=ResultCache(metrics=m), run_batch=gated,
+                      flush_age=0.01)
+        s.start()
+        jobs = [s.submit(new_job(32, 32, g, gen_limit=8)) for _ in range(4)]
+        time.sleep(0.2)  # let followers coalesce behind the gated leader
+        release.set()
+        _wait_done(jobs)
+        s.stop()
+        journal.close()
+        assert len(calls) == 1 and len(calls[0]) == 1  # ONE engine run
+        assert jobs[0].result.cached is None
+        for f in jobs[1:]:
+            assert f.result.cached == "coalesced"
+            assert np.array_equal(f.result.grid, jobs[0].result.grid)
+        # N journaled completions — one done record per id.
+        done = [json.loads(line)["id"]
+                for line in open(journal.path, "rb").read().splitlines()
+                if json.loads(line)["event"] == "done"]
+        assert sorted(done) == sorted(j.id for j in jobs)
+        snap = m.snapshot()["counters"]
+        assert snap["cache_inflight_coalesced_total"] == 3
+
+    def test_followers_share_leader_failure(self, tmp_path):
+        g = _board(16, (32, 32))
+        release = threading.Event()
+
+        def doomed(key, jobs):
+            release.wait(10)
+            raise RuntimeError("engine down")
+
+        m = Metrics()
+        s = Scheduler(metrics=m, cache=ResultCache(metrics=m),
+                      run_batch=doomed, retryable=lambda e: False,
+                      flush_age=0.01)
+        s.start()
+        jobs = [s.submit(new_job(32, 32, g, gen_limit=8)) for _ in range(3)]
+        time.sleep(0.2)
+        release.set()
+        _wait_done(jobs)
+        s.stop()
+        assert all(j.state == FAILED for j in jobs)
+        assert all("engine down" in j.error for j in jobs)
+
+    def test_cancel_follower_and_leader_promotion(self):
+        g = _board(17, (32, 32))
+        m = Metrics()
+        s = Scheduler(metrics=m, cache=ResultCache(metrics=m),
+                      flush_age=0.01)
+        # NOT started: everything stays QUEUED so cancel windows are open.
+        leader = s.submit(new_job(32, 32, g, gen_limit=8))
+        f1 = s.submit(new_job(32, 32, g, gen_limit=8))
+        f2 = s.submit(new_job(32, 32, g, gen_limit=8))
+        assert s.cancel(f1.id) and f1.state == CANCELLED
+        # Cancelling the LEADER hands the engine run to the next follower.
+        assert s.cancel(leader.id) and leader.state == CANCELLED
+        s.start()
+        _wait_done([f2])
+        assert f2.state == DONE and f2.result.cached is None  # promoted
+        s.stop()
+
+    def test_no_cache_opts_out(self, tmp_path):
+        g = _board(18, (32, 32))
+        calls = []
+
+        def counting(key, jobs):
+            calls.append(len(jobs))
+            return batcher.run_batch(key, jobs)
+
+        m = Metrics()
+        s = Scheduler(metrics=m, cache=ResultCache(metrics=m),
+                      run_batch=counting, flush_age=0.01)
+        s.start()
+        a = s.submit(new_job(32, 32, g, gen_limit=8))
+        _wait_done([a])
+        b = s.submit(new_job(32, 32, g, gen_limit=8, no_cache=True))
+        _wait_done([b])
+        s.stop()
+        assert len(calls) == 2  # the repeat ran the engine again
+        assert b.result.cached is None
+        assert np.array_equal(a.result.grid, b.result.grid)
+
+    def test_no_cache_requires_json_boolean(self):
+        with pytest.raises(TypeError):
+            new_job(8, 8, np.zeros((8, 8), np.uint8), no_cache="true")
+
+    def test_follower_urgency_folds_into_queued_leader(self):
+        # A coalesced follower never sits in a bucket, so its priority and
+        # deadline MUST fold into the leader or the dispatch-ordering
+        # guarantee silently breaks for repeat traffic.
+        g = _board(27, (32, 32))
+        m = Metrics()
+        s = Scheduler(metrics=m, cache=ResultCache(metrics=m),
+                      flush_age=10.0)  # unstarted: all stay QUEUED
+        leader = s.submit(new_job(32, 32, g, gen_limit=8))
+        assert leader.priority == 0 and leader.deadline_s is None
+        first_follower = s.submit(new_job(32, 32, g, gen_limit=8, priority=5))
+        assert leader.priority == 5
+        s.submit(new_job(32, 32, g, gen_limit=8, deadline_s=0.25))
+        assert leader.deadline_s is not None
+        # Promotion (FIFO: the first follower takes over) inherits the
+        # REMAINING followers' folded urgency too.
+        s.submit(new_job(32, 32, g, gen_limit=8, priority=9))
+        assert leader.priority == 9
+        assert s.cancel(leader.id)
+        promoted = s._inflight_fp[first_follower.fingerprint]
+        assert promoted is first_follower
+        assert promoted.priority == 9 and promoted.deadline_s is not None
+
+    def test_rejected_submissions_skip_the_consult(self):
+        # A submission that will be 429'd must not do CAS I/O nor count a
+        # consult — the reject path must not amplify overload or skew the
+        # hit/miss series.
+        g = _board(28, (32, 32))
+        m = Metrics()
+        s = Scheduler(metrics=m, cache=ResultCache(metrics=m),
+                      max_queue_depth=1, flush_age=10.0)  # unstarted
+        s.submit(new_job(32, 32, g, gen_limit=8))
+        misses_before = m.snapshot()["counters"]["cache_misses_total"]
+        from gol_tpu.serve.scheduler import QueueFull
+
+        with pytest.raises(QueueFull):
+            s.submit(new_job(32, 32, g, gen_limit=8))
+        assert (m.snapshot()["counters"]["cache_misses_total"]
+                == misses_before)
+
+    def test_bitpack_is_the_engine_convention(self):
+        # The cache's ts-lane packing and the engine's batch staging must
+        # share ONE bit convention — pinned by construction (both delegate
+        # to io/bitpack) and by value here.
+        from gol_tpu import engine
+        from gol_tpu.io import bitpack
+
+        stacked = np.stack([_board(29, (8, 64)), _board(30, (8, 64))])
+        words = engine._pack_board_words(stacked)
+        assert np.array_equal(words, bitpack.pack_words(stacked))
+        assert np.array_equal(engine._unpack_board_words(words), stacked)
+        assert np.array_equal(
+            bitpack.unpack_words(bitpack.pack_words(stacked[0]), 64),
+            stacked[0],
+        )
+
+
+# ---------------------------------------------------------------------------
+class TestServerCache:
+    def test_http_hit_marker_and_bad_type_400(self, tmp_path):
+        import urllib.request
+
+        from gol_tpu.serve.server import GolServer
+
+        def http(method, url, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(url, data=data, method=method)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read())
+
+        import urllib.error
+
+        srv = GolServer(port=0, flush_age=0.01, result_cache=True,
+                        cache_dir=str(tmp_path / "cas"))
+        srv.start()
+        try:
+            base = srv.url
+            g = _board(19, (32, 32))
+            body = {"width": 32, "height": 32,
+                    "cells": text_grid.encode(g).decode("ascii"),
+                    "gen_limit": 8}
+            status, first = http("POST", f"{base}/jobs", body)
+            assert status == 202
+            deadline = time.perf_counter() + 30
+            while time.perf_counter() < deadline:
+                status, res1 = http("GET", f"{base}/result/{first['id']}")
+                if status == 200:
+                    break
+                time.sleep(0.02)
+            assert status == 200 and "cached" not in res1
+            status, second = http("POST", f"{base}/jobs", body)
+            assert status == 202
+            status, res2 = http("GET", f"{base}/result/{second['id']}")
+            assert status == 200 and res2["cached"] == "memory"
+            assert res2["grid"] == res1["grid"]
+            # Wrong-typed no_cache is a 400, exactly like check_similarity.
+            status, err = http("POST", f"{base}/jobs",
+                               {**body, "no_cache": "yes"})
+            assert status == 400 and "no_cache" in err["error"]
+            # Hit counters ride the serving registry's exposition formats.
+            status, snap = http("GET", f"{base}/metrics?format=json")
+            assert snap["counters"]["cache_hits_total"] == 1
+            req = urllib.request.urlopen(f"{base}/metrics", timeout=10)
+            prom = req.read().decode()
+            assert "gol_serve_cache_hits_total 1" in prom
+        finally:
+            srv.shutdown()
+
+    def test_gol_top_renders_hit_ratio(self):
+        from gol_tpu.obs import top
+
+        frame = top.render_frame(
+            {"counters": {"cache_hits_total": 6, "cache_misses_total": 2,
+                          "cache_inflight_coalesced_total": 1,
+                          "cache_hits_total_memory": 5,
+                          "cache_hits_total_disk": 1}},
+            None, ansi=False,
+        )
+        assert "cache: hit ratio" in frame
+        assert "0.88" in frame  # (6 + 1) / (6 + 2)
+        frame_plain = top.render_frame({"counters": {}}, None, ansi=False)
+        assert "cache:" not in frame_plain  # no cache mounted -> no line
+
+
+# ---------------------------------------------------------------------------
+class TestFleetCacheTier:
+    def _fleet(self, tmp_path, ids=("wa", "wb", "wc")):
+        from gol_tpu.fleet.workers import Fleet
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        for wid in ids:
+            fleet.attach(f"http://{wid}.invalid", wid)
+        return fleet
+
+    def _router(self, tmp_path, sink, **kw):
+        from gol_tpu.fleet.router import RouterServer
+
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            sink.append(url.split("//")[1].split(".")[0])
+            return 202, {"id": f"j{len(sink)}", "state": "queued"}
+
+        return RouterServer(self._fleet(tmp_path), port=0, http=stub_http,
+                            **kw)
+
+    @staticmethod
+    def _body(seed: int, extra=None) -> bytes:
+        g = _board(seed, (32, 32))
+        body = {"width": 32, "height": 32,
+                "cells": text_grid.encode(g).decode("ascii")}
+        return json.dumps({**body, **(extra or {})}).encode()
+
+    def test_fingerprint_rank_is_deterministic(self):
+        from gol_tpu.fleet import placement
+
+        fp = "fp:" + result_fingerprint(_board(20))
+        ids = ["w0", "w1", "w2", "w3"]
+        assert placement.rank(fp, ids) == placement.rank(fp, list(ids))
+        # Removing a worker moves only that worker's keys (HRW property,
+        # already pinned for buckets — restated for fingerprint keys).
+        full = placement.rank(fp, ids)
+        without = placement.rank(fp, [w for w in ids if w != full[-1]])
+        assert without == full[:-1]
+
+    def test_repeats_land_on_the_fingerprint_owner(self, tmp_path):
+        from gol_tpu.fleet import placement
+
+        sink = []
+        router = self._router(tmp_path, sink, cache_route=True)
+        try:
+            # Same 32x32 bucket, different boards: with cache routing the
+            # targets follow each board's fingerprint owner...
+            for seed in (21, 22, 23):
+                fp = "fp:" + body_fingerprint(
+                    json.loads(self._body(seed).decode())
+                )
+                owner = placement.rank(fp, ["wa", "wb", "wc"])[0]
+                for _ in range(2):  # ...and repeats land on the SAME one
+                    status, payload = router.route_submit(self._body(seed))
+                    assert status == 202
+                    assert sink[-1] == owner == payload["worker"]
+            assert router.registry.counter("jobs_cache_routed_total") == 6
+        finally:
+            router.httpd.server_close()
+
+    def test_no_cache_body_keeps_bucket_routing(self, tmp_path):
+        from gol_tpu.fleet import placement
+
+        sink = []
+        router = self._router(tmp_path, sink, cache_route=True)
+        try:
+            key = placement.key_for(json.loads(self._body(24).decode()))
+            bucket_owner = placement.rank(key.label(),
+                                          ["wa", "wb", "wc"])[0]
+            status, _ = router.route_submit(
+                self._body(24, {"no_cache": True})
+            )
+            assert status == 202 and sink[-1] == bucket_owner
+            assert router.registry.counter("jobs_cache_routed_total") == 0
+        finally:
+            router.httpd.server_close()
+
+    def test_unfingerprintable_body_falls_back_to_bucket(self, tmp_path):
+        from gol_tpu.fleet import placement
+
+        sink = []
+        router = self._router(tmp_path, sink, cache_route=True)
+        try:
+            body = {"width": 32, "height": 32}  # no cells: cannot key
+            key = placement.key_for(body)
+            bucket_owner = placement.rank(key.label(),
+                                          ["wa", "wb", "wc"])[0]
+            status, _ = router.route_submit(json.dumps(body).encode())
+            assert status == 202 and sink[-1] == bucket_owner
+        finally:
+            router.httpd.server_close()
+
+    def test_default_router_keeps_bucket_affinity(self, tmp_path):
+        from gol_tpu.fleet import placement
+
+        sink = []
+        router = self._router(tmp_path, sink)  # cache_route off (default)
+        try:
+            key = placement.key_for(json.loads(self._body(25).decode()))
+            bucket_owner = placement.rank(key.label(),
+                                          ["wa", "wb", "wc"])[0]
+            for seed in (25, 26):  # different boards, same bucket
+                router.route_submit(self._body(seed))
+                assert sink[-1] == bucket_owner
+        finally:
+            router.httpd.server_close()

@@ -115,6 +115,21 @@ def test_no_wall_clock_in_fleet():
         )
 
 
+def test_no_wall_clock_in_cache():
+    """Same rule for gol_tpu/cache/: the result cache sits on the serve
+    admission path (consult-before-enqueue) and feeds the same latency
+    series — any age/latency accounting it ever grows must be
+    ``time.perf_counter()`` only, and nothing in a content-addressed store
+    has a legitimate wall-clock need (entries are keyed by content, not
+    mtime)."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "cache", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/cache/ (use "
+            f"time.perf_counter() for any latency path): {offenders}"
+        )
+
+
 def test_no_wall_clock_in_engine():
     """Same rule for the engine module itself, which PR 6 made part of the
     serve hot path (the batched/ring runners and their staging live there):

@@ -1,0 +1,224 @@
+"""Result-cache smoke: kill/restart across the CAS tier, corrupt, re-run.
+
+The `make cache-smoke` harness, exercising the ISSUE 9 acceptance against
+real OS processes:
+
+1. boot `gol serve --result-cache --cache-dir` with a journal; submit one
+   board and collect its engine-path result;
+2. SIGKILL the server; restart on the same directories; resubmit the SAME
+   board — it must be served from the **disk CAS tier** (the memory tier
+   died with the process), byte-identical, marked ``cached: disk``;
+3. byte-gate: a cache-DISABLED server run of the same board must produce
+   the identical grid/generations/exit reason (the cache must be
+   invisible in the bytes);
+4. corrupt the CAS entry on disk; restart; resubmit — the server must
+   evict loudly, RE-RUN the engine, and still answer byte-identically
+   (``cache_corrupt_evictions_total`` counts it); the re-run repopulates
+   the tier (a further resubmission hits again).
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/cache_smoke.py [--gen-limit 200]
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu.io import text_grid  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        # Error statuses (the 409 "result not ready" poll) are answers
+        # here, not exceptions.
+        return err.code, json.loads(err.read())
+
+
+def _start_server(port: int, journal_dir: str, cache_dir: str | None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "gol_tpu", "serve",
+        "--port", str(port),
+        "--journal-dir", journal_dir,
+        "--flush-age", "0.05",
+    ]
+    if cache_dir is not None:
+        cmd += ["--result-cache", "--cache-dir", cache_dir]
+    proc = subprocess.Popen(
+        cmd, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.perf_counter() + 120
+    base = f"http://127.0.0.1:{port}"
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server died on boot (rc={proc.returncode}):\n"
+                + (proc.stdout.read() if proc.stdout else "")
+            )
+        try:
+            status, _ = _http("GET", f"{base}/healthz", timeout=2)
+            if status == 200:
+                return proc, base
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("server did not become healthy in 120s")
+
+
+def _submit_and_fetch(base: str, body: dict) -> dict:
+    status, payload = _http("POST", f"{base}/jobs", body)
+    assert status == 202, f"submit got HTTP {status}: {payload}"
+    job_id = payload["id"]
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        status, result = _http("GET", f"{base}/result/{job_id}")
+        if status == 200:
+            return result
+        assert status == 409, f"result fetch got HTTP {status}: {result}"
+        time.sleep(0.05)
+    raise RuntimeError(f"job {job_id} did not finish in 120s")
+
+
+def _metrics(base: str) -> dict:
+    status, snap = _http("GET", f"{base}/metrics?format=json")
+    assert status == 200
+    return snap["counters"]
+
+
+def _same_answer(a: dict, b: dict) -> bool:
+    return (a["grid"] == b["grid"]
+            and a["generations"] == b["generations"]
+            and a["exit_reason"] == b["exit_reason"])
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--gen-limit", type=int, default=200)
+    args = parser.parse_args()
+
+    root = tempfile.mkdtemp(prefix="gol_cache_smoke_")
+    journal_dir = os.path.join(root, "journal")
+    cache_dir = os.path.join(root, "cache")
+    plain_journal = os.path.join(root, "journal_nocache")
+    rng = np.random.default_rng(1234)
+    board = rng.integers(0, 2, size=(64, 64), dtype=np.uint8)
+    body = {
+        "width": 64, "height": 64,
+        "cells": text_grid.encode(board).decode("ascii"),
+        "gen_limit": args.gen_limit,
+    }
+    proc = None
+    try:
+        # 1. Engine path populates the tiers.
+        port = _free_port()
+        proc, base = _start_server(port, journal_dir, cache_dir)
+        engine_result = _submit_and_fetch(base, body)
+        assert "cached" not in engine_result, \
+            f"first run must take the engine path: {engine_result}"
+        counters = _metrics(base)
+        assert counters.get("cache_misses_total", 0) >= 1, counters
+        entries = glob.glob(os.path.join(cache_dir, "*", "*.json"))
+        assert entries, "CAS tier wrote no entry"
+        print(f"cache-smoke: engine run done "
+              f"({engine_result['generations']} generations; CAS entry "
+              f"{os.path.basename(entries[0])})")
+
+        # 2. SIGKILL; restart; the resubmission must hit the DISK tier.
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        port = _free_port()
+        proc, base = _start_server(port, journal_dir, cache_dir)
+        hit_result = _submit_and_fetch(base, body)
+        assert hit_result.get("cached") == "disk", \
+            f"post-restart resubmit must hit the CAS tier: {hit_result}"
+        assert _same_answer(engine_result, hit_result), \
+            "CAS hit is not byte-identical to the engine result"
+        counters = _metrics(base)
+        assert counters.get("cache_hits_total_disk", 0) >= 1, counters
+        print("cache-smoke: restart + resubmit hit the CAS tier, "
+              "byte-identical")
+
+        # 3. Byte-gate against a cache-DISABLED server.
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        port = _free_port()
+        proc, base = _start_server(port, plain_journal, None)
+        plain_result = _submit_and_fetch(base, body)
+        assert "cached" not in plain_result
+        assert _same_answer(plain_result, hit_result), \
+            "cached answer differs from a cache-disabled server's"
+        print("cache-smoke: cache-disabled run byte-identical")
+
+        # 4. Corrupt the CAS entry: loud evict + correct re-run.
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        meta_path = entries[0]
+        meta = json.load(open(meta_path))
+        flipped = ("1" + meta["grid"][1:] if meta["grid"][0] == "0"
+                   else "0" + meta["grid"][1:])
+        meta["grid"] = flipped
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        port = _free_port()
+        proc, base = _start_server(port, journal_dir, cache_dir)
+        rerun_result = _submit_and_fetch(base, body)
+        assert "cached" not in rerun_result, \
+            f"corrupt entry must force a re-run: {rerun_result}"
+        assert _same_answer(rerun_result, engine_result), \
+            "re-run after corruption is not byte-identical"
+        counters = _metrics(base)
+        assert counters.get("cache_corrupt_evictions_total", 0) >= 1, counters
+        # The re-run repopulated the tier: the next resubmit hits again.
+        again = _submit_and_fetch(base, body)
+        assert again.get("cached") in ("memory", "disk"), again
+        print("cache-smoke: corrupt entry evicted loudly, re-run "
+              "byte-identical, tier repopulated")
+
+        status, _ = _http("POST", f"{base}/drain", {})
+        assert status == 200
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        proc = None
+        print("cache-smoke: PASS")
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
