@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -90,6 +90,16 @@ fleet-smoke:
 # and a corrupted CAS entry must evict loudly and re-run correctly.
 cache-smoke:
 	python3 tools/cache_smoke.py
+
+# Fleet-tracing + metrics-history smoke (tools/fleettrace_smoke.py): a real
+# `gol fleet --workers 2` under --trace/--metrics-history takes a Zipf load
+# with cache hits, one worker is SIGKILLed mid-load (spillover + respawn),
+# and `gol fleet-trace` must stitch ONE valid Perfetto JSON (router + both
+# worker pids, >= 1 cross-process flow chain) while `gol history-report`
+# renders the router's durable ring with jobs_completed_total monotonic
+# through the respawn.
+fleettrace-smoke:
+	python3 tools/fleettrace_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

@@ -1461,6 +1461,201 @@ def _bench_cache(args) -> int:
     return 0 if speedup >= 10.0 else 1
 
 
+def _bench_fleettrace(args) -> int:
+    """Fleet-observability overhead suite (--suite fleettrace) -> BENCH_r12.
+
+    ISSUE 10's cost acceptance: the fleet-granular tier — trace-context
+    propagation (X-Gol-Trace stamped per routed submit, router
+    submit/forward spans + flow starts, worker span rings + flow
+    adoption) AND durable metrics history (per-worker partition rings fed
+    by the sampler, the router's merged/floored ring ticking) — must cost
+    < 3% of fleet throughput against the identical load with everything
+    OFF (the PR-7 telemetry budget, applied one tier up).
+
+    Two REAL 2-worker fleets (subprocess workers behind in-process
+    routers) stay up for the whole measurement; rounds alternate
+    off/on so machine drift lands on both columns. The headline is the
+    on/off jobs-per-sec ratio (acceptance >= 0.97); ``lanes.on.
+    jobs_per_sec`` is the absolute leaf CI gates with
+    ``tools/bench_diff.py --metric lanes.on.jobs_per_sec``. rc 0 iff the
+    ratio clears 0.97 and every job of every round lands DONE.
+    """
+    import concurrent.futures
+    import shutil
+    import tempfile
+
+    import jax
+
+    from gol_tpu.fleet import client as fleet_client
+    from gol_tpu.fleet.router import RouterServer
+    from gol_tpu.fleet.workers import Fleet
+    from gol_tpu.io import text_grid
+    from gol_tpu.obs import recorder as obs_recorder, trace as obs_trace
+
+    repeats = args.repeats
+    # Compute must dominate the fixed submit/route/poll overhead (the
+    # fleet suite's lesson), but the suite also runs 2 lanes x (repeats+1)
+    # rounds — 2500 keeps one round ~2-4s on CPU.
+    gen_limit = args.gen_limit if args.gen_limit is not None else 2500
+    side = 160
+    freqs = (2, 3, 5, 9)  # 4 equal-work buckets (HRW-spread over 2 workers)
+    per_bucket = 8
+    max_batch = 8
+    njobs = len(freqs) * per_bucket
+    workroot = tempfile.mkdtemp(prefix="gol-bench-fleettrace-")
+    print(
+        f"bench fleettrace: {njobs} jobs across {len(freqs)} {side}^2 "
+        f"buckets, gen_limit {gen_limit}, repeats {repeats}, 2 workers, "
+        f"platform={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    boards = {
+        freq: [text_grid.generate(side, side, seed=6000 + 100 * freq + i)
+               for i in range(per_bucket)]
+        for freq in freqs
+    }
+
+    def _http(method, url, body=None, timeout=120):
+        return fleet_client.http_json(method, url, body, timeout=timeout)
+
+    def submit_all(base: str) -> None:
+        def one(freq_board):
+            freq, board = freq_board
+            status, payload = _http("POST", f"{base}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": gen_limit,
+                "similarity_frequency": freq,
+            })
+            if status != 202:
+                raise RuntimeError(f"submit rejected HTTP {status}: {payload}")
+
+        work = [(freq, b) for freq, bs in boards.items() for b in bs]
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(one, work))
+
+    def completed(base: str) -> tuple[int, int]:
+        _, snap = _http("GET", f"{base}/metrics?format=json")
+        return (int(snap["counters"].get("jobs_completed_total", 0)),
+                int(snap["counters"].get("jobs_failed_total", 0)))
+
+    def run_round(base: str) -> float:
+        done0, _ = completed(base)
+        t0 = time.perf_counter()
+        submit_all(base)
+        while True:
+            done, failed = completed(base)
+            if failed:
+                raise RuntimeError(f"{failed} job(s) FAILED")
+            if done - done0 >= njobs:
+                return time.perf_counter() - t0
+            time.sleep(0.05)
+
+    def boot(name: str, telemetry: bool):
+        fleet_dir = os.path.join(workroot, f"fleet-{name}")
+        serve_args = [
+            "--flush-age", "0.2",
+            "--max-batch", str(max_batch),
+            "--pipeline-depth", "2",
+            "--max-queue-depth", "4096",
+        ]
+        if telemetry:
+            serve_args += ["--trace", os.path.join(workroot, "trace"),
+                           "--metrics-history",
+                           "--sample-interval", "0.25"]
+        fleet = Fleet(fleet_dir, serve_args=serve_args)
+        fleet.spawn_fleet(2)
+        router = RouterServer(fleet, port=0)
+        router.start()
+        if telemetry:
+            router.start_history(
+                os.path.join(fleet_dir, "router-history"), interval=0.25
+            )
+        return router
+
+    results = {}
+    trace_dir = os.path.join(workroot, "trace")
+    router_off = router_on = None
+    try:
+        router_off = boot("off", telemetry=False)
+        router_on = boot("on", telemetry=True)
+        obs_recorder.install(trace_dir)
+        # Warm both lanes (every bucket compiles on its HRW owner). The ON
+        # warm runs traced so the on-column rounds measure a steady state.
+        run_round(router_off.url)
+        obs_trace.enable()
+        run_round(router_on.url)
+        obs_trace.disable()
+        off_runs, on_runs = [], []
+        for _ in range(repeats):
+            # Interleave off/on rounds: thermal/noisy-neighbor drift biases
+            # both columns equally. The process-global tracer flag serves
+            # the in-process ROUTER; each ON worker armed itself via
+            # --trace at boot, each OFF worker never did.
+            off_runs.append(run_round(router_off.url))
+            obs_trace.enable()
+            try:
+                on_runs.append(run_round(router_on.url))
+            finally:
+                obs_trace.disable()
+        off, on = min(off_runs), min(on_runs)
+        results = {
+            "off": {"seconds": round(off, 3),
+                    "jobs_per_sec": round(njobs / off, 2)},
+            "on": {"seconds": round(on, 3),
+                   "jobs_per_sec": round(njobs / on, 2)},
+        }
+        print(
+            f"  off {njobs / off:.1f} jobs/s, on {njobs / on:.1f} jobs/s "
+            f"(ratio {(njobs / on) / (njobs / off):.4f})",
+            file=sys.stderr,
+        )
+    finally:
+        obs_trace.disable()
+        obs_trace.clear()
+        obs_recorder.uninstall()
+        for router in (router_on, router_off):
+            if router is not None:
+                router.shutdown(cascade=True)
+        shutil.rmtree(workroot, ignore_errors=True)
+
+    ratio = results["on"]["jobs_per_sec"] / results["off"]["jobs_per_sec"]
+    payload = {
+        "metric": "fleet_telemetry_on_over_off_jobs_per_sec",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "vs_baseline": None,  # the off column IS the baseline; floor 0.97
+        "load": {
+            "jobs": njobs,
+            "buckets": [f"{side}x{side}/sim{f}" for f in freqs],
+            "per_bucket": per_bucket,
+            "gen_limit": gen_limit,
+            "max_batch": max_batch,
+            "workers": 2,
+            "note": "both lanes run real subprocess workers behind "
+            "in-process routers; rounds interleave off/on. CI gates the "
+            "absolute leaf with --metric lanes.on.jobs_per_sec",
+        },
+        "telemetry_on": [
+            "router tracing + X-Gol-Trace propagation + submit/forward "
+            "spans + flow starts",
+            "worker --trace (span rings, flow adoption, flight recorder)",
+            "worker --metrics-history partition rings (0.25s sampler)",
+            "router merged/floored history ring (0.25s tick)",
+        ],
+        "lanes": results,
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r12.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    print(json.dumps(payload))
+    return 0 if ratio >= 0.97 else 1
+
+
 # Named measurement suites, table-driven: adding one is one line here (plus
 # its _bench_* function) — no if/elif chain to grow. Each entry is
 # (runner, one-line help shown by --list-suites). Suites pin their own
@@ -1506,6 +1701,13 @@ SUITES = {
         "telemetry overhead on the megabatch serve load: tracing + SLO "
         "engine + dispatch-gap sampler on vs off (acceptance: on >= 0.97x "
         "off); writes BENCH_r09.json",
+    ),
+    "fleettrace": (
+        _bench_fleettrace,
+        "fleet-observability overhead: trace propagation + spans + durable "
+        "metrics history on vs off through a real 2-worker fleet "
+        "(acceptance: on >= 0.97x off; CI gates "
+        "--metric lanes.on.jobs_per_sec); writes BENCH_r12.json",
     ),
 }
 

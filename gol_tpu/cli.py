@@ -775,6 +775,31 @@ def _serve(args) -> int:
     cache_dir = args.cache_dir
     if args.result_cache and cache_dir is None and args.journal_dir:
         cache_dir = os.path.join(args.journal_dir, "cache")
+    # --metrics-history with no DIR rides the journal partition (the fleet
+    # lane: every worker's history lands beside its journal with zero
+    # extra flags); bare --metrics-history without a journal needs an
+    # explicit DIR — there is nowhere durable to default to.
+    history_dir = args.metrics_history
+    if history_dir == "auto":
+        if not args.journal_dir:
+            raise ValueError(
+                "--metrics-history needs a DIR (or --journal-dir, whose "
+                "partition hosts the default <journal-dir>/history)"
+            )
+        history_dir = os.path.join(args.journal_dir, "history")
+    if history_dir and args.sample_interval <= 0:
+        # The history ring is fed by the sampler thread; with the sampler
+        # disabled the ring would mount and then silently stay empty —
+        # exactly the record an incident review would reach for and not
+        # find. Refuse the combination instead.
+        raise ValueError(
+            "--metrics-history is fed by the background sampler; "
+            f"--sample-interval must be > 0 (got {args.sample_interval})"
+        )
+    if args.history_bytes is not None and args.history_bytes < 4096:
+        raise ValueError(
+            f"--history-bytes must be >= 4096, got {args.history_bytes}"
+        )
     server = GolServer(
         host=args.host,
         port=args.port,
@@ -792,6 +817,8 @@ def _serve(args) -> int:
         cache_dir=cache_dir,
         cache_entries=args.cache_entries,
         cache_payload=args.cache_payload,
+        history_dir=history_dir,
+        history_bytes=args.history_bytes,
     )
     stop = {"signaled": False}
 
@@ -851,6 +878,21 @@ def _fleet(args) -> int:
         raise ValueError(
             f"--health-interval must be > 0, got {args.health_interval}"
         )
+    # The worker-side --metrics-history/--history-bytes rules, enforced
+    # BEFORE any worker spawns: forwarding a value every worker will
+    # reject at its own argv parse would boot-crash the whole fleet and
+    # surface as a raw _await_ready RuntimeError instead of the CLI's
+    # `gol: <error>` contract.
+    if args.metrics_history and args.sample_interval <= 0:
+        raise ValueError(
+            "--metrics-history is fed by each worker's background "
+            f"sampler; --sample-interval must be > 0 "
+            f"(got {args.sample_interval})"
+        )
+    if args.history_bytes is not None and args.history_bytes < 4096:
+        raise ValueError(
+            f"--history-bytes must be >= 4096, got {args.history_bytes}"
+        )
     # Worker flags forwarded verbatim to every spawned `gol serve` —
     # including --warm-plans, so a tuned fleet pre-compiles each worker's
     # bucket programs (and the plan cache is shared via GOL_PLAN_CACHE /
@@ -877,6 +919,18 @@ def _fleet(args) -> int:
         # <partition>/cache): with --cache-route, a fingerprint's HRW owner
         # IS the worker whose partition holds its cache shard.
         serve_args += ["--result-cache"]
+    if args.trace:
+        # Every worker arms its own tracer on the SHARED directory
+        # (exports/flight dumps are pid-qualified, so processes never
+        # collide); the router's own arming rides main()'s --trace hook.
+        serve_args += ["--trace", args.trace]
+    if args.metrics_history:
+        # Bare --metrics-history on a worker resolves to its journal
+        # partition (<partition>/history) — per-process rings, exactly
+        # like the journal and the CAS tier.
+        serve_args += ["--metrics-history"]
+        if args.history_bytes is not None:
+            serve_args += ["--history-bytes", str(args.history_bytes)]
 
     fleet = Fleet(args.fleet_dir, serve_args=serve_args)
     recovered = fleet.load()
@@ -894,6 +948,15 @@ def _fleet(args) -> int:
     router = RouterServer(fleet, host=args.host, port=args.port,
                           big_edge=args.big_edge,
                           cache_route=args.cache_route)
+    if args.metrics_history:
+        # The router's durable record is the fleet-MERGED snapshot, floored
+        # by MonotonicCounters — the series an incident review replays stay
+        # monotonic through every worker respawn in the window.
+        router.start_history(
+            os.path.join(args.fleet_dir, "router-history"),
+            interval=args.sample_interval,  # validated > 0 above
+            total_bytes=args.history_bytes,
+        )
     stop = {"signaled": False}
 
     def _on_signal(signum, frame):
@@ -1453,6 +1516,49 @@ def _trace_report(args) -> int:
     return 0
 
 
+def _fleet_trace(args) -> int:
+    """``gol fleet-trace``: one stitched Perfetto timeline for the fleet.
+
+    Collects ``GET /debug/trace`` from the router and every worker its
+    ``GET /fleet`` lists (concurrently; a single ``gol serve`` — no /fleet
+    — is traced alone), normalizes each process's monotonic clock against
+    its wall anchor, and writes ONE Chrome trace JSON: a pid lane per
+    process, cross-process flow arrows router→worker per job. Unreachable
+    workers are skipped with a note — tracing the survivors during the
+    incident that killed a worker is the point."""
+    from gol_tpu.obs import fleettrace
+
+    doc = fleettrace.export(args.server, args.output)
+    other = doc.get("otherData", {})
+    processes = other.get("processes", {})
+    events = doc.get("traceEvents", [])
+    flows = sum(1 for e in events if e.get("ph") in ("s", "t", "f"))
+    spans = sum(1 for e in events if e.get("ph") == "X")
+    print(f"fleet-trace -> {args.output}: {len(processes)} process(es) "
+          f"[{', '.join(sorted(processes))}], {spans} span(s), "
+          f"{flows} flow point(s)", file=sys.stderr)
+    for entry in other.get("skipped", []):
+        print(f"  skipped {entry.get('name')}: {entry.get('reason')}",
+              file=sys.stderr)
+    if not processes:
+        print("fleet-trace: no process had tracing enabled — start the "
+              "fleet with --trace DIR", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _history_report(args) -> int:
+    """``gol history-report``: render a metrics-history ring as
+    rate/value/percentile timelines (gol_tpu/obs/history.py)."""
+    from gol_tpu.obs import history
+
+    if not os.path.isdir(args.history_dir):
+        raise ValueError(f"{args.history_dir} is not a directory (pass the "
+                         "ring a --metrics-history run wrote)")
+    sys.stdout.write(history.render_report(args.history_dir))
+    return 0
+
+
 def _generate(args) -> int:
     if args.output:
         # Streamed: north-star-sized grids (65536^2 = 4 GB of text) generate
@@ -1745,6 +1851,21 @@ def build_parser() -> argparse.ArgumentParser:
         "gol-serve-sampler thread); <= 0 disables the background sampler "
         "(GET /slo then evaluates on demand)",
     )
+    srv.add_argument(
+        "--metrics-history", nargs="?", const="auto", default=None,
+        metavar="DIR",
+        help="durable metrics history (gol_tpu/obs/history.py): every "
+        "sampler tick appends the serving metrics snapshot to a "
+        "size-capped append-only JSONL ring in DIR, surviving restarts "
+        "(render with `gol history-report DIR`, gate windows with "
+        "tools/bench_diff.py --history). With no DIR the ring lands at "
+        "<journal-dir>/history. Default: off (no per-tick cost)",
+    )
+    srv.add_argument(
+        "--history-bytes", type=int, default=None, metavar="N",
+        help="metrics-history ring cap in bytes (default 16 MiB); oldest "
+        "segments compact away past it",
+    )
     srv.set_defaults(func=_serve)
 
     flt = sub.add_parser(
@@ -1823,6 +1944,26 @@ def build_parser() -> argparse.ArgumentParser:
                      metavar="S")
     flt.add_argument("--sample-interval", type=float, default=1.0,
                      metavar="S")
+    flt.add_argument(
+        "--trace", default=None, metavar="DIR",
+        help="fleet-wide span tracing: arms the router AND every spawned "
+        "worker (one pid-qualified export per process in DIR), and stamps "
+        "X-Gol-Trace onto forwarded submits so worker spans join the "
+        "router's trace. Stitch every live process's ring into ONE "
+        "Perfetto timeline with `gol fleet-trace`",
+    )
+    flt.add_argument(
+        "--metrics-history", action="store_true",
+        help="durable metrics history for the whole fleet: every worker "
+        "appends its snapshot ring beside its journal partition "
+        "(<partition>/history) and the router appends the fleet-MERGED, "
+        "respawn-floored view to <fleet-dir>/router-history — the "
+        "cumulative series stay monotonic through worker respawns. "
+        "Render with `gol history-report <dir>`",
+    )
+    flt.add_argument("--history-bytes", type=int, default=None, metavar="N",
+                     help="per-process history ring cap in bytes "
+                     "(default 16 MiB)")
     flt.set_defaults(func=_fleet)
 
     tun = sub.add_parser(
@@ -1884,6 +2025,28 @@ def build_parser() -> argparse.ArgumentParser:
         "long search's progress live)",
     )
     tun.set_defaults(func=_tune)
+
+    ftr = sub.add_parser(
+        "fleet-trace",
+        help="stitch the live span rings of a whole fleet (router + every "
+        "worker) into ONE clock-normalized Perfetto trace file with "
+        "cross-process flow arrows per job",
+    )
+    ftr.add_argument("--server", default="http://127.0.0.1:8000",
+                     help="the fleet router (or a single gol serve) URL")
+    ftr.add_argument("-o", "--output", default="fleet-trace.json",
+                     help="stitched Chrome trace JSON path "
+                     "(default fleet-trace.json)")
+    ftr.set_defaults(func=_fleet_trace)
+
+    hrp = sub.add_parser(
+        "history-report",
+        help="render a durable metrics-history ring (--metrics-history) as "
+        "rate/value/percentile timelines with respawn boundaries marked",
+    )
+    hrp.add_argument("history_dir", help="a history directory "
+                     "(e.g. <journal>/history or <fleet>/router-history)")
+    hrp.set_defaults(func=_history_report)
 
     rpt = sub.add_parser(
         "trace-report",
@@ -1993,7 +2156,8 @@ def main(argv: list[str] | None = None) -> int:
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in (
         "run", "generate", "show", "serve", "fleet", "submit", "batch",
-        "tune", "trace-report", "top", "slo-report", "-h", "--help"
+        "tune", "trace-report", "fleet-trace", "history-report", "top",
+        "slo-report", "-h", "--help"
     ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)

@@ -26,22 +26,28 @@ def http_json(
     *,
     raw: bytes | None = None,
     timeout: float = 30.0,
+    headers: dict | None = None,
 ):
     """One JSON exchange -> (status, payload).
 
     ``raw`` forwards pre-encoded bytes verbatim (the router's submit path:
     the client's body was already parsed for placement; re-encoding a 17 MB
-    board a second time would be pure tax). HTTP error statuses return
-    normally; connection-level failures raise (URLError/OSError).
+    board a second time would be pure tax). ``headers`` adds/overrides
+    request headers (the router's trace-context stamp, obs/propagate.py —
+    receivers that don't know a header ignore it). HTTP error statuses
+    return normally; connection-level failures raise (URLError/OSError).
     """
     if body is not None and raw is not None:
         raise ValueError("pass body or raw, not both")
     data = raw
-    headers = {"Accept": "application/json"}
+    hdrs = {"Accept": "application/json"}
     if body is not None:
         data = json.dumps(body).encode("utf-8")
     if data is not None:
-        headers["Content-Type"] = "application/json"
+        hdrs["Content-Type"] = "application/json"
+    if headers:
+        hdrs.update(headers)
+    headers = hdrs
     req = urllib.request.Request(url, data=data, headers=headers, method=method)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:

@@ -50,6 +50,7 @@ import socket
 
 from gol_tpu.fleet import client, placement
 from gol_tpu.fleet.workers import Fleet, Worker
+from gol_tpu.obs import propagate, registry as obs_registry, trace as obs_trace
 from gol_tpu.obs.registry import Registry, _fmt
 
 logger = logging.getLogger(__name__)
@@ -340,6 +341,13 @@ class RouterServer:
         self._jobs_cap = 65536
         self._jobs_lock = threading.Lock()
         self._draining = False
+        # Durable metrics history (obs/history.py), mounted by
+        # start_history: one tick thread appending the FLOORED merged
+        # snapshot — the MonotonicCounters pass above is exactly what
+        # makes the durable record monotonic through worker respawns.
+        self._history = None
+        self._history_stop = threading.Event()
+        self._history_thread: threading.Thread | None = None
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -377,10 +385,73 @@ class RouterServer:
             "workers": results,
         }
 
+    def start_history(self, directory: str, interval: float = 1.0,
+                      total_bytes: int | None = None) -> None:
+        """Mount the router-side durable metrics history: every
+        ``interval`` seconds one fleet-merged (and respawn-floored)
+        snapshot appends to the ring in ``directory``. Default OFF — a
+        router without the flag ticks nothing and allocates nothing."""
+        from gol_tpu.obs import history as obs_history
+
+        if interval <= 0:
+            raise ValueError(f"history interval must be > 0, got {interval}")
+        if self._history is not None:
+            return
+        kwargs = {}
+        if total_bytes is not None:
+            kwargs["total_bytes"] = total_bytes
+            kwargs["segment_bytes"] = min(
+                obs_history.DEFAULT_SEGMENT_BYTES, max(1, total_bytes // 4)
+            )
+        self._history = obs_history.HistoryWriter(
+            directory, source="router", **kwargs
+        )
+        self._history_stop.clear()
+
+        def loop():
+            while not self._history_stop.wait(interval):
+                try:
+                    self.history_tick()
+                except Exception:  # noqa: BLE001 - telemetry must survive
+                    logger.exception("router history tick failed")
+
+        self._history_thread = threading.Thread(
+            target=loop, name="gol-fleet-history", daemon=True
+        )
+        self._history_thread.start()
+
+    def history_tick(self) -> None:
+        """One history sample (public so tests drive it deterministically):
+        the merged view the operators' dashboards read, plus the fleet
+        membership gauges — the durable record answers "what was the fleet
+        doing" without a second artifact."""
+        if self._history is None:
+            return
+        _, merged = self._merged_snapshot()
+        stats = self.fleet.stats()
+        sample = {
+            "counters": dict(merged.get("counters") or {}),
+            "gauges": {
+                **(merged.get("gauges") or {}),
+                "fleet_workers": stats["workers"],
+                "fleet_workers_healthy": stats["healthy"],
+                "fleet_worker_restarts": stats["restarts"],
+            },
+            "histograms": dict(merged.get("histograms") or {}),
+        }
+        self._history.append(sample)
+
     def shutdown(self, cascade: bool = True) -> None:
         """Stop serving; with ``cascade`` (the SIGTERM path) drain the
         whole fleet and SIGTERM local workers first. ``cascade=False``
         abandons the workers untouched — the router-restart lane."""
+        if self._history_thread is not None:
+            self._history_stop.set()
+            self._history_thread.join(timeout=5)
+            self._history_thread = None
+        if self._history is not None:
+            self._history.close()
+            self._history = None
         if cascade:
             self.drain()
             self.fleet.stop_health()
@@ -457,10 +528,41 @@ class RouterServer:
         order = self.candidates(key, rank_label=rank_label)
         if not order:
             return 503, {"error": "fleet has no routable workers"}
+        # Trace-context propagation (obs/propagate.py), ONLY while tracing
+        # is enabled (`gol fleet --trace`): one fleet-wide trace id per
+        # submit — spillover hops re-send the SAME id, so however many
+        # workers the walk visits, the job is one flow chain. The flow
+        # START is stamped at forward time; the adopting worker's claim
+        # point closes the router→worker fleet-queueing gap that
+        # `gol trace-report` measures. Disabled (the default), this block
+        # allocates nothing and the forwarded request is byte-identical
+        # to the headerless PR-8 wire format (test-pinned).
+        if not obs_trace.enabled():
+            # The disabled path builds NOTHING extra — no header, no span
+            # attributes, no candidate-ranking string: byte-identical
+            # requests and PR-8 work per submit (test-pinned).
+            return self._forward_submit(raw, key, order, None)
+        trace_id = propagate.new_trace_id()
+        headers = {propagate.TRACE_HEADER: propagate.encode(
+            trace_id, propagate.sender_label()
+        )}
+        obs_trace.flow("job", trace_id, "s", bucket=key.label())
+        with obs_trace.span(
+            "fleet.submit", bucket=key.label(),
+            candidates=",".join(w.id for w in order),
+            cache_route=bool(rank_label),
+        ):
+            return self._forward_submit(raw, key, order, headers)
+
+    def _forward_submit(self, raw: bytes, key: placement.PlacementKey,
+                        order: list[Worker], headers: dict | None):
+        """The spillover walk: try workers in ranked order; spans/events
+        record each hop without ever changing a status code."""
         last = (503, {"error": "no worker accepted the job"})
         small = key.max_edge <= self.big_edge
         shed_seen = False  # any 429: keep it as the client's answer
         normal_shed = False  # a NORMAL worker shed: skip big-lane tails
+        http_kwargs = {"headers": headers} if headers else {}
         for worker in order:
             if worker.big and small and normal_shed:
                 # The big lane is the last resort for small jobs ONLY
@@ -473,10 +575,13 @@ class RouterServer:
                 # mid-walk, the next big still gets its try.)
                 continue
             try:
-                status, payload = self.http(
-                    "POST", worker.url + "/jobs", raw=raw,
-                    timeout=self.submit_timeout,
-                )
+                with obs_trace.span("fleet.forward", worker=worker.id,
+                                    big=worker.big):
+                    status, payload = self.http(
+                        "POST", worker.url + "/jobs", raw=raw,
+                        timeout=self.submit_timeout,
+                        **http_kwargs,
+                    )
             except (urllib.error.URLError, ConnectionError, OSError) as err:
                 self.registry.inc("route_errors_total")
                 if not _delivery_impossible(err):
@@ -486,6 +591,8 @@ class RouterServer:
                     # Spilling here would run the board twice under two
                     # ids; surface the ambiguity instead and let the
                     # client decide (poll /fleet, resubmit knowingly).
+                    obs_trace.event("fleet.ambiguous", worker=worker.id,
+                                    error=type(err).__name__)
                     return 504, {
                         "error": f"worker {worker.id} did not answer the "
                                  "submit in time; outcome unknown — the "
@@ -494,6 +601,8 @@ class RouterServer:
                 # Nothing was delivered: spilling is safe. A 429 already
                 # seen stays the answer — Retry-After is actionable,
                 # "unreachable" is not.
+                obs_trace.event("fleet.spill", worker=worker.id,
+                                reason="unreachable")
                 if not shed_seen:
                     last = (503, {
                         "error": f"worker {worker.id} unreachable: {err}",
@@ -505,6 +614,8 @@ class RouterServer:
                 # client only sees a 429 when the WHOLE fleet sheds.
                 self.fleet.note_shed(worker.id)
                 self.registry.inc("route_sheds_total")
+                obs_trace.event("fleet.spill", worker=worker.id,
+                                reason="shed")
                 shed_seen = True
                 normal_shed = normal_shed or not worker.big
                 last = (status, payload)
@@ -798,6 +909,16 @@ def _make_handler(router: RouterServer):
                                 content_type="text/plain; version=0.0.4")
             elif path == "/slo":
                 self._reply(200, router.slo_json())
+            elif path == "/debug/trace":
+                # The router's span ring, same shape as the worker
+                # endpoint — what `gol fleet-trace` stitches per process.
+                tracer = obs_trace.tracer()
+                self._reply(200, {
+                    "enabled": tracer.enabled,
+                    "meta": tracer.metadata(),
+                    "spans": tracer.snapshot(),
+                    "registry": obs_registry.default().snapshot(),
+                })
             elif path == "/fleet":
                 self._reply(200, router.fleet_json())
             elif path == "/healthz":

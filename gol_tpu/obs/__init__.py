@@ -24,17 +24,26 @@ tune, and the CLI:
                      alerts, optional admission shedding);
 - ``obs.sampler``  — the serve-side background sampler: SLO evaluation
                      ticks plus the continuous dispatch-gap monitor;
-- ``obs.top``      — ``gol top`` terminal dashboard rendering.
+- ``obs.top``      — ``gol top`` terminal dashboard rendering;
+- ``obs.propagate``— trace-context propagation: the ``X-Gol-Trace``
+                     header joining router and worker spans into one
+                     fleet-wide trace;
+- ``obs.fleettrace`` — ``gol fleet-trace``: collect every live process's
+                     span ring and stitch ONE clock-normalized
+                     Chrome/Perfetto timeline;
+- ``obs.history``  — durable metrics history: append-only, size-capped
+                     snapshot ring + ``gol history-report``.
 
 Stdlib-only at import time (jax loads lazily inside ``profiler.capture``),
 so arming observability never reorders backend initialization.
 """
 
 from gol_tpu.obs import (  # noqa: F401
-    profiler, recorder, registry, report, sampler, slo, timeline, top, trace,
+    fleettrace, history, profiler, propagate, recorder, registry, report,
+    sampler, slo, timeline, top, trace,
 )
 
 __all__ = [
-    "profiler", "recorder", "registry", "report", "sampler", "slo",
-    "timeline", "top", "trace",
+    "fleettrace", "history", "profiler", "propagate", "recorder",
+    "registry", "report", "sampler", "slo", "timeline", "top", "trace",
 ]

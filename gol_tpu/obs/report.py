@@ -17,6 +17,13 @@ Three views, built from the same normalized span list:
 - **gap analysis** — per thread, untraced wall time between consecutive
   top-level spans (where a run spends time *nobody* instrumented — the
   question phase printfs can never answer).
+
+A stitched FLEET trace (``gol fleet-trace``, multiple pids) additionally
+renders **per-process** phase tables (one per pid lane, labeled from the
+stitcher's process table) and the **cross-process gap**: per propagated
+flow id, the time between the router's forward point (``ph:"s"`` in the
+router pid) and the owning worker's claim point (``ph:"t"`` in another
+pid) — the fleet-queueing hop no single process can measure.
 """
 
 from __future__ import annotations
@@ -47,6 +54,7 @@ def load_spans(path: str) -> tuple[list[dict], dict]:
                 "start_us": float(e.get("ts", 0.0)),
                 "dur_us": float(e.get("dur", 0.0)),
                 "tid": e.get("tid", 0),
+                "pid": e.get("pid", 0),
                 "depth": (e.get("args") or {}).get("depth", 0),
                 "attrs": {k: v for k, v in (e.get("args") or {}).items()
                           if k != "depth"},
@@ -55,12 +63,24 @@ def load_spans(path: str) -> tuple[list[dict], dict]:
             if e.get("ph") == "X"
         ]
         meta = dict(doc.get("otherData") or {})
-        flows = _flow_counts(
-            e.get("ph") for e in doc["traceEvents"]
+        flow_events = [
+            {
+                "id": str(e.get("id", "0")),
+                "ph": e["ph"],
+                "ts_us": float(e.get("ts", 0.0)),
+                "pid": e.get("pid", 0),
+                "attrs": dict(e.get("args") or {}),
+            }
+            for e in doc["traceEvents"]
             if e.get("ph") in ("s", "t", "f")
-        )
+        ]
+        flows = _flow_counts(e["ph"] for e in flow_events)
         if flows:
             meta["flows"] = flows
+        if flow_events:
+            # The stitched-fleet lane: points keep ts/pid so the
+            # cross-process gap analysis below can measure the hop.
+            meta["flow_points"] = flow_events
         return spans, meta
     # Flight-recorder JSONL.
     spans, meta, flow_phases = [], {}, []
@@ -89,6 +109,7 @@ def load_spans(path: str) -> tuple[list[dict], dict]:
                 "start_us": float(rec.get("start_s", 0.0)) * 1e6,
                 "dur_us": float(rec.get("duration_s", 0.0)) * 1e6,
                 "tid": rec.get("tid", 0),
+                "pid": 0,  # a flight dump is one process by construction
                 "depth": rec.get("depth", 0),
                 "attrs": rec.get("attrs") or {},
             })
@@ -197,6 +218,37 @@ def gap_analysis(spans: list[dict]) -> list[str]:
     return lines
 
 
+def cross_process_gaps(flow_points: list[dict]) -> dict[str, list[float]]:
+    """Per flow id, the router-forward -> worker-claim hop in microseconds.
+
+    A gap exists when a flow id has an ``s`` point in one pid and a ``t``
+    point in a DIFFERENT pid (the propagated id's contract: the router
+    stamps ``s`` at forward time, the adopting worker steps ``t`` at
+    accept/claim). The claim point — ``attrs.state == "claimed"`` — is
+    preferred; the first foreign ``t`` (admission) is the fallback, so
+    partially-adopted traces still measure the hop. Returns
+    ``{"fleet_queueing": [gap_us, ...]}`` (empty when the trace is
+    single-process)."""
+    by_id: dict[str, list[dict]] = {}
+    for p in flow_points:
+        by_id.setdefault(p["id"], []).append(p)
+    gaps: list[float] = []
+    for points in by_id.values():
+        starts = [p for p in points if p["ph"] == "s"]
+        if not starts:
+            continue
+        start = min(starts, key=lambda p: p["ts_us"])
+        foreign = [p for p in points
+                   if p["ph"] == "t" and p["pid"] != start["pid"]]
+        if not foreign:
+            continue
+        claimed = [p for p in foreign
+                   if p["attrs"].get("state") == "claimed"]
+        target = min(claimed or foreign, key=lambda p: p["ts_us"])
+        gaps.append(target["ts_us"] - start["ts_us"])
+    return {"fleet_queueing": gaps} if gaps else {}
+
+
 def render(path: str) -> str:
     spans, meta = load_spans(path)
     lines = [f"# trace report: {path}", ""]
@@ -227,9 +279,34 @@ def render(path: str) -> str:
         return "\n".join(lines) + "\n"
     lines.append(f"{len(spans)} span(s)")
     lines.append("")
-    lines.append("## per-phase")
-    lines.extend(phase_table(spans))
-    lines.append("")
+    pids = sorted({s["pid"] for s in spans})
+    if len(pids) > 1:
+        # A stitched fleet trace: one phase table per process lane, the
+        # lane labeled from the stitcher's process table when present.
+        labels = {}
+        for name, info in (meta.get("processes") or {}).items():
+            labels[info.get("pid")] = name
+        for pid in pids:
+            label = labels.get(pid)
+            lines.append(f"## per-phase — process {pid}"
+                         + (f" ({label})" if label else ""))
+            lines.extend(phase_table([s for s in spans if s["pid"] == pid]))
+            lines.append("")
+    else:
+        lines.append("## per-phase")
+        lines.extend(phase_table(spans))
+        lines.append("")
+    gaps = cross_process_gaps(meta.get("flow_points") or [])
+    for name, values in sorted(gaps.items()):
+        lines.append(f"## cross-process gaps — {name} "
+                     "(router forward -> worker claim)")
+        lines.append(
+            f"  {len(values)} hop(s): p50 "
+            f"{_fmt_ms(registry.quantile(values, 0.5))} ms, p95 "
+            f"{_fmt_ms(registry.quantile(values, 0.95))} ms, max "
+            f"{_fmt_ms(max(values))} ms"
+        )
+        lines.append("")
     lines.append("## span tree (newest top-level spans)")
     lines.extend(span_tree(spans))
     lines.append("")

@@ -60,6 +60,7 @@ class ServeSampler:
         slo=None,
         interval: float = 1.0,
         marginal_rates: dict[str, float] | None = None,
+        history=None,
         clock=time.perf_counter,
     ):
         if interval <= 0:
@@ -68,6 +69,9 @@ class ServeSampler:
         self.slo = slo
         self.interval = interval
         self.marginal_rates = dict(marginal_rates or {})
+        # Durable metrics history (obs/history.py HistoryWriter) or None
+        # (the default — no history object means zero per-tick cost).
+        self.history = history
         self._clock = clock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -106,6 +110,10 @@ class ServeSampler:
         if self.slo is not None:
             self.slo.evaluate()
         self._sample_gap()
+        if self.history is not None:
+            # One snapshot per tick into the durable ring: taken AFTER the
+            # gap sample so the freshly-set gauges ride the same record.
+            self.history.append(self.registry.snapshot())
 
     def _sample_gap(self) -> None:
         now = self._clock()

@@ -106,6 +106,13 @@ class Job:
     # scheduler at admission when a cache is mounted; None otherwise (and
     # for no_cache jobs). Process-local — replayed jobs re-derive it.
     fingerprint: str | None = None
+    # The propagated fleet trace id (obs/propagate.py): set at admission
+    # when the router stamped an ``X-Gol-Trace`` header AND tracing is
+    # enabled in this process — the job's flow events then carry the
+    # fleet-wide id and chain onto the router's trace. Process-local like
+    # the perf_counter stamps; never journaled (replayed jobs have no
+    # live trace to join).
+    trace: str | None = None
     # perf_counter stamps, process-local (never journaled).
     accepted_at: float = 0.0
     started_at: float | None = None
@@ -182,6 +189,13 @@ class Job:
                 f"job {self.id}: illegal transition {self.state} -> {new_state}"
             )
         self.state = new_state
+
+    def flow_id(self) -> str:
+        """The Perfetto flow id this job's lifecycle events ride: the
+        propagated fleet trace id when a router stamped one (so the chain
+        crosses the process boundary), the job id otherwise — byte-for-byte
+        the pre-propagation behavior."""
+        return self.trace or self.id
 
     def dispatch_key(self):
         """Sort key for dispatch order inside a bucket: higher priority

@@ -394,11 +394,15 @@ class Scheduler:
                 self.metrics.set_gauge("queue_depth", self._queued)
                 self._cv.notify_all()
         # Flow START: with tracing on, the job's lifecycle becomes a Perfetto
-        # arrow chain from here to its finish inside a batch span.
-        obs_trace.flow("job", job.id, "s", bucket=key.label())
+        # arrow chain from here to its finish inside a batch span. A job
+        # carrying a propagated trace id (obs/propagate.py) chains onto the
+        # ROUTER's flow start instead of opening its own — phase "t", under
+        # the fleet-wide id.
+        obs_trace.flow("job", job.flow_id(), "t" if job.trace else "s",
+                       bucket=key.label())
         if hit is not None:
             self._journal_terminal(JobJournal.record_done, job)
-            obs_trace.flow("job", job.id, "f", state="cached")
+            obs_trace.flow("job", job.flow_id(), "f", state="cached")
         return job
 
     def _complete_from_cache_locked(self, job: Job, entry: CacheEntry,
@@ -610,7 +614,7 @@ class Scheduler:
             self.metrics.observe(
                 "queue_latency_seconds", started - job.accepted_at
             )
-            obs_trace.flow("job", job.id, "t", state="claimed")
+            obs_trace.flow("job", job.flow_id(), "t", state="claimed")
 
     def _on_retry(self, key: BucketKey, batch: list[Job]):
         def on_retry(attempt, err, delay):
@@ -638,7 +642,7 @@ class Scheduler:
             job.error = f"{type(err).__name__}: {err}"
             job.transition(FAILED)
             self.metrics.inc("jobs_failed_total")
-            obs_trace.flow("job", job.id, "f", state="failed")
+            obs_trace.flow("job", job.flow_id(), "f", state="failed")
             self._journal_terminal(JobJournal.record_failed, job)
 
     def _take_followers(self, batch: list[Job]) -> list[Job]:
@@ -729,7 +733,7 @@ class Scheduler:
             self.metrics.observe(
                 "job_latency_seconds_" + priority_class(f.priority), latency
             )
-            obs_trace.flow("job", f.id, "f", state="coalesced")
+            obs_trace.flow("job", f.flow_id(), "f", state="coalesced")
         # One journal append + fsync for the whole batch's done records
         # (identical lines to per-job appends — replay is oblivious): the
         # per-record fsync was the last per-*job* serial host cost on the
@@ -801,7 +805,8 @@ class Scheduler:
                 # Flow FINISH inside the batch span, so Perfetto binds the
                 # arrow head to the enclosing serve.batch slice.
                 for job in batch:
-                    obs_trace.flow("job", job.id, "f", bucket=key.label())
+                    obs_trace.flow("job", job.flow_id(), "f",
+                                   bucket=key.label())
         except Exception as err:  # noqa: BLE001 - every job must terminate
             self._fail_batch(key, batch, err)
             return
@@ -935,7 +940,8 @@ class Scheduler:
                     on_retry=self._on_retry(key, batch),
                 )
                 for job in batch:
-                    obs_trace.flow("job", job.id, "f", bucket=key.label())
+                    obs_trace.flow("job", job.flow_id(), "f",
+                                   bucket=key.label())
         except Exception as err:  # noqa: BLE001 - every job must terminate
             self._fail_batch(key, batch, err)
             return

@@ -8,7 +8,10 @@ Endpoints (all JSON unless noted):
   text-grid encoding (the same bytes the CLI reads/writes). 202 + ``{"id",
   "state"}`` on acceptance, 429 when the queue is full or draining, 400 on
   a bad request. With the result cache mounted (``--result-cache``) a
-  repeat board completes at admission; ``no_cache: true`` opts out.
+  repeat board completes at admission; ``no_cache: true`` opts out. An
+  ``X-Gol-Trace`` header (a tracing fleet router's stamp) is adopted as
+  the job's flow id when tracing is enabled here, and ignored otherwise —
+  requests and responses are byte-identical either way (obs/propagate.py).
 - ``GET /jobs/<id>``  — lifecycle state + timings.
 - ``GET /result/<id>``— final grid (text-grid string), generations, exit
   reason; 409 while the job is not DONE, 410 for FAILED/CANCELLED. A
@@ -56,6 +59,8 @@ from urllib.parse import urlparse, parse_qs
 
 from gol_tpu.io import text_grid
 from gol_tpu.obs import (
+    history as obs_history,
+    propagate as obs_propagate,
     recorder as obs_recorder,
     registry as obs_registry,
     sampler as obs_sampler,
@@ -106,6 +111,8 @@ class GolServer:
         cache_dir: str | None = None,
         cache_entries: int = 1024,
         cache_payload: str = "text",
+        history_dir: str | None = None,
+        history_bytes: int | None = None,
         **scheduler_kwargs,
     ):
         self.metrics = metrics or Metrics()
@@ -139,14 +146,32 @@ class GolServer:
             registry=self.metrics,
             shed=slo_shed,
         )
+        # Durable metrics history (obs/history.py): OFF by default — no
+        # writer object, no per-tick work. With --metrics-history, every
+        # sampler tick appends the serving registry snapshot to the
+        # size-capped ring, so this process's window survives it.
+        self.history = None
+        if history_dir:
+            kwargs = {}
+            if history_bytes:
+                kwargs["total_bytes"] = history_bytes
+                kwargs["segment_bytes"] = min(
+                    obs_history.DEFAULT_SEGMENT_BYTES,
+                    max(1, history_bytes // 4),
+                )
+            self.history = obs_history.HistoryWriter(
+                history_dir, source="serve", **kwargs
+            )
         # One background thread ticks the SLO evaluation AND the dispatch-
-        # gap monitor; sample_interval <= 0 disables the thread (tests call
+        # gap monitor (and, when mounted, the metrics-history append);
+        # sample_interval <= 0 disables the thread (tests call
         # sampler.tick() themselves).
         self.sampler = obs_sampler.ServeSampler(
             self.metrics,
             slo=self.slo,
             interval=sample_interval if sample_interval > 0 else 1.0,
             marginal_rates=_tuned_marginal_rates(),
+            history=self.history,
         )
         self._sample_interval = sample_interval
         self.replayed = 0
@@ -199,6 +224,8 @@ class GolServer:
 
     def shutdown(self, drain: bool = True) -> None:
         self.sampler.stop()
+        if self.history is not None:
+            self.history.close()
         obs_recorder.remove_state_provider(obs_slo.STATE_PROVIDER)
         self.scheduler.stop(drain=drain)
         self.httpd.shutdown()
@@ -211,7 +238,7 @@ class GolServer:
 
     # -- request-level operations (handler methods stay thin) -------------
 
-    def submit_json(self, body: dict) -> dict:
+    def submit_json(self, body: dict, trace_header: str | None = None) -> dict:
         required = ("width", "height", "cells")
         missing = [k for k in required if k not in body]
         if missing:
@@ -232,6 +259,16 @@ class GolServer:
         if body.get("deadline_s") is not None:
             kwargs["deadline_s"] = float(body["deadline_s"])
         job = new_job(width, height, board, **kwargs)
+        # Trace-context adoption (obs/propagate.py): a router forwarding
+        # under `--trace` stamps X-Gol-Trace; when tracing is enabled HERE
+        # too, the job's flow events ride the fleet-wide id and chain onto
+        # the router's trace. Tracing disabled (the default) never looks at
+        # the header — an old client (no header) and a headered forward are
+        # byte-identical through this path, response included (test-pinned).
+        if trace_header is not None and obs_trace.enabled():
+            ctx = obs_propagate.decode(trace_header)
+            if ctx is not None:
+                job.trace = ctx[0]
         self.scheduler.submit(job)
         return {"id": job.id, "state": job.state}
 
@@ -386,7 +423,12 @@ def _make_handler(server: GolServer):
                         )
                         return
                     try:
-                        out = server.submit_json(self._read_body())
+                        out = server.submit_json(
+                            self._read_body(),
+                            trace_header=self.headers.get(
+                                obs_propagate.TRACE_HEADER
+                            ),
+                        )
                     except (QueueFull, Draining) as e:
                         self._reply(429, {"error": str(e)})
                         return

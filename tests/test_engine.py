@@ -167,6 +167,21 @@ def test_choose_mesh_shape():
     assert choose_mesh_shape(7, width=917504) == (1, 7)
 
 
+def test_choose_mesh_shape_cap_fallback_warns_via_warnings(recwarn):
+    """ADVICE r5: the width-cap fallback must announce itself through
+    ``warnings.warn`` (filterable, per-call-site deduped), never a raw
+    stderr write from library code. One device on a grid no factorization
+    can keep under the temporal kernel's width cap takes the fallback and
+    warns RuntimeWarning; the in-cap path stays silent."""
+    import warnings
+
+    with pytest.warns(RuntimeWarning, match="width cap"):
+        assert choose_mesh_shape(1, width=524288) == (1, 1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning would raise
+        assert choose_mesh_shape(8, width=262144) == (8, 1)
+
+
 def test_choose_mesh_shape_height_aware(capsys):
     # Heights the row-only default cannot shard fall to the row-heaviest
     # factorization that divides the grid (advisor r3: the old near-square

@@ -1,0 +1,729 @@
+"""Fleet-wide distributed tracing + durable metrics history (ISSUE 10).
+
+The load-bearing assertions:
+
+- **propagation compatibility** — with tracing DISABLED (the default), an
+  ``X-Gol-Trace`` header on a submit changes NOTHING (response shape, job
+  state, span ring all byte-identical to a headerless submit), and a
+  tracing router never adds the header; enabled, the worker adopts the
+  propagated id and its flow events chain onto the router's;
+- **stitching** — ``gol fleet-trace`` merges per-process ``/debug/trace``
+  payloads into one Chrome document with per-process pid lanes and the
+  per-process clock-skew adjustment applied (pinned on injected skew);
+- **history** — the snapshot ring rotates, compacts to its byte cap,
+  tolerates torn tails, continues numbering across respawns; the
+  router-side history (fed through the PR-8 MonotonicCounters floors)
+  stays monotonic through a worker reset; ``tools/bench_diff.py
+  --history`` exits nonzero on a regressed window;
+- **spillover/429/504 walks** keep their PR-8 status codes exactly, with
+  or without tracing.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gol_tpu.fleet import placement
+from gol_tpu.fleet.router import RouterServer
+from gol_tpu.fleet.workers import Fleet
+from gol_tpu.io import text_grid
+from gol_tpu.obs import (
+    fleettrace, history, propagate, report, sampler as obs_sampler, trace,
+)
+from gol_tpu.serve.server import GolServer
+
+import tools.bench_diff as bench_diff
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts and ends with the tracer off, empty, and at the
+    default ring size (the test_obs.py hygiene rule)."""
+    trace.enable(ring_size=trace._DEFAULT_RING)
+    trace.disable()
+    trace.clear()
+    yield
+    trace.enable(ring_size=trace._DEFAULT_RING)
+    trace.disable()
+    trace.clear()
+
+
+def _http(method, url, body=None, headers=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    hdrs = {"Content-Type": "application/json"} if body else {}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=data, method=method, headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestPropagate:
+    def test_round_trip(self):
+        tid = propagate.new_trace_id()
+        value = propagate.encode(tid, propagate.sender_label())
+        assert propagate.decode(value) == (tid, propagate.sender_label())
+        assert propagate.decode(propagate.encode(tid)) == (tid, None)
+
+    def test_malformed_values_degrade_to_none(self):
+        for bad in (None, "", 7, "not a token!", "a/b/c!", "x" * 65,
+                    "ok/" + "y" * 65, "sp ace"):
+            assert propagate.decode(bad) is None
+
+    def test_encode_rejects_bad_tokens(self):
+        with pytest.raises(ValueError):
+            propagate.encode("bad token!")
+        with pytest.raises(ValueError):
+            propagate.encode("ok", "bad parent!")
+
+
+class TestWorkerAdoption:
+    """The serve-side half of the propagation contract, over real HTTP."""
+
+    def _boot(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01, sample_interval=0)
+        srv.start()
+        return srv
+
+    def _submit(self, srv, headers=None, seed=1):
+        board = text_grid.generate(16, 16, seed=seed)
+        return _http("POST", f"{srv.url}/jobs", {
+            "width": 16, "height": 16,
+            "cells": text_grid.encode(board).decode("ascii"),
+            "gen_limit": 2,
+        }, headers=headers)
+
+    def test_header_ignored_while_tracing_disabled(self, tmp_path):
+        """Old-worker behavior, byte-identical: a headered submit against
+        a tracing-disabled server is indistinguishable from a headerless
+        one — same response shape, no adopted trace, empty span ring."""
+        srv = self._boot(tmp_path)
+        try:
+            hdr = {propagate.TRACE_HEADER: propagate.encode("cafe1234")}
+            status_h, payload_h = self._submit(srv, headers=hdr, seed=1)
+            status_n, payload_n = self._submit(srv, headers=None, seed=2)
+            assert status_h == status_n == 202
+            assert set(payload_h) == set(payload_n) == {"id", "state"}
+            assert payload_h["state"] == payload_n["state"]
+            for payload in (payload_h, payload_n):
+                job = srv.scheduler.job(payload["id"])
+                assert job.trace is None
+                assert job.flow_id() == job.id
+            assert trace.snapshot() == []  # nothing recorded, ever
+        finally:
+            srv.shutdown()
+
+    def test_no_header_submit_is_byte_identical_with_tracing_on(self, tmp_path):
+        """Old-client-to-new-server: without the header, a traced server's
+        flow events are EXACTLY the PR-7 shape — phase "s" under the job's
+        own id."""
+        srv = self._boot(tmp_path)
+        try:
+            trace.enable()
+            status, payload = self._submit(srv)
+            assert status == 202
+            flows = [s for s in trace.snapshot()
+                     if (s["attrs"] or {}).get("flow_phase")]
+            starts = [s for s in flows
+                      if s["attrs"]["flow_phase"] == "s"]
+            assert starts and starts[0]["attrs"]["flow_id"] == payload["id"]
+        finally:
+            srv.shutdown()
+
+    def test_traced_server_adopts_header(self, tmp_path):
+        srv = self._boot(tmp_path)
+        try:
+            trace.enable()
+            tid = "feed0123deadbeef"
+            hdr = {propagate.TRACE_HEADER: propagate.encode(tid, "router-1")}
+            status, payload = self._submit(srv, headers=hdr)
+            assert status == 202
+            job = srv.scheduler.job(payload["id"])
+            assert job.trace == tid and job.flow_id() == tid
+            flows = [s for s in trace.snapshot()
+                     if (s["attrs"] or {}).get("flow_id") == tid]
+            # The adopting side STEPS the router's flow (phase "t"), never
+            # opens a second chain with "s".
+            assert flows and flows[0]["attrs"]["flow_phase"] == "t"
+            assert not any(s["attrs"]["flow_phase"] == "s" for s in flows)
+        finally:
+            srv.shutdown()
+
+    def test_malformed_header_degrades_to_own_id(self, tmp_path):
+        srv = self._boot(tmp_path)
+        try:
+            trace.enable()
+            hdr = {propagate.TRACE_HEADER: "not a token!!/nope"}
+            status, payload = self._submit(srv, headers=hdr)
+            assert status == 202
+            assert srv.scheduler.job(payload["id"]).trace is None
+        finally:
+            srv.shutdown()
+
+
+class TestRouterPropagation:
+    def _fake_fleet(self, tmp_path, ids=("wa", "wb")):
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        for wid in ids:
+            fleet.attach(f"http://{wid}.invalid", wid)
+        return fleet
+
+    BODY = json.dumps({"width": 32, "height": 32}).encode()
+
+    def test_disabled_router_sends_no_header(self, tmp_path):
+        """The disabled path is the PR-8 wire format exactly: the stub
+        accepts NO headers kwarg, so any stamped header would raise."""
+        def stub_http(method, url, body=None, raw=None, timeout=0):
+            return 202, {"id": "j1", "state": "queued"}
+
+        router = RouterServer(self._fake_fleet(tmp_path), port=0,
+                              http=stub_http)
+        try:
+            status, payload = router.route_submit(self.BODY)
+            assert status == 202
+            assert trace.snapshot() == []
+        finally:
+            router.httpd.server_close()
+
+    def test_traced_router_stamps_header_and_flow(self, tmp_path):
+        seen = {}
+
+        def stub_http(method, url, body=None, raw=None, timeout=0,
+                      headers=None):
+            seen["headers"] = headers
+            return 202, {"id": "j1", "state": "queued"}
+
+        router = RouterServer(self._fake_fleet(tmp_path), port=0,
+                              http=stub_http)
+        try:
+            trace.enable()
+            status, _ = router.route_submit(self.BODY)
+            assert status == 202
+            ctx = propagate.decode(
+                (seen["headers"] or {}).get(propagate.TRACE_HEADER)
+            )
+            assert ctx is not None
+            tid, parent = ctx
+            assert parent == propagate.sender_label()
+            spans = trace.snapshot()
+            flows = [s for s in spans
+                     if (s["attrs"] or {}).get("flow_id") == tid]
+            assert flows and flows[0]["attrs"]["flow_phase"] == "s"
+            names = [s["name"] for s in spans]
+            assert "fleet.submit" in names and "fleet.forward" in names
+            submit = next(s for s in spans if s["name"] == "fleet.submit")
+            # The candidate ranking rides the span (the walk's evidence).
+            assert set(submit["attrs"]["candidates"].split(",")) == {
+                "wa", "wb"
+            }
+        finally:
+            router.httpd.server_close()
+
+    def test_traced_spillover_walk_keeps_status_codes(self, tmp_path):
+        """429-then-202, unreachable-then-202, and the ambiguous 504 all
+        answer EXACTLY their PR-8 statuses with tracing on — spans and
+        spill events are evidence, never behavior."""
+        key = placement.key_for(json.loads(self.BODY))
+        first, second = placement.rank(key.label(), ["wa", "wb"])
+
+        def shed_then_accept(method, url, body=None, raw=None, timeout=0,
+                             headers=None):
+            wid = url.split("//")[1].split(".")[0]
+            if wid == first:
+                return 429, {"error": "shedding"}
+            return 202, {"id": "j1", "state": "queued"}
+
+        trace.enable()
+        router = RouterServer(self._fake_fleet(tmp_path), port=0,
+                              http=shed_then_accept)
+        try:
+            status, payload = router.route_submit(self.BODY)
+            assert status == 202 and payload["worker"] == second
+            spills = [s for s in trace.snapshot()
+                      if s["name"] == "fleet.spill"]
+            assert spills and spills[0]["attrs"]["reason"] == "shed"
+        finally:
+            router.httpd.server_close()
+
+        trace.clear()
+
+        def ambiguous(method, url, body=None, raw=None, timeout=0,
+                      headers=None):
+            raise TimeoutError("mid-exchange")
+
+        router = RouterServer(self._fake_fleet(tmp_path, ids=("wc", "wd")),
+                              port=0, http=ambiguous)
+        try:
+            status, payload = router.route_submit(self.BODY)
+            assert status == 504 and "outcome unknown" in payload["error"]
+            assert any(s["name"] == "fleet.ambiguous"
+                       for s in trace.snapshot())
+        finally:
+            router.httpd.server_close()
+
+
+class TestStitch:
+    @staticmethod
+    def _payload(pid, anchor_ns, spans, anchor_perf=100.0):
+        return {
+            "enabled": True,
+            "meta": {"pid": pid, "anchor_perf_s": anchor_perf,
+                     "anchor_unix_ns": anchor_ns, "dropped_spans": 0},
+            "spans": spans,
+        }
+
+    @staticmethod
+    def _span(name, start, **attrs):
+        return {"name": name, "start_s": start, "duration_s": 0.01,
+                "tid": 7, "thread_name": "t", "depth": 0,
+                "attrs": attrs or None}
+
+    def test_skew_adjustment_is_applied(self):
+        """Two processes whose wall anchors differ by exactly 500us: the
+        later process's events shift by +500us on the stitched axis —
+        the injected-skew pin of the acceptance criteria."""
+        router = self._payload(10, 1_000_000_000, [
+            self._span("fleet.submit", 100.5),
+            self._span("job", 100.5, flow_phase="s", flow_id="abc"),
+        ])
+        worker = self._payload(20, 1_000_500_000, [
+            self._span("serve.batch", 100.2),
+            self._span("job", 100.2, flow_phase="t", flow_id="abc",
+                       state="claimed"),
+        ])
+        doc = fleettrace.stitch([
+            {"name": "router", "payload": router},
+            {"name": "w0", "payload": worker},
+        ])
+        ts = {(e["pid"], e["name"]): e["ts"]
+              for e in doc["traceEvents"] if e["ph"] != "M"}
+        # Router: (100.5 - 100.0) * 1e6 + 0 skew; worker: 0.2s + 500us.
+        assert ts[(10, "fleet.submit")] == pytest.approx(500_000.0)
+        assert ts[(20, "serve.batch")] == pytest.approx(200_500.0)
+        procs = doc["otherData"]["processes"]
+        assert procs["router"]["skew_us_vs_origin"] == 0.0
+        assert procs["w0"]["skew_us_vs_origin"] == pytest.approx(500.0)
+        # Both processes present with their own pids + name metadata.
+        assert {e["pid"] for e in doc["traceEvents"]} == {10, 20}
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas} == {
+            "router (pid 10)", "w0 (pid 20)"
+        }
+        # The flow chain crosses processes under ONE id.
+        flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "t")]
+        assert {f["id"] for f in flows} == {"abc"}
+        assert {f["pid"] for f in flows} == {10, 20}
+
+    def test_pid_collision_gets_synthetic_lanes(self):
+        """In-process test fleets report one pid for every lane; the
+        stitcher must keep the lanes distinct (and record the real pid)."""
+        a = self._payload(42, 1_000_000_000, [self._span("x", 100.1)])
+        b = self._payload(42, 1_000_000_000, [self._span("y", 100.1)])
+        doc = fleettrace.stitch([
+            {"name": "router", "payload": a},
+            {"name": "w0", "payload": b},
+        ])
+        procs = doc["otherData"]["processes"]
+        assert procs["router"]["pid"] != procs["w0"]["pid"]
+        assert procs["router"]["real_pid"] == procs["w0"]["real_pid"] == 42
+
+    def test_pid_in_synthetic_block_cannot_hang_the_probe(self):
+        """A real pid that IS its own synthetic fallback (1_00X_000 +
+        pid%1000 — reachable on hosts with a large pid_max) used to make
+        the collision loop a fixed point and spin forever; the probe must
+        advance and terminate with distinct lanes."""
+        # index 1's fallback for real pid 1001234 is 1_000_000 + 1000 +
+        # 234 = 1001234 — the colliding pid itself.
+        a = self._payload(1_001_234, 1_000_000_000, [self._span("x", 100.1)])
+        b = self._payload(1_001_234, 1_000_000_000, [self._span("y", 100.1)])
+        doc = fleettrace.stitch([
+            {"name": "router", "payload": a},
+            {"name": "w0", "payload": b},
+        ])
+        procs = doc["otherData"]["processes"]
+        pids = {procs["router"]["pid"], procs["w0"]["pid"]}
+        assert len(pids) == 2
+
+    def test_unreachable_and_disabled_processes_are_skipped(self):
+        live = self._payload(10, 1_000_000_000, [self._span("x", 100.1)])
+        disabled = {"enabled": False,
+                    "meta": {"pid": 11, "anchor_perf_s": 0.0,
+                             "anchor_unix_ns": 0},
+                    "spans": []}
+        doc = fleettrace.stitch([
+            {"name": "router", "payload": live},
+            {"name": "w0", "payload": None, "error": "unreachable"},
+            {"name": "w1", "payload": disabled},
+        ])
+        assert set(doc["otherData"]["processes"]) == {"router"}
+        skipped = {s["name"]: s["reason"]
+                   for s in doc["otherData"]["skipped"]}
+        assert skipped["w0"] == "unreachable"
+        assert "disabled" in skipped["w1"]
+
+    def test_report_renders_per_process_tables_and_fleet_gap(self, tmp_path):
+        """A stitched file renders one phase table per process plus the
+        router-forward -> worker-claim fleet-queueing gap."""
+        router = self._payload(10, 1_000_000_000, [
+            self._span("fleet.submit", 100.5),
+            self._span("job", 100.5, flow_phase="s", flow_id="abc"),
+        ])
+        worker = self._payload(20, 1_000_000_000, [
+            self._span("serve.batch", 100.9),
+            self._span("job", 100.52, flow_phase="t", flow_id="abc"),
+            self._span("job", 100.9, flow_phase="t", flow_id="abc",
+                       state="claimed"),
+            self._span("job", 100.95, flow_phase="f", flow_id="abc"),
+        ])
+        doc = fleettrace.stitch([
+            {"name": "router", "payload": router},
+            {"name": "w0", "payload": worker},
+        ])
+        path = tmp_path / "fleet-trace.json"
+        path.write_text(json.dumps(doc))
+        text = report.render(str(path))
+        assert "process 10 (router)" in text
+        assert "process 20 (w0)" in text
+        assert "fleet_queueing" in text
+        # The gap prefers the CLAIMED step: 100.9 - 100.5 = 400ms.
+        assert "p50 400.000 ms" in text
+
+    def test_collect_against_live_fleet(self, tmp_path):
+        """collect() walks GET /fleet and /debug/trace over real HTTP; a
+        stitched export from in-process workers still yields distinct
+        lanes (synthetic pids) and the cross-process flow chain."""
+        workers = {}
+        for wid in ("w0", "w1"):
+            srv = GolServer(port=0, journal_dir=str(tmp_path / wid),
+                            flush_age=0.01, sample_interval=0)
+            srv.start()
+            workers[wid] = srv
+        fleet = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            fleet.attach(srv.url, wid)
+        router = RouterServer(fleet, port=0)
+        router.start()
+        try:
+            trace.enable()
+            board = text_grid.generate(16, 16, seed=9)
+            status, payload = _http("POST", f"{router.url}/jobs", {
+                "width": 16, "height": 16,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": 2,
+            })
+            assert status == 202
+
+            def done():
+                s, p = _http("GET", f"{router.url}/jobs/{payload['id']}")
+                return s == 200 and p.get("state") == "done"
+            deadline = 60
+            import time as _time
+            while not done() and deadline > 0:
+                _time.sleep(0.05)
+                deadline -= 0.05
+            entries = fleettrace.collect(router.url)
+            assert {e["name"] for e in entries} == {"router", "w0", "w1"}
+            assert all(e["payload"] is not None for e in entries)
+            out = tmp_path / "stitched.json"
+            doc = fleettrace.export(router.url, str(out))
+            with open(out) as f:
+                json.load(f)  # valid JSON on disk
+            # One flow id appears in BOTH the router lane and a worker
+            # lane: the cross-process chain.
+            flows = [e for e in doc["traceEvents"]
+                     if e.get("ph") in ("s", "t", "f")]
+            by_id = {}
+            for e in flows:
+                by_id.setdefault(e["id"], set()).add(e["pid"])
+            assert any(len(pids) > 1 for pids in by_id.values()), by_id
+        finally:
+            router.shutdown(cascade=False)
+            for srv in workers.values():
+                srv.shutdown()
+
+
+class TestHistory:
+    @staticmethod
+    def _writer(d, **kw):
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+        kw.setdefault("clock", clock)
+        return history.HistoryWriter(str(d), **kw)
+
+    def test_round_trip_and_rotation(self, tmp_path):
+        w = self._writer(tmp_path / "h", segment_bytes=500,
+                         total_bytes=10_000)
+        for i in range(20):
+            w.append({"counters": {"jobs_completed_total": i},
+                      "gauges": {"queue_depth": i % 3}})
+        w.close()
+        segs = [n for n in os.listdir(tmp_path / "h")
+                if n.startswith("seg-")]
+        assert len(segs) > 1  # rotated
+        rs = history.runs(str(tmp_path / "h"))
+        assert len(rs) == 1  # one incarnation = one run across segments
+        samples = rs[0]["samples"]
+        assert [s["counters"]["jobs_completed_total"] for s in samples] == \
+            list(range(20))
+        assert [s["seq"] for s in samples] == list(range(1, 21))
+
+    def test_compaction_respects_byte_cap(self, tmp_path):
+        w = self._writer(tmp_path / "h", segment_bytes=400,
+                         total_bytes=1200)
+        for i in range(200):
+            w.append({"counters": {"c": i}})
+        w.close()
+        d = str(tmp_path / "h")
+        total = sum(os.path.getsize(os.path.join(d, n))
+                    for n in os.listdir(d))
+        # The cap bounds the ring (one in-flight segment of slack).
+        assert total <= 1200 + 400
+        # The newest samples survived; the oldest were compacted away.
+        samples = [s for r in history.runs(d) for s in r["samples"]]
+        assert samples[-1]["counters"]["c"] == 199
+        assert samples[0]["counters"]["c"] > 0
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        w = self._writer(tmp_path / "h")
+        for i in range(3):
+            w.append({"counters": {"c": i}})
+        w.close()
+        d = str(tmp_path / "h")
+        seg = sorted(os.listdir(d))[-1]
+        with open(os.path.join(d, seg), "ab") as f:
+            f.write(b'{"record": "sample", "seq": 99, "t"')
+        samples = [s for r in history.runs(d) for s in r["samples"]]
+        assert [s["counters"]["c"] for s in samples] == [0, 1, 2]
+
+    def test_respawn_continues_numbering_and_splits_runs(self, tmp_path):
+        d = str(tmp_path / "h")
+        w1 = self._writer(tmp_path / "h")
+        w1.append({"counters": {"done": 100}})
+        w1.close()
+        first = set(os.listdir(d))
+        w2 = self._writer(tmp_path / "h")
+        w2.append({"counters": {"done": 5}})
+        w2.close()
+        assert first < set(os.listdir(d))  # a NEW segment, never reuse
+        # Same test process = same pid, so the reader welds the runs (the
+        # clock IS comparable); a real respawn changes pid and splits.
+        recs = history.read_records(d)
+        headers = [r for r in recs if r["record"] == "header"]
+        assert len(headers) == 2
+        # Fake the respawn by rewriting the second header's pid.
+        seg = sorted(n for n in os.listdir(d))[-1]
+        path = os.path.join(d, seg)
+        lines = open(path, "rb").read().splitlines()
+        h = json.loads(lines[0])
+        h["pid"] = h["pid"] + 1
+        lines[0] = json.dumps(h).encode()
+        open(path, "wb").write(b"\n".join(lines) + b"\n")
+        rs = history.runs(d)
+        assert len(rs) == 2
+
+    def test_window_rate_sums_per_run_deltas(self, tmp_path):
+        d = str(tmp_path / "h")
+        os.makedirs(d)
+
+        def seg(index, pid, points):
+            lines = [json.dumps({"record": "header", "pid": pid,
+                                 "source": "t", "anchor_perf_s": 0.0,
+                                 "anchor_unix_ns": 1})]
+            for i, (t, v) in enumerate(points):
+                lines.append(json.dumps({
+                    "record": "sample", "seq": i + 1, "t": t,
+                    "counters": {"jobs_completed_total": v},
+                }))
+            with open(os.path.join(d, f"seg-{index:08d}.jsonl"), "w") as f:
+                f.write("\n".join(lines) + "\n")
+
+        seg(0, 100, [(0.0, 0.0), (10.0, 100.0)])
+        seg(1, 200, [(3.0, 0.0), (8.0, 50.0)])  # respawned at zero
+        rate, seconds = history.window_rate(d, "jobs_completed_total")
+        assert seconds == pytest.approx(15.0)
+        assert rate == pytest.approx(150.0 / 15.0)
+        assert history.window_rate(d, "missing_counter") is None
+
+    def test_report_renders(self, tmp_path):
+        w = self._writer(tmp_path / "h")
+        for i in range(5):
+            w.append({"counters": {"jobs_completed_total": i * 10},
+                      "gauges": {"queue_depth": i},
+                      "histograms": {"lat": {"count": i, "sum": i,
+                                             "p99": 0.1 * i}}})
+        w.close()
+        text = history.render_report(str(tmp_path / "h"))
+        assert "jobs_completed_total" in text
+        assert "queue_depth" in text
+        assert "lat" in text
+        assert "whole-window rates" in text
+        empty = history.render_report(str(tmp_path))  # no segments here
+        assert "no history records" in empty
+
+    def test_sampler_feeds_history(self, tmp_path):
+        from gol_tpu.serve.metrics import Metrics
+
+        metrics = Metrics()
+        metrics.inc("jobs_completed_total", 3)
+        w = self._writer(tmp_path / "h")
+        s = obs_sampler.ServeSampler(metrics, history=w)
+        s.tick()
+        metrics.inc("jobs_completed_total", 2)
+        s.tick()
+        w.close()
+        samples = [smp for r in history.runs(str(tmp_path / "h"))
+                   for smp in r["samples"]]
+        assert [smp["counters"]["jobs_completed_total"]
+                for smp in samples] == [3, 5]
+
+    def test_server_defaults_history_off(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        sample_interval=0)
+        srv.start()
+        try:
+            assert srv.history is None
+            assert srv.sampler.history is None
+        finally:
+            srv.shutdown()
+
+
+class TestRouterHistory:
+    def test_merged_history_is_monotonic_across_worker_reset(self, tmp_path):
+        """The acceptance pin: the DURABLE record of a cumulative series
+        never dips through a worker respawn, because the router's history
+        tick rides the same MonotonicCounters floors the live merge does."""
+        snapshots = {"value": 100.0}
+
+        def stub_http(method, url, body=None, raw=None, timeout=0,
+                      headers=None):
+            return 200, {"counters":
+                         {"jobs_completed_total": snapshots["value"]},
+                         "gauges": {}, "histograms": {}}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://wa.invalid", "wa")
+        router = RouterServer(fleet, port=0, http=stub_http)
+        router.start()
+        try:
+            hdir = str(tmp_path / "router-history")
+            router.start_history(hdir, interval=3600)
+            router.history_tick()
+            snapshots["value"] = 7.0  # the worker respawned: reset to ~0
+            router.history_tick()
+            snapshots["value"] = 20.0
+            router.history_tick()
+            series = history.counter_series(hdir, "jobs_completed_total")
+            values = [v for run in series for _, v in run]
+            assert values == sorted(values), values
+            assert values[-1] == pytest.approx(120.0)  # 100 banked + 20
+            gauges = [s["gauges"] for r in history.runs(hdir)
+                      for s in r["samples"]]
+            assert all(g["fleet_workers"] == 1 for g in gauges)
+        finally:
+            router.shutdown(cascade=False)
+
+    def test_history_off_by_default(self, tmp_path):
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        router = RouterServer(fleet, port=0)
+        try:
+            assert router._history is None
+            router.history_tick()  # a no-op, never raises
+        finally:
+            router.httpd.server_close()
+
+
+class TestBenchDiffHistory:
+    @staticmethod
+    def _write(d, rate_points):
+        os.makedirs(d, exist_ok=True)
+        lines = [json.dumps({"record": "header", "pid": 1, "source": "t",
+                             "anchor_perf_s": 0.0, "anchor_unix_ns": 1})]
+        for i, (t, v) in enumerate(rate_points):
+            lines.append(json.dumps({
+                "record": "sample", "seq": i + 1, "t": t,
+                "counters": {"jobs_completed_total": v},
+            }))
+        with open(os.path.join(d, "seg-00000000.jsonl"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def test_regression_window_exits_nonzero(self, tmp_path, capsys):
+        old = str(tmp_path / "old")
+        new = str(tmp_path / "new")
+        self._write(old, [(0.0, 0.0), (10.0, 1000.0)])  # 100/s
+        self._write(new, [(0.0, 0.0), (10.0, 500.0)])  # 50/s: regressed
+        assert bench_diff.main(["--history", old, new]) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_within_tolerance_exits_zero(self, tmp_path):
+        old = str(tmp_path / "old")
+        new = str(tmp_path / "new")
+        self._write(old, [(0.0, 0.0), (10.0, 1000.0)])
+        self._write(new, [(0.0, 0.0), (10.0, 950.0)])  # -5% < 10%
+        assert bench_diff.main(["--history", old, new]) == 0
+
+    def test_missing_counter_is_a_shape_error(self, tmp_path):
+        old = str(tmp_path / "old")
+        new = str(tmp_path / "new")
+        self._write(old, [(0.0, 0.0), (10.0, 1000.0)])
+        self._write(new, [(0.0, 0.0), (10.0, 900.0)])
+        assert bench_diff.main(
+            ["--history", old, new, "--metric", "never_seen_total"]
+        ) == 2
+
+    def test_not_a_directory_is_a_shape_error(self, tmp_path):
+        new = str(tmp_path / "new")
+        self._write(new, [(0.0, 0.0), (10.0, 900.0)])
+        assert bench_diff.main(
+            ["--history", str(tmp_path / "missing"), new]
+        ) == 2
+
+
+class TestCliValidation:
+    """History-flag combinations that would otherwise fail AFTER boot (a
+    silently-empty ring, a fleet of boot-crashing workers) must be the
+    CLI's `gol: <error>` rc-1 contract, rejected before anything spawns."""
+
+    def _run(self, argv, capsys):
+        from gol_tpu import cli
+
+        rc = cli.main(argv)
+        return rc, capsys.readouterr().err
+
+    def test_serve_history_needs_the_sampler(self, tmp_path, capsys):
+        rc, err = self._run([
+            "serve", "--journal-dir", str(tmp_path / "j"),
+            "--metrics-history", "--sample-interval", "0",
+        ], capsys)
+        assert rc == 1 and "gol:" in err and "--sample-interval" in err
+
+    def test_serve_bare_history_needs_a_journal(self, tmp_path, capsys):
+        rc, err = self._run(["serve", "--metrics-history"], capsys)
+        assert rc == 1 and "gol:" in err and "--journal-dir" in err
+
+    def test_fleet_rejects_history_flags_before_spawning(self, tmp_path,
+                                                         capsys):
+        rc, err = self._run([
+            "fleet", "--workers", "1",
+            "--fleet-dir", str(tmp_path / "fleet"),
+            "--metrics-history", "--history-bytes", "2048",
+        ], capsys)
+        assert rc == 1 and "gol:" in err and "--history-bytes" in err
+        # Nothing spawned: the fleet dir holds no worker partition/log.
+        assert not any((tmp_path / "fleet").glob("w*")), \
+            list((tmp_path / "fleet").glob("*"))
+        rc, err = self._run([
+            "fleet", "--workers", "1",
+            "--fleet-dir", str(tmp_path / "fleet2"),
+            "--metrics-history", "--sample-interval", "0",
+        ], capsys)
+        assert rc == 1 and "gol:" in err and "--sample-interval" in err
+        assert not any((tmp_path / "fleet2").glob("w*"))

@@ -37,6 +37,22 @@ def test_no_raw_stderr_write_in_library_code():
     )
 
 
+def test_no_raw_stderr_write_in_parallel():
+    """The gol_tpu/parallel/ pin of the rule above (ADVICE r5:
+    ``choose_mesh_shape``'s width-cap fallback once wrote its warning via
+    raw ``sys.stderr.write`` from library code — it now rides
+    ``warnings.warn(RuntimeWarning)`` so embedders can filter and repeated
+    ``make_mesh`` calls dedupe per call site; tests/test_engine.py pins the
+    category). The whole-tree test already covers this subtree; this one
+    exists so a future split of the library root cannot silently drop the
+    mesh-layer coverage the finding was about."""
+    offenders = _offenders(_LIBRARY_ROOT / "parallel", _FORBIDDEN)
+    assert not offenders, (
+        f"raw {_FORBIDDEN} in gol_tpu/parallel/ (choose_mesh_shape's "
+        f"fallback warning must ride warnings.warn/logging): {offenders}"
+    )
+
+
 def test_no_wall_clock_in_serve_latency_paths():
     """``time.time()`` is banned in gol_tpu/serve/: every latency sample and
     dispatch-age decision there must come from ``time.perf_counter()``. The
@@ -60,10 +76,13 @@ def test_no_wall_clock_in_obs():
     (obs/slo.py, obs/sampler.py): a stepped clock there would fire — or
     suppress — a burn-rate page, and with ``--slo-shed`` turn a clock
     adjustment into load shedding.
-    The ONE sanctioned wall-clock read is the tracer's per-process alignment
-    anchor, taken via ``time.time_ns()`` at ``trace.enable()`` — outside
-    this needle set on purpose, exported as metadata, and never part of any
-    duration or timestamp arithmetic (gol_tpu/obs/trace.py documents it)."""
+    The sanctioned wall-clock reads are the per-process alignment anchors,
+    taken via ``time.time_ns()`` — at ``trace.enable()`` (the tracer's) and
+    per segment header in ``history.HistoryWriter`` (the metrics ring's) —
+    outside this needle set on purpose, exported as metadata, and never
+    part of any duration, rate, or timestamp arithmetic (gol_tpu/obs/
+    trace.py and history.py document them; fleettrace.py consumes them
+    only to align axes ACROSS processes, never within one)."""
     for needle in ("time.time(", "datetime.now"):
         offenders = _offenders(_LIBRARY_ROOT / "obs", needle)
         assert not offenders, (

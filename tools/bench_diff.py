@@ -17,6 +17,16 @@ command with an exit code, so CI (or `make bench-diff`) can gate on it:
   moved more than the tolerance are listed as drift (no exit-code verdict —
   nested fields mix directions and units; the headline is the contract).
 
+With ``--history``, OLD and NEW are **metrics-history directories**
+(``gol serve/fleet --metrics-history``, gol_tpu/obs/history.py) instead of
+artifacts: the gated value is the whole-window rate of a cumulative
+counter (``--metric``, default ``jobs_completed_total``) computed per
+writer run and summed — respawn boundaries contribute their own deltas,
+never a bogus negative one. An incident window gates against a baseline
+window exactly like one bench run gates against another:
+
+    python tools/bench_diff.py --history baseline/history incident/history
+
 Exit codes: 0 within tolerance, 1 headline regression, 2 usage/shape error.
 """
 
@@ -24,7 +34,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Substrings marking a lower-is-better headline (times); everything else is
 # treated as higher-is-better (rates, ratios, counts of useful work).
@@ -140,10 +153,50 @@ def compare(old: dict, new: dict, tolerance: float, metric: str | None = None):
     return lines, regressed
 
 
+def compare_history(old_dir: str, new_dir: str, tolerance: float,
+                    metric: str | None):
+    """(report lines, regressed?) for two metrics-history windows.
+
+    The gated value is ``obs.history.window_rate`` of ``metric`` (a
+    cumulative counter; default jobs_completed_total) over each retained
+    window. Direction is inferred from the metric name exactly like the
+    artifact lane (a latency-named counter would gate lower-better)."""
+    from gol_tpu.obs import history
+
+    name = metric or "jobs_completed_total"
+    rates = {}
+    for label, directory in (("OLD", old_dir), ("NEW", new_dir)):
+        if not os.path.isdir(directory):
+            raise ValueError(f"{label} {directory!r} is not a history "
+                             "directory")
+        wr = history.window_rate(directory, name)
+        if wr is None:
+            raise ValueError(
+                f"{label} history {directory!r} holds no measurable window "
+                f"of counter {name!r} (needs >= 2 samples carrying it)"
+            )
+        rates[label] = wr
+    (v_old, s_old), (v_new, s_new) = rates["OLD"], rates["NEW"]
+    lower = lower_is_better(name, "")
+    rel = (v_new - v_old) / abs(v_old) if v_old else 0.0
+    bad = rel > tolerance if lower else rel < -tolerance
+    better = rel < -tolerance if lower else rel > tolerance
+    verdict = ("REGRESSION" if bad
+               else "improvement" if better else "within tolerance")
+    lines = [
+        f"history window rate of {name} ({'lower' if lower else 'higher'} "
+        f"is better): {v_old:g}/s (over {s_old:.1f}s) -> {v_new:g}/s "
+        f"(over {s_new:.1f}s) ({rel:+.1%}) — {verdict}",
+    ]
+    return lines, bad
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("old", help="baseline BENCH_*.json")
-    parser.add_argument("new", help="candidate BENCH_*.json")
+    parser.add_argument("old", help="baseline BENCH_*.json "
+                        "(or, with --history, a metrics-history dir)")
+    parser.add_argument("new", help="candidate BENCH_*.json "
+                        "(or, with --history, a metrics-history dir)")
     parser.add_argument(
         "--tolerance", type=float, default=0.10,
         help="relative noise threshold (default 0.10 = 10%%)",
@@ -155,11 +208,31 @@ def main(argv=None) -> int:
         "direction is inferred from the path (seconds/latency = lower is "
         "better)",
     )
+    parser.add_argument(
+        "--history", action="store_true",
+        help="OLD/NEW are metrics-history directories "
+        "(--metrics-history rings); gate the whole-window rate of the "
+        "--metric counter (default jobs_completed_total) instead of a "
+        "bench artifact headline",
+    )
     args = parser.parse_args(argv)
     if args.tolerance < 0:
         print(f"bench-diff: tolerance must be >= 0, got {args.tolerance}",
               file=sys.stderr)
         return 2
+    if args.history:
+        try:
+            lines, regressed = compare_history(
+                args.old, args.new, args.tolerance, args.metric
+            )
+        except ValueError as err:
+            print(f"bench-diff: {err}", file=sys.stderr)
+            return 2
+        print(f"bench-diff (history): {args.old} -> {args.new} "
+              f"(tolerance {args.tolerance:.0%})")
+        for line in lines:
+            print(line)
+        return 1 if regressed else 0
     docs = []
     for path in (args.old, args.new):
         try:

@@ -1,0 +1,319 @@
+"""Fleet-tracing + metrics-history smoke: one stitched timeline, one
+durable record, through a real worker kill.
+
+The `make fleettrace-smoke` harness, exercising the ISSUE 10 acceptance
+end-to-end against real OS processes:
+
+1. boot ``gol fleet --workers 2`` with ``--trace`` (router + every worker
+   armed, X-Gol-Trace stamped on forwards), ``--result-cache`` and
+   ``--metrics-history`` (per-partition worker rings + the router's
+   merged, respawn-floored ring);
+2. submit a Zipf-shaped load — a few unique boards across two bucket
+   shapes, the head board submitted over and over — so the cache tier
+   serves real hits while the engine lanes stay busy;
+3. SIGKILL one worker mid-load (forcing at least one spillover-routed
+   submit while it is down) and keep submitting; the health loop respawns
+   it on its partition;
+4. wait for every accepted job to be DONE through the router, then run
+   ``gol fleet-trace``: the output must be ONE valid Chrome/Perfetto JSON
+   containing the router and BOTH live workers (>= 2 distinct worker
+   pids) and at least one cross-process flow chain (one flow id with
+   points in the router pid AND a worker pid);
+5. ``gol history-report`` must render the router's ring, and the merged
+   ``jobs_completed_total`` series in it must be MONOTONIC across the
+   kill/respawn window (the PR-8 floors, made durable).
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/fleettrace_smoke.py [--jobs 40] [--gen-limit 150]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gol_tpu.io import text_grid  # noqa: E402
+from gol_tpu.obs import history  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _start_fleet(port: int, fleet_dir: str, trace_dir: str):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "fleet",
+            "--port", str(port),
+            "--workers", "2",
+            "--fleet-dir", fleet_dir,
+            "--trace", trace_dir,
+            "--metrics-history",
+            "--result-cache",
+            "--flush-age", "0.05",
+            "--max-batch", "8",
+            "--health-interval", "0.5",
+            "--sample-interval", "0.25",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def _await_healthy(base: str, timeout: float = 240.0) -> None:
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        try:
+            status, payload = _http("GET", f"{base}/healthz", timeout=3)
+            if status == 200 and payload.get("ok"):
+                return
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(0.25)
+    raise RuntimeError("fleet router never became healthy")
+
+
+def _submit(base, board, gen_limit, attempts=40):
+    body = {
+        "width": board.shape[1], "height": board.shape[0],
+        "cells": text_grid.encode(board).decode("ascii"),
+        "gen_limit": gen_limit,
+    }
+    last = None
+    for _ in range(attempts):
+        try:
+            status, payload = _http("POST", f"{base}/jobs", body, timeout=60)
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            last = f"{type(e).__name__}: {e}"
+            time.sleep(0.25)
+            continue
+        if status == 202:
+            return payload["id"]
+        last = f"HTTP {status}: {payload}"
+        time.sleep(0.25)  # 429/503/504 during the kill window: retry
+    raise RuntimeError(f"submit never accepted: {last}")
+
+
+def _cli(args, timeout=120):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=40)
+    parser.add_argument("--gen-limit", type=int, default=150)
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-fleettrace-smoke-")
+    fleet_dir = os.path.join(workdir, "fleet")
+    trace_dir = os.path.join(workdir, "trace")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    proc = _start_fleet(port, fleet_dir, trace_dir)
+    rc = 1
+    try:
+        _await_healthy(base)
+        print(f"fleettrace-smoke: fleet up at {base}", flush=True)
+
+        # The Zipf-ish load: 6 unique boards over two bucket shapes; the
+        # head board repeats (cache hits once --result-cache has it).
+        uniques = [text_grid.generate(32 if i % 2 == 0 else 30,
+                                      32 if i % 2 == 0 else 30,
+                                      seed=7000 + i)
+                   for i in range(6)]
+        order = [uniques[0], uniques[1], uniques[2], uniques[0],
+                 uniques[3], uniques[0], uniques[4], uniques[1],
+                 uniques[0], uniques[5]]
+        ids = []
+        kill_at = args.jobs // 2
+        victim = None
+        for i in range(args.jobs):
+            ids.append(_submit(base, order[i % len(order)], args.gen_limit))
+            if i + 1 == kill_at:
+                # SIGKILL the busiest worker mid-load: submits that rank
+                # it first must spill to the survivor until the health
+                # loop respawns the partition.
+                _, membership = _http("GET", f"{base}/fleet")
+                workers = membership["workers"]
+                victim = workers[0]
+                print(f"fleettrace-smoke: SIGKILL worker {victim['id']} "
+                      f"(pid {victim['pid']})", flush=True)
+                os.kill(victim["pid"], signal.SIGKILL)
+
+        deadline = time.perf_counter() + 300
+        pending = set(ids)
+        while pending and time.perf_counter() < deadline:
+            for job_id in list(pending):
+                try:
+                    status, payload = _http("GET", f"{base}/jobs/{job_id}",
+                                            timeout=10)
+                except (urllib.error.URLError, ConnectionError, OSError):
+                    break
+                if status == 200 and payload.get("state") == "done":
+                    pending.discard(job_id)
+                elif status == 200 and payload.get("state") in (
+                    "failed", "cancelled"
+                ):
+                    print(f"fleettrace-smoke: FAIL job {job_id} ended "
+                          f"{payload['state']}")
+                    return 1
+            time.sleep(0.2)
+        if pending:
+            print(f"fleettrace-smoke: FAIL {len(pending)} job(s) never "
+                  "finished")
+            return 1
+        print(f"fleettrace-smoke: all {len(ids)} jobs DONE through the "
+              "kill/respawn", flush=True)
+
+        # Respawn must have landed (same partition, new pid) before the
+        # stitch expects two live workers.
+        deadline = time.perf_counter() + 120
+        while time.perf_counter() < deadline:
+            _, membership = _http("GET", f"{base}/fleet")
+            live = [w for w in membership["workers"]
+                    if w.get("healthy") and w.get("url")]
+            if len(live) >= 2:
+                break
+            time.sleep(0.25)
+        else:
+            print("fleettrace-smoke: FAIL respawn never became healthy")
+            return 1
+
+        # --- gol fleet-trace: ONE valid Perfetto JSON -------------------
+        out_path = os.path.join(workdir, "fleet-trace.json")
+        result = _cli(["fleet-trace", "--server", base, "-o", out_path])
+        if result.returncode != 0:
+            print("fleettrace-smoke: FAIL gol fleet-trace rc="
+                  f"{result.returncode}\n{result.stdout}\n{result.stderr}")
+            return 1
+        with open(out_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        procs = doc["otherData"]["processes"]
+        router_pids = {info["pid"] for name, info in procs.items()
+                       if name == "router"}
+        worker_pids = {info["pid"] for name, info in procs.items()
+                       if name != "router"}
+        if not router_pids or len(worker_pids) < 2:
+            print(f"fleettrace-smoke: FAIL process table {procs}")
+            return 1
+        real_worker_pids = {info["real_pid"] for name, info in procs.items()
+                           if name != "router"}
+        if len(real_worker_pids) < 2:
+            print(f"fleettrace-smoke: FAIL wanted >= 2 worker pids, got "
+                  f"{real_worker_pids}")
+            return 1
+        flows = {}
+        for e in events:
+            if e.get("ph") in ("s", "t", "f"):
+                flows.setdefault(e["id"], set()).add(e["pid"])
+        chains = [fid for fid, pids in flows.items()
+                  if pids & router_pids and pids & worker_pids]
+        if not chains:
+            print(f"fleettrace-smoke: FAIL no cross-process flow chain "
+                  f"(flows: {dict(list(flows.items())[:5])})")
+            return 1
+        spans = [e for e in events if e.get("ph") == "X"]
+        if not spans:
+            print("fleettrace-smoke: FAIL stitched trace has no spans")
+            return 1
+        print(f"fleettrace-smoke: stitched {len(procs)} processes, "
+              f"{len(spans)} spans, {len(chains)} cross-process flow "
+              f"chain(s)", flush=True)
+
+        # --- durable history: monotonic through the respawn -------------
+        router_history = os.path.join(fleet_dir, "router-history")
+        series = history.counter_series(router_history,
+                                        "jobs_completed_total")
+        values = [v for run in series for _, v in run]
+        if len(values) < 3:
+            print(f"fleettrace-smoke: FAIL router history too thin "
+                  f"({len(values)} samples)")
+            return 1
+        if values != sorted(values):
+            print("fleettrace-smoke: FAIL merged jobs_completed_total "
+                  f"dipped across the respawn: {values}")
+            return 1
+        if values[-1] < len(ids):
+            print(f"fleettrace-smoke: FAIL merged total {values[-1]} < "
+                  f"{len(ids)} accepted jobs")
+            return 1
+        result = _cli(["history-report", router_history])
+        if result.returncode != 0 or "jobs_completed_total" not in result.stdout:
+            print("fleettrace-smoke: FAIL gol history-report rc="
+                  f"{result.returncode}\n{result.stdout}\n{result.stderr}")
+            return 1
+        # Worker partitions wrote their own rings too.
+        worker_rings = [d for d in (os.path.join(fleet_dir, w, "history")
+                                    for w in ("w0", "w1"))
+                        if os.path.isdir(d) and history.runs(d)]
+        if not worker_rings:
+            print("fleettrace-smoke: FAIL no worker partition history ring")
+            return 1
+        print(f"fleettrace-smoke: history monotonic over {len(values)} "
+              f"samples (final total {values[-1]}); "
+              f"{len(worker_rings)} worker ring(s)", flush=True)
+        rc = 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+            if rc == 0:
+                print("fleettrace-smoke: FAIL fleet ignored SIGTERM")
+                rc = 1
+        if rc == 0 and proc.returncode != 0:
+            out = proc.stdout.read().decode("utf-8", "replace")[-3000:]
+            print(f"fleettrace-smoke: FAIL fleet exited rc="
+                  f"{proc.returncode}\n{out}")
+            rc = 1
+        shutil.rmtree(workdir, ignore_errors=True)
+    if rc == 0:
+        print("fleettrace-smoke: PASS — one stitched Perfetto timeline "
+              "(router + 2 workers, cross-process flows) and a monotonic "
+              "durable history through a worker SIGKILL/respawn")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
