@@ -26,10 +26,15 @@ bench:
 # bad direction beyond TOLERANCE (default 10%), so it is CI-able. METRIC
 # gates on a flattened nested leaf instead of the headline — the cache
 # suite's CI gate rides the warm-hit jobs/sec leaf so hit-path regressions
-# fail even when the cold lane moves too:
+# fail even when the cold lane moves too, and the wire suite's bytes-on-wire
+# headline (text/packed round-trip byte ratio at 2048^2, higher is better —
+# a format regression shows up as the ratio collapsing toward 1) gates via
+# its nested leaf likewise:
 #   make bench-diff OLD=BENCH_r08.json NEW=/tmp/BENCH_r08.json [TOLERANCE=0.1]
 #   make bench-diff OLD=BENCH_r11.json NEW=/tmp/BENCH_r11.json \
 #       METRIC=lanes.warm.jobs_per_sec
+#   make bench-diff OLD=BENCH_r13.json NEW=/tmp/BENCH_r13.json \
+#       METRIC=sizes.b2048.bytes.ratio_roundtrip
 bench-diff:
 	@test -n "$(OLD)" && test -n "$(NEW)" || \
 		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1] [METRIC=dot.path]"; exit 2; }

@@ -1656,6 +1656,252 @@ def _bench_fleettrace(args) -> int:
     return 0 if ratio >= 0.97 else 1
 
 
+def _bench_wire(args) -> int:
+    """Binary data plane suite (--suite wire) -> BENCH_r13.json.
+
+    ISSUE 11's acceptance: bytes-on-wire per hop, submit->accepted
+    latency, and router forward latency for text vs packed
+    (application/x-gol-packed, io/wire.py) on 1024^2..4096^2 boards
+    through a REAL 2-worker fleet (in-process workers behind an in-process
+    router — the same rig the fleet tests drive, so every hop is the
+    production code path: content negotiation at the worker, header-only
+    placement + zero-copy forward at the router, packed CAS payloads).
+
+    Measured per board size:
+
+    - **bytes per hop**: client->router submit body (== router->worker:
+      the raw buffer is forwarded verbatim, asserted), worker CAS entry
+      on disk (meta + sidecar), worker->client result body. The headline
+      is the 2048^2 round-trip ratio (text bytes / packed bytes), gated
+      at >= 6x.
+    - **submit->accepted latency**: POST /jobs RTT through the router,
+      p50 per format lane (identical board seeds across lanes, so both
+      formats move the same cell content; each lane gets a fresh rig so
+      retained-job memory stays bounded).
+    - **router forward latency**: through-router p50 minus direct-to-
+      worker p50 — the router's own share, which for text includes
+      JSON-parsing the multi-MB body and for packed reads ~24 bytes +
+      meta. Gated: packed forward < text forward at 2048^2.
+
+    Byte-identity is gated, not assumed: the same board submitted text
+    and packed must fetch bit-identical grids through BOTH result
+    formats (rc 1 otherwise, like every other gate).
+    """
+    import tempfile
+
+    from gol_tpu.cache.store import CacheEntry, DiskCAS
+    from gol_tpu.fleet import client as fleet_client
+    from gol_tpu.fleet.router import RouterServer
+    from gol_tpu.fleet.workers import Fleet
+    from gol_tpu.io import text_grid, wire
+    from gol_tpu.serve.server import GolServer
+
+    if args.gen_limit is None:
+        args.gen_limit = 1  # the data plane is the subject, not the engine
+    sizes = (1024, 2048, 4096)
+    iters = {1024: 9, 2048: 9, 4096: 3}
+
+    tmp = tempfile.mkdtemp(prefix="gol_bench_wire_")
+    rig_seq = [0]
+
+    class _Rig:
+        """One disposable 2-worker fleet. The single-process server keeps
+        every job's board and result in memory for its life, so each
+        measurement lane gets a FRESH rig and tears it down — peak RSS
+        stays one lane's jobs, not the whole suite's (the compiled bucket
+        programs are lru-cached module-wide, so rig churn pays no
+        recompiles). No journal: journaling is format-independent text
+        either way and only adds fsync noise to the RTTs under test."""
+
+        def __init__(self):
+            rig_seq[0] += 1
+            self.workers = {}
+            for wid in ("w0", "w1"):
+                srv = GolServer(port=0, flush_age=0.01)
+                srv.start()
+                self.workers[wid] = srv
+            self.fleet = Fleet(
+                os.path.join(tmp, f"fleet{rig_seq[0]}")
+            )
+            for wid, srv in self.workers.items():
+                self.fleet.attach(srv.url, wid)
+            self.router = RouterServer(self.fleet, port=0, big_edge=8192)
+            self.router.start()
+
+        def close(self):
+            self.router.shutdown(cascade=False)
+            for srv in self.workers.values():
+                srv.shutdown()
+
+    def submit_text(base, board, seed_tag):
+        body = {
+            "width": board.shape[1], "height": board.shape[0],
+            "cells": text_grid.encode(board).decode("ascii"),
+            "gen_limit": args.gen_limit,
+        }
+        raw = json.dumps(body).encode("utf-8")
+        t0 = time.perf_counter()
+        status, _, resp = fleet_client.http_exchange(
+            "POST", base + "/jobs", raw=raw, timeout=300)
+        dt = time.perf_counter() - t0
+        assert status == 202, (status, resp[:200])
+        return json.loads(resp)["id"], len(raw), dt
+
+    def submit_packed(base, board, seed_tag):
+        raw = wire.encode_frame({"gen_limit": args.gen_limit}, grid=board)
+        t0 = time.perf_counter()
+        status, _, resp = fleet_client.http_exchange(
+            "POST", base + "/jobs", raw=raw,
+            content_type=wire.CONTENT_TYPE, timeout=300)
+        dt = time.perf_counter() - t0
+        assert status == 202, (status, resp[:200])
+        return json.loads(resp)["id"], len(raw), dt
+
+    def fetch(base, job_id, packed):
+        deadline = time.perf_counter() + 300
+        while time.perf_counter() < deadline:
+            status, body = fleet_client.http_json(
+                "GET", f"{base}/jobs/{job_id}", timeout=30)
+            if status == 200 and body.get("state") == "done":
+                break
+            time.sleep(0.01)
+        headers = {"Accept": wire.CONTENT_TYPE} if packed else None
+        status, ctype, resp = fleet_client.http_exchange(
+            "GET", f"{base}/result/{job_id}", timeout=30, headers=headers)
+        assert status == 200, (status, resp[:200])
+        if packed:
+            assert wire.is_packed(ctype), ctype
+            frame = wire.decode_frame(resp)
+            return frame.grid(), len(resp)
+        payload = json.loads(resp)
+        grid = text_grid.decode(payload["grid"].encode("ascii"),
+                                payload["width"], payload["height"])
+        return np.asarray(grid), len(resp)
+
+    def p50(vals):
+        return sorted(vals)[len(vals) // 2]
+
+    out_sizes = {}
+    identity_ok = True
+    identity_checked = 0
+    for size in sizes:
+        n = iters[size]
+        lat = {"router_text": [], "router_packed": [],
+               "direct_text": [], "direct_packed": []}
+        bytes_rec = {}
+        # One fresh rig per format lane (memory-bounded; same board seeds
+        # across lanes, so both formats move the same cell content).
+        for fmt, fn in (("text", submit_text), ("packed", submit_packed)):
+            rig = _Rig()
+            direct = rig.workers["w0"].url
+            for i in range(n):
+                board = text_grid.generate(size, size, seed=7000 + i)
+                _, nbytes, dt = fn(rig.router.url, board, i)
+                lat[f"router_{fmt}"].append(dt)
+                bytes_rec[f"submit_{fmt}"] = nbytes
+                _, _, dt = fn(direct, board, i)
+                lat[f"direct_{fmt}"].append(dt)
+            rig.close()
+        # Byte-identity: ONE board, both formats, both result encodings.
+        rig = _Rig()
+        board = text_grid.generate(size, size, seed=99)
+        jid_t, _, _ = submit_text(rig.router.url, board, "id")
+        jid_p, _, _ = submit_packed(rig.router.url, board, "id")
+        grid_tt, result_text_bytes = fetch(rig.router.url, jid_t, packed=False)
+        grid_tp, result_packed_bytes = fetch(rig.router.url, jid_t, packed=True)
+        grid_pt, _ = fetch(rig.router.url, jid_p, packed=False)
+        grid_pp, _ = fetch(rig.router.url, jid_p, packed=True)
+        rig.close()
+        same = (np.array_equal(grid_tt, grid_tp)
+                and np.array_equal(grid_tt, grid_pt)
+                and np.array_equal(grid_tt, grid_pp))
+        identity_ok = identity_ok and same
+        identity_checked += 1
+        bytes_rec["result_text"] = result_text_bytes
+        bytes_rec["result_packed"] = result_packed_bytes
+        # CAS bytes: the stored form of that result under each payload.
+        entry = CacheEntry(grid=grid_tt, generations=args.gen_limit,
+                           exit_reason="gen_limit")
+        cas_bytes = {}
+        for payload_kind in ("text", "packed"):
+            cas_dir = os.path.join(tmp, f"cas_{payload_kind}_{size}")
+            cas = DiskCAS(cas_dir, payload=payload_kind)
+            cas.put("f" * 24, entry)
+            total = 0
+            for root, _dirs, files in os.walk(cas_dir):
+                total += sum(os.path.getsize(os.path.join(root, f))
+                             for f in files)
+            cas_bytes[payload_kind] = total
+        bytes_rec["cas_text"] = cas_bytes["text"]
+        bytes_rec["cas_packed"] = cas_bytes["packed"]
+        text_rt = bytes_rec["submit_text"] + bytes_rec["result_text"]
+        packed_rt = bytes_rec["submit_packed"] + bytes_rec["result_packed"]
+        fwd_text = p50(lat["router_text"]) - p50(lat["direct_text"])
+        fwd_packed = p50(lat["router_packed"]) - p50(lat["direct_packed"])
+        out_sizes[f"b{size}"] = {
+            "bytes": {
+                **bytes_rec,
+                "ratio_submit": bytes_rec["submit_text"]
+                / bytes_rec["submit_packed"],
+                "ratio_result": bytes_rec["result_text"]
+                / bytes_rec["result_packed"],
+                "ratio_cas": bytes_rec["cas_text"] / bytes_rec["cas_packed"],
+                "ratio_roundtrip": text_rt / packed_rt,
+            },
+            "latency": {
+                "submit_text_p50_ms": p50(lat["router_text"]) * 1e3,
+                "submit_packed_p50_ms": p50(lat["router_packed"]) * 1e3,
+                "direct_text_p50_ms": p50(lat["direct_text"]) * 1e3,
+                "direct_packed_p50_ms": p50(lat["direct_packed"]) * 1e3,
+                "forward_text_ms": fwd_text * 1e3,
+                "forward_packed_ms": fwd_packed * 1e3,
+            },
+        }
+        s = out_sizes[f"b{size}"]
+        print(
+            f"  {size}^2: submit {bytes_rec['submit_text']} -> "
+            f"{bytes_rec['submit_packed']} B "
+            f"({s['bytes']['ratio_submit']:.1f}x), roundtrip "
+            f"{s['bytes']['ratio_roundtrip']:.1f}x, forward "
+            f"{s['latency']['forward_text_ms']:.1f} -> "
+            f"{s['latency']['forward_packed_ms']:.1f} ms, "
+            f"identity {'OK' if same else 'MISMATCH'}",
+            file=sys.stderr,
+        )
+
+    head = out_sizes["b2048"]
+    ratio = head["bytes"]["ratio_roundtrip"]
+    fwd_win = (head["latency"]["forward_packed_ms"]
+               < head["latency"]["forward_text_ms"])
+    print(
+        f"  headline: 2048^2 round-trip bytes {ratio:.1f}x smaller packed "
+        f"(acceptance >= 6x), router forward win: {fwd_win}, "
+        f"byte-identity: {identity_ok}",
+        file=sys.stderr,
+    )
+    payload = {
+        "metric": "wire_bytes_reduction_roundtrip_2048",
+        "value": ratio,
+        "unit": "x",
+        "vs_baseline": ratio,  # text bytes over packed bytes; gated >= 6
+        "sizes": out_sizes,
+        "identity": {"checked": identity_checked, "ok": identity_ok},
+        "gates": {
+            "bytes_ratio_min": 6.0,
+            "forward_latency_win": fwd_win,
+        },
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r13.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    print(json.dumps(payload))
+    return 0 if (ratio >= 6.0 and fwd_win and identity_ok) else 1
+
+
 # Named measurement suites, table-driven: adding one is one line here (plus
 # its _bench_* function) — no if/elif chain to grow. Each entry is
 # (runner, one-line help shown by --list-suites). Suites pin their own
@@ -1701,6 +1947,15 @@ SUITES = {
         "telemetry overhead on the megabatch serve load: tracing + SLO "
         "engine + dispatch-gap sampler on vs off (acceptance: on >= 0.97x "
         "off); writes BENCH_r09.json",
+    ),
+    "wire": (
+        _bench_wire,
+        "binary data plane: bytes-on-wire per hop, submit latency, and "
+        "router forward latency for text vs packed wire frames on "
+        "1024^2..4096^2 boards through a real 2-worker fleet (acceptance: "
+        ">= 6x round-trip bytes at 2048^2 + a packed forward-latency win "
+        "+ byte-identical results; CI gates the headline or "
+        "--metric sizes.b2048.bytes.ratio_roundtrip); writes BENCH_r13.json",
     ),
     "fleettrace": (
         _bench_fleettrace,

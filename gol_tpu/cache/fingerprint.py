@@ -119,3 +119,50 @@ def body_fingerprint(body: dict) -> str:
             body.get("similarity_frequency", GameConfig().similarity_frequency)
         ),
     )
+
+
+def packed_body_fingerprint(raw: bytes) -> str:
+    """A routing key from a raw PACKED ``POST /jobs`` body — WITHOUT
+    unpacking the payload.
+
+    The packed lane of ``body_fingerprint``: the router's ``--cache-route``
+    needs a deterministic per-(board, config) label to rank workers by, and
+    the whole point of the packed format is that the router never decodes
+    boards — so the board's contribution is the frame's own payload CRC +
+    byte length (read from the header and the body size; the words are a
+    deterministic function of the cells, so every packed resend of a board
+    keys identically) instead of the cell-level positional digest.
+
+    The key is therefore format-scoped (``v1p-`` prefix): a board submitted
+    packed and the SAME board submitted as text may rank onto different
+    workers — a one-time locality miss, never a correctness issue, since
+    the worker-side cache fingerprints the DECODED board identically for
+    both formats. Raises ``ValueError`` (via ``wire.WireError``) on frames
+    too malformed to key — callers fall back to bucket routing.
+    """
+    from gol_tpu.io import wire
+
+    width, height, meta = wire.peek(raw)
+    if width <= 0 or height <= 0:
+        raise ValueError(f"dimensions must be positive, got {height}x{width}")
+    check = meta.get("check_similarity", True)
+    if not isinstance(check, bool):
+        raise TypeError(
+            f"check_similarity must be a JSON boolean, got "
+            f"{type(check).__name__}"
+        )
+    crc = wire.payload_crc(raw)
+    sim = (
+        f"s{int(meta.get('similarity_frequency', GameConfig().similarity_frequency))}"
+        if check else "nosim"
+    )
+    # The board's contribution is the payload CRC alone: the payload LENGTH
+    # is already pinned by the height/width axes below, and folding the
+    # frame length would smuggle meta-only fields (priority, deadline_s —
+    # QoS, which body_fingerprint pins OUT of the key) into the routing
+    # key, re-routing exactly the repeat traffic --cache-route exists for.
+    return (
+        f"v{SCHEMA_VERSION}p-{crc:08x}-{height}x{width}"
+        f"-{meta.get('convention', Convention.C)}"
+        f"-g{int(meta.get('gen_limit', GameConfig().gen_limit))}-{sim}"
+    )

@@ -14,14 +14,27 @@ safe and recovery is never required.
 
 Payload encodings (the meta JSON is always the commit point):
 
-- ``text`` (default): the grid rides inside the meta file in the tree's
+- ``packed`` (the default): the grid's wire frame (``io/wire.py`` — the
+  packed binary format every serving hop speaks) in a ``.golp`` sidecar
+  beside the meta, committed with the same staging discipline. ~8x
+  smaller than text at any width, and a packed wire hit serves its
+  payload words WITHOUT a decode→re-encode round trip (the sidecar bytes
+  are already the response's word layout). Big-endian hosts fall back to
+  ``text`` loudly, like the ts lane.
+- ``text``: the grid rides inside the meta file in the tree's
   text-grid encoding — the same bytes the journal stores, one file per
-  entry, zero extra dependencies.
+  entry, zero extra dependencies. Always readable regardless of the
+  configured payload (the migration lane: entries written before the
+  packed default, and packed-lane write failures, read back forever).
 - ``ts`` (optional): exact-fit payloads whose width packs (W % 32 == 0)
   write their bitpacked words to a TensorStore zarr beside the meta
-  (``io/ts_store.py``) — 8x smaller than text for big boards. Anything the
-  lane cannot take (unpackable width, TensorStore missing) falls back to
-  ``text`` loudly; on read the CRC gate covers both encodings identically.
+  (``io/ts_store.py``). Anything the lane cannot take (unpackable width,
+  TensorStore missing) falls back to ``text`` loudly.
+
+On read the payload lane is chosen by the ENTRY's meta, not the store's
+configured payload — every encoding reads back on every configuration,
+and the CRC gate covers all of them identically (over the decoded
+answer, so a poisoned payload evicts regardless of how it was stored).
 """
 
 from __future__ import annotations
@@ -46,6 +59,7 @@ logger = logging.getLogger(__name__)
 SCHEMA_VERSION = 1
 _META_SUFFIX = ".json"
 _STORE_SUFFIX = ".zarr"
+_PACKED_SUFFIX = ".golp"
 
 
 @dataclasses.dataclass
@@ -55,6 +69,12 @@ class CacheEntry:
     grid: np.ndarray  # uint8 {0,1}, (height, width)
     generations: int
     exit_reason: str
+    # The grid's packed wire words (io/wire.py row layout) when a lane had
+    # them in hand — a packed engine readback on put, the packed sidecar
+    # on get. Serving a packed wire response from this entry then skips
+    # the re-pack. Never part of the canonical identity below: ``grid``
+    # is the answer, words are a cached encoding of it.
+    words: np.ndarray | None = None
 
     def canonical_bytes(self) -> bytes:
         """The whole decoded answer, canonically: row-major uint8 cell
@@ -115,9 +135,11 @@ class DiskCAS:
     torn/corrupt/mismatched entry (the caller's loud-evict counter).
     """
 
-    def __init__(self, directory: str, payload: str = "text", on_evict=None):
-        if payload not in ("text", "ts"):
-            raise ValueError(f"payload must be 'text' or 'ts', got {payload!r}")
+    def __init__(self, directory: str, payload: str = "packed", on_evict=None):
+        if payload not in ("packed", "text", "ts"):
+            raise ValueError(
+                f"payload must be 'packed', 'text' or 'ts', got {payload!r}"
+            )
         self.directory = directory
         self.payload = payload
         self.on_evict = on_evict
@@ -133,6 +155,9 @@ class DiskCAS:
 
     def store_path(self, fp: str) -> str:
         return os.path.join(self._subdir(fp), fp + _STORE_SUFFIX)
+
+    def packed_path(self, fp: str) -> str:
+        return os.path.join(self._subdir(fp), fp + _PACKED_SUFFIX)
 
     # -- writes -------------------------------------------------------------
 
@@ -152,6 +177,15 @@ class DiskCAS:
         }
         subdir = self._subdir(fp)
         os.makedirs(subdir, exist_ok=True)
+        if self.payload == "packed" and sys.byteorder == "little":
+            try:
+                self._write_packed(fp, entry)
+                meta["payload"] = "packed"
+            except Exception as err:  # noqa: BLE001 - degrade, never fail
+                logger.warning(
+                    "cache CAS: packed payload for %s failed (%s: %s); "
+                    "falling back to text", fp, type(err).__name__, err,
+                )
         if self.payload == "ts" and width % 32 == 0 \
                 and sys.byteorder == "little":
             try:
@@ -181,6 +215,52 @@ class DiskCAS:
             except OSError:
                 pass
             raise
+
+    def _write_packed(self, fp: str, entry: CacheEntry) -> None:
+        """The packed sidecar: one wire frame (io/wire.py), staged +
+        fsynced + renamed like every durable file in the tree. The meta
+        JSON written after it stays the commit point — a crash between
+        the two leaves an invisible orphan sidecar, overwritten by the
+        next idempotent put."""
+        from gol_tpu.io import wire
+
+        height, width = (int(x) for x in entry.grid.shape)
+        if entry.words is not None:
+            frame = wire.encode_frame(
+                {}, words=entry.words, width=width, height=height
+            )
+        else:
+            frame = wire.encode_frame({}, grid=entry.grid)
+        subdir = self._subdir(fp)
+        fd, tmp = tempfile.mkstemp(
+            dir=subdir, prefix=fp + ".", suffix=STAGING_SUFFIX
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(frame)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.packed_path(fp))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _read_packed(self, fp: str, width: int, height: int):
+        """(grid, words) from the packed sidecar; any defect raises (the
+        caller's evict-and-re-run gate)."""
+        from gol_tpu.io import wire
+
+        with open(self.packed_path(fp), "rb") as f:
+            frame = wire.decode_frame(f.read())
+        if (frame.width, frame.height) != (width, height):
+            raise ValueError(
+                f"packed payload geometry {frame.height}x{frame.width} "
+                f"does not match meta {height}x{width}"
+            )
+        return frame.grid(), frame.words
 
     def _write_ts(self, fp: str, entry: CacheEntry, width: int) -> None:
         import jax.numpy as jnp
@@ -214,7 +294,10 @@ class DiskCAS:
                     f"fingerprint mismatch (stored {meta['fingerprint']!r})"
                 )
             width, height = int(meta["width"]), int(meta["height"])
-            if meta["payload"] == "ts":
+            words = None
+            if meta["payload"] == "packed":
+                grid, words = self._read_packed(fp, width, height)
+            elif meta["payload"] == "ts":
                 grid = self._read_ts(fp, width, height)
             else:
                 grid = text_grid.decode(
@@ -226,6 +309,7 @@ class DiskCAS:
                 grid=grid,
                 generations=int(meta["generations"]),
                 exit_reason=str(meta["exit_reason"]),
+                words=words,
             )
             if zlib.crc32(entry.canonical_bytes()) != int(meta["crc"]):
                 raise ValueError("payload CRC mismatch")
@@ -246,7 +330,7 @@ class DiskCAS:
             "cache CAS: evicting corrupt entry %s (%s); the engine re-runs "
             "— a poisoned cache entry can never be served", fp, reason,
         )
-        for path in (self.meta_path(fp),):
+        for path in (self.meta_path(fp), self.packed_path(fp)):
             try:
                 os.unlink(path)
             except OSError:

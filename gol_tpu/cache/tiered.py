@@ -33,7 +33,7 @@ class ResultCache:
         memory_entries: int = 1024,
         cas_dir: str | None = None,
         metrics=None,
-        payload: str = "text",
+        payload: str = "packed",
     ):
         self.memory = MemoryLRU(memory_entries)
         self.metrics = metrics

@@ -1142,14 +1142,28 @@ def _tune(args) -> int:
     return 0
 
 
-def _http_json(method: str, url: str, body: dict | None = None, timeout=30):
+def _http_json(method: str, url: str, body: dict | None = None, timeout=30,
+               raw: bytes | None = None, content_type: str | None = None):
     """The ONE stdlib JSON client (``gol_tpu/fleet/client.py`` — jax-free,
     shared with the router/health loops): HTTP errors come back as
     (status, payload), connection trouble raises for the callers'
-    retry/timeout logic."""
+    retry/timeout logic. ``raw``/``content_type`` send a pre-encoded
+    body (the packed wire submit)."""
     from gol_tpu.fleet import client as fleet_client
 
-    return fleet_client.http_json(method, url, body, timeout=timeout)
+    return fleet_client.http_json(method, url, body, timeout=timeout,
+                                  raw=raw, content_type=content_type)
+
+
+def _http_exchange(method: str, url: str, timeout=30, accept=None):
+    """Byte-level GET for the packed result fetch: (status, content type,
+    body bytes) — the caller parses by the RESPONSE type, so an old
+    server answering JSON degrades transparently."""
+    from gol_tpu.fleet import client as fleet_client
+
+    headers = {"Accept": accept} if accept else None
+    return fleet_client.http_exchange(method, url, timeout=timeout,
+                                      headers=headers)
 
 
 def _submit(args) -> int:
@@ -1186,25 +1200,45 @@ def _submit(args) -> int:
             targets = urls
             print(f"gol submit: sharding {len(args.input_files)} board(s) "
                   f"across {len(urls)} fleet worker(s)", file=sys.stderr)
+    # --wire packed: boards travel as binary wire frames (io/wire.py, ~8x
+    # fewer bytes). Degradation is PER TARGET: a server that answers 415
+    # (or 400 — an old server's JSON parser rejecting the frame) gets ONE
+    # logged retry as text and every later submit to it goes text too.
+    wire_mode = {t: getattr(args, "wire", "text") for t in targets}
     ids = {}  # job id -> (input path, server base the job lives on)
     for i, path in enumerate(args.input_files):
         target = targets[i % len(targets)]
         grid = text_grid.read_grid(path, width, height)
-        body = {
-            "width": width,
-            "height": height,
-            "cells": text_grid.encode(grid).decode("ascii"),
+        meta = {
             "convention": variant.convention,
             "gen_limit": args.gen_limit,
             "priority": args.priority,
         }
         if args.deadline is not None:
-            body["deadline_s"] = args.deadline
+            meta["deadline_s"] = args.deadline
         if args.no_cache:
             # Per-job result-cache opt-out (Job.no_cache); servers without
             # a cache ignore the field after type validation.
-            body["no_cache"] = True
-        status, payload = _http_json("POST", f"{target}/jobs", body)
+            meta["no_cache"] = True
+        if wire_mode[target] == "packed":
+            from gol_tpu.io import wire
+
+            status, payload = _http_json(
+                "POST", f"{target}/jobs",
+                raw=wire.encode_frame(meta, grid=grid),
+                content_type=wire.CONTENT_TYPE,
+            )
+            if status in (400, 415):
+                print(
+                    f"gol submit: {target} does not accept the packed wire "
+                    f"format (HTTP {status}); retrying as text",
+                    file=sys.stderr,
+                )
+                wire_mode[target] = "text"
+        if wire_mode[target] != "packed":
+            body = {"width": width, "height": height,
+                    "cells": text_grid.encode(grid).decode("ascii"), **meta}
+            status, payload = _http_json("POST", f"{target}/jobs", body)
         if status != 202:
             print(f"gol submit: {path}: HTTP {status}: "
                   f"{payload.get('error', payload)}", file=sys.stderr)
@@ -1299,8 +1333,8 @@ def _collect_results(pending: dict, args, outdir) -> int:
                 rc = 1
                 continue
             try:
-                status, result = _http_json(
-                    "GET", f"{job_base}/result/{job_id}"
+                status, result, grid = _fetch_result(
+                    job_base, job_id, getattr(args, "wire", "text")
                 )
             except (urllib.error.URLError, ConnectionError, OSError):
                 pending[job_id] = (path, job_base)  # refetch next sweep
@@ -1318,9 +1352,6 @@ def _collect_results(pending: dict, args, outdir) -> int:
                 if outdir
                 else path + ".out"
             )
-            grid = text_grid.decode(
-                result["grid"].encode("ascii"), result["width"], result["height"]
-            )
             text_grid.write_grid(out_path, grid)
             # The cache marker: present only when the server answered from
             # its result cache (or coalesced the run) — old servers' result
@@ -1332,6 +1363,37 @@ def _collect_results(pending: dict, args, outdir) -> int:
                   f"{result['exit_reason']}\t-> {out_path}{marker}"
                   f"{_submit_latency_note(job_base, job_id)}")
     return rc
+
+
+def _fetch_result(base: str, job_id: str, wire_pref: str):
+    """GET /result/<id> -> (status, result meta dict, grid or None).
+
+    With ``wire_pref == "packed"`` the fetch sends ``Accept:
+    application/x-gol-packed`` and parses by the RESPONSE content type —
+    a new server answers a binary frame (~8x fewer bytes on the wire), an
+    old server ignores the header and answers JSON, byte-identical
+    either way (the decoded grid is the same board; test-pinned)."""
+    if wire_pref == "packed":
+        from gol_tpu.io import wire
+
+        status, ctype, body = _http_exchange(
+            "GET", f"{base}/result/{job_id}", accept=wire.CONTENT_TYPE
+        )
+        if status == 200 and wire.is_packed(ctype):
+            frame = wire.decode_frame(body)
+            return status, dict(frame.meta), frame.grid()
+        try:
+            result = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            result = {"error": body[:200].decode("utf-8", "replace")}
+    else:
+        status, result = _http_json("GET", f"{base}/result/{job_id}")
+    grid = None
+    if status == 200:
+        grid = text_grid.decode(
+            result["grid"].encode("ascii"), result["width"], result["height"]
+        )
+    return status, result, grid
 
 
 def _submit_latency_note(base: str, job_id: str) -> str:
@@ -1811,10 +1873,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="in-process result-cache LRU bound (default 1024 entries)",
     )
     srv.add_argument(
-        "--cache-payload", choices=("text", "ts"), default="text",
-        help="CAS payload encoding: 'text' (default, self-contained) or "
-        "'ts' (TensorStore zarr via io/ts_store.py for exact-fit packed "
-        "payloads, 8x smaller; falls back to text where unavailable)",
+        "--cache-payload", choices=("packed", "text", "ts"), default="packed",
+        help="CAS payload encoding: 'packed' (default — the binary wire "
+        "frame, io/wire.py, ~8x smaller than text at any width; packed "
+        "hits serve without a decode/re-encode round trip), 'text' "
+        "(self-contained meta JSON) or 'ts' (TensorStore zarr via "
+        "io/ts_store.py). Entries of every encoding read back on every "
+        "setting; unavailable lanes fall back to text loudly",
     )
     srv.add_argument(
         "--warm-plans", action="store_true",
@@ -2110,6 +2175,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="opt these submissions out of the server's result cache "
         "(always a fresh engine run); result lines from cache-served "
         "repeats carry a 'cached:<tier>' marker otherwise",
+    )
+    sbm.add_argument(
+        "--wire", choices=("text", "packed"), default="text",
+        help="wire format for boards (io/wire.py): 'packed' submits binary "
+        "frames (~8x fewer bytes than text) and fetches results with "
+        "Accept: application/x-gol-packed. Degrades gracefully against "
+        "old servers: a 415/400 submit answer retries as text (once, "
+        "logged, per target), and JSON result answers parse as always",
     )
     sbm.add_argument("--poll-interval", type=float, default=0.2)
     sbm.add_argument(

@@ -77,6 +77,12 @@ class BatchBoardResult:
     grid: np.ndarray  # uint8 {0,1}, (height, width) — cropped, not padded
     generations: int
     exit_reason: str  # one of EXIT_REASONS
+    # Packed-kernel readbacks keep the board's device word layout here
+    # (io/bitpack.py convention; packed mode is exact-fit by construction,
+    # so the words ARE the cropped board): the serving stack can answer a
+    # packed wire response or store a packed CAS payload without
+    # re-packing. None on the byte/masked lanes.
+    words: np.ndarray | None = None
 
 
 def _generation(cur, kernel: Kernel, topology: Topology):
@@ -1391,7 +1397,7 @@ class StagedBatch:
     """Host-side operands of one batch, ready to dispatch.
 
     The staging product of the pipelined serve path (gol_tpu/pipeline): all
-    CPU work — stacking, zero-padding, ``np.packbits`` — is done, nothing
+    CPU work — stacking, zero-padding, ``packbits`` — is done, nothing
     has touched the device. The HOST operand arrays are retained here so an
     idempotent retry can re-dispatch without re-staging (and because the
     compiled program donates its device operand buffer)."""
@@ -1435,14 +1441,25 @@ def stage_batch(
     padded_shape: tuple[int, int] | None = None,
     pad_batch_to: int | None = None,
     temporal_depth: int = 1,
+    packed_boards=None,
 ) -> StagedBatch | None:
     """Host staging for ``simulate_batch``: validate, stack, pad, pack.
 
     Returns None for an empty board list. Pure host work — safe to run on a
     pipeline thread while the device computes a previous batch. Packing
     happens exactly once per staging (``engine_stage_packs_total`` counts
-    the ``np.packbits`` passes; the retry paths re-dispatch from the
-    retained staging, so the counter proves zero re-packs on retry)."""
+    the ``packbits`` passes; the retry paths re-dispatch from the
+    retained staging, so the counter proves zero re-packs on retry).
+
+    ``packed_boards`` (aligned with ``boards``; entries are each board's
+    pre-packed (H, W/32) word array — a packed wire submit's retained
+    payload — or None) is the zero-re-pack lane: when the batch resolves
+    to the packed kernel and EVERY board carries words, the operand is
+    assembled from them directly — no cell canvas is materialized and no
+    ``packbits`` pass runs, byte-identically to packing the stacked
+    cells (packed mode is exact-fit, and the wire payload IS the staging
+    layout). Any board without words falls the whole batch back to the
+    classic stack-and-pack path."""
     boards = [np.ascontiguousarray(np.asarray(b, dtype=np.uint8)) for b in boards]
     if not boards:
         return None
@@ -1472,9 +1489,6 @@ def stage_batch(
     b = len(boards)
     total = max(b, pad_batch_to or b)
     ph, pw = padded_shape
-    stacked = np.zeros((total, ph, pw), np.uint8)
-    for i, board in enumerate(boards):
-        stacked[i, : heights[i], : widths[i]] = board
     h_arr = np.ones((total,), np.int32)
     w_arr = np.ones((total,), np.int32)
     h_arr[:b] = heights
@@ -1487,11 +1501,37 @@ def stage_batch(
         head.check_similarity, head.similarity_frequency, mode,
         temporal_depth,
     )
-    if mode == "packed":
-        operand = _pack_board_words(stacked)
-        obs_registry.default().inc("engine_stage_packs_total")
+    words = None
+    if (
+        mode == "packed"
+        and packed_boards is not None
+        and len(packed_boards) == b
+        and all(w is not None for w in packed_boards)
+    ):
+        words = np.zeros((total, ph, pw // 32), np.uint32)
+        for i, w in enumerate(packed_boards):
+            w = np.ascontiguousarray(np.asarray(w, dtype=np.uint32))
+            if w.shape != (ph, pw // 32):
+                raise ValueError(
+                    f"packed board {i} has word shape {w.shape}; the "
+                    f"{ph}x{pw} packed canvas needs ({ph}, {pw // 32})"
+                )
+            words[i] = w
+    if mode == "packed" and words is not None:
+        # The zero-re-pack lane: no cell canvas, no np.packbits pass —
+        # engine_stage_packs_total deliberately NOT incremented, so the
+        # counter's drop is the visible signal packed submits bypass the
+        # staging tax.
+        operand = words
     else:
-        operand = stacked
+        stacked = np.zeros((total, ph, pw), np.uint8)
+        for i, board in enumerate(boards):
+            stacked[i, : heights[i], : widths[i]] = board
+        if mode == "packed":
+            operand = _pack_board_words(stacked)
+            obs_registry.default().inc("engine_stage_packs_total")
+        else:
+            operand = stacked
     return StagedBatch(
         runner=runner, operand=operand, h_arr=h_arr, w_arr=w_arr,
         limits=limits, heights=heights, widths=widths, mode=mode,
@@ -1522,7 +1562,13 @@ def _collect_board_results(staged: StagedBatch, finals, gens, reasons
     """Crop one batch's fetched device results back into per-board slices
     (shared by ``complete_batch`` and ``complete_ring``)."""
     finals = np.asarray(finals)
+    final_words = None
     if staged.mode == "packed":
+        # Keep the device word layout: packed mode is exact-fit, so each
+        # board's slice of the word canvas IS its packed result — retained
+        # on the BatchBoardResult so a packed wire response or CAS payload
+        # never re-packs what the device already computed in this layout.
+        final_words = finals
         finals = _unpack_board_words(finals)
     finals = np.asarray(finals, dtype=np.uint8)
     gens = np.asarray(gens)
@@ -1537,6 +1583,10 @@ def _collect_board_results(staged: StagedBatch, finals, gens, reasons
             grid=finals[i, : staged.heights[i], : staged.widths[i]].copy(),
             generations=int(gens[i]),
             exit_reason=EXIT_REASONS[int(reasons[i])],
+            words=(
+                np.asarray(final_words[i], dtype=np.uint32).copy()
+                if final_words is not None else None
+            ),
         )
         for i in range(b)
     ]

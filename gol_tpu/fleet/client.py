@@ -19,6 +19,43 @@ import urllib.error
 import urllib.request
 
 
+def http_exchange(
+    method: str,
+    url: str,
+    body: dict | None = None,
+    *,
+    raw: bytes | None = None,
+    timeout: float = 30.0,
+    headers: dict | None = None,
+    content_type: str | None = None,
+):
+    """One HTTP exchange -> (status, response content type, body bytes).
+
+    The format-agnostic primitive under ``http_json``: the packed wire
+    paths (io/wire.py) ride it directly — a packed result relay must hand
+    the frame bytes through untouched, and a packed submit forward must
+    carry its own Content-Type. ``content_type`` overrides the request
+    body's type (default ``application/json``, byte-identical to the
+    pre-wire client for every JSON caller). HTTP error statuses return
+    normally; connection-level failures raise (URLError/OSError)."""
+    if body is not None and raw is not None:
+        raise ValueError("pass body or raw, not both")
+    data = raw
+    hdrs = {"Accept": "application/json"}
+    if body is not None:
+        data = json.dumps(body).encode("utf-8")
+    if data is not None:
+        hdrs["Content-Type"] = content_type or "application/json"
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url, data=data, headers=hdrs, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
 def http_json(
     method: str,
     url: str,
@@ -27,6 +64,7 @@ def http_json(
     raw: bytes | None = None,
     timeout: float = 30.0,
     headers: dict | None = None,
+    content_type: str | None = None,
 ):
     """One JSON exchange -> (status, payload).
 
@@ -34,26 +72,16 @@ def http_json(
     the client's body was already parsed for placement; re-encoding a 17 MB
     board a second time would be pure tax). ``headers`` adds/overrides
     request headers (the router's trace-context stamp, obs/propagate.py —
-    receivers that don't know a header ignore it). HTTP error statuses
-    return normally; connection-level failures raise (URLError/OSError).
+    receivers that don't know a header ignore it). ``content_type``
+    overrides the body's Content-Type (the packed wire forward). HTTP
+    error statuses return normally; connection-level failures raise
+    (URLError/OSError).
     """
-    if body is not None and raw is not None:
-        raise ValueError("pass body or raw, not both")
-    data = raw
-    hdrs = {"Accept": "application/json"}
-    if body is not None:
-        data = json.dumps(body).encode("utf-8")
-    if data is not None:
-        hdrs["Content-Type"] = "application/json"
-    if headers:
-        hdrs.update(headers)
-    headers = hdrs
-    req = urllib.request.Request(url, data=data, headers=headers, method=method)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, _parse(resp.read())
-    except urllib.error.HTTPError as e:
-        return e.code, _parse(e.read())
+    status, _ctype, data = http_exchange(
+        method, url, body, raw=raw, timeout=timeout, headers=headers,
+        content_type=content_type,
+    )
+    return status, _parse(data)
 
 
 def _parse(raw: bytes):

@@ -12,7 +12,14 @@ without modification — and adds the fleet surfaces:
   unreachable spills to the next-ranked worker before the client sees an
   error; oversized boards (padded edge > ``big_edge``) go to the dedicated
   big-lane worker when the fleet has one. The 202 payload gains a
-  ``worker`` field.
+  ``worker`` field. Packed wire bodies (``Content-Type:
+  application/x-gol-packed``, io/wire.py) are placed from the frame
+  header + meta alone — no payload read, no unpack — and the raw buffer
+  is forwarded under the same content type: the router's cost per packed
+  submit is independent of board size.
+- ``GET /result/<id>`` with ``Accept: application/x-gol-packed`` relays
+  the worker's packed frame bytes verbatim (text/JSON results and every
+  error stay parsed-JSON, byte-identical to pre-wire routing).
 - ``GET /jobs/<id>``, ``/jobs/<id>/timeline``, ``GET /result/<id>``,
   ``DELETE /jobs/<id>`` — forwarded to the owning worker (an in-memory
   id->worker map, rebuilt lazily by broadcast after a router restart: the
@@ -50,12 +57,16 @@ import socket
 
 from gol_tpu.fleet import client, placement
 from gol_tpu.fleet.workers import Fleet, Worker
+from gol_tpu.io import wire
 from gol_tpu.obs import propagate, registry as obs_registry, trace as obs_trace
 from gol_tpu.obs.registry import Registry, _fmt
 
 logger = logging.getLogger(__name__)
 
-_MAX_BODY = 64 << 20  # the worker-side cap; the router must not be tighter
+# Body caps ride io/wire.py (wire.max_body_bytes — numpy-only, jax-free,
+# importable here), the same constants the workers enforce: the router
+# must never be tighter than a worker, and the packed cap bounds the same
+# board-area universe as the text cap rather than the same byte count.
 
 # SLO status ordering for the fleet-wide worst-of merge.
 _SLO_RANK = {"ok": 0, "warning": 1, "critical": 2}
@@ -300,6 +311,7 @@ class RouterServer:
         port: int = 0,
         big_edge: int = 1024,
         http=client.http_json,
+        http_exchange=client.http_exchange,
         submit_timeout: float = 120.0,
         cache_route: bool = False,
     ):
@@ -311,6 +323,10 @@ class RouterServer:
         self.fleet = fleet
         self.big_edge = big_edge
         self.http = http
+        # The byte-level exchange (packed wire result relay): separate
+        # injectable so tests stubbing the JSON client keep working
+        # unchanged — only Accept-packed result fetches ride this one.
+        self.http_exchange = http_exchange
         self.submit_timeout = submit_timeout
         # The fleet cache tier (gol_tpu/cache): rank workers by the job's
         # RESULT FINGERPRINT instead of its padding bucket, so every repeat
@@ -502,26 +518,54 @@ class RouterServer:
         order += [w for w in bigs if w.healthy and w.id not in in_order]
         return order
 
-    def route_submit(self, raw: bytes):
-        """(status, payload) for POST /jobs: place, forward, spill."""
+    def route_submit(self, raw: bytes, content_type: str | None = None):
+        """(status, payload) for POST /jobs: place, forward, spill.
+
+        A PACKED body (``Content-Type: application/x-gol-packed``) is
+        placed from its frame header + meta alone (``wire.peek``: ~24
+        bytes plus the meta JSON — no payload read, no CRC pass, no board
+        unpack) and forwarded as the SAME raw buffer under the same
+        content type: the router touches a few dozen bytes of a multi-MB
+        submit instead of JSON-parsing all of it. The text path is
+        byte-identical to pre-wire routing (test-pinned)."""
         if self._draining:
             self.registry.inc("jobs_rejected_total")
             return 429, {"error": "fleet is draining; not accepting jobs"}
-        body = json.loads(raw.decode("utf-8"))
-        if not isinstance(body, dict):
-            raise ValueError("request body must be a JSON object")
+        ctype = wire.content_type_of(content_type)
+        packed = ctype == wire.CONTENT_TYPE
+        if not packed and ctype.startswith(wire.CONTENT_TYPE_FAMILY):
+            # A gol wire revision this router does not speak: 415 without
+            # forwarding (the router could not even place it), the same
+            # retry-as-text signal the workers emit.
+            return 415, {
+                "error": f"unsupported content type {ctype}; this router "
+                         f"speaks {wire.CONTENT_TYPE} and application/json",
+            }
+        if packed:
+            width, height, meta = wire.peek(raw)  # UnsupportedWire -> 415
+            body = {**meta, "width": width, "height": height}
+        else:
+            body = json.loads(raw.decode("utf-8"))
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
         key = placement.key_for(body)  # raises -> handler's 400
         rank_label = None
         if self.cache_route and not body.get("no_cache"):
             # Fleet cache tier: repeats of a board must land where its
             # answer is cached, so the HRW key is the result fingerprint
-            # (jax-free; gol_tpu/cache/fingerprint.py). A body the
-            # fingerprinter rejects falls back to bucket routing — the
-            # worker's full validation still answers the client.
-            from gol_tpu.cache.fingerprint import body_fingerprint
+            # (jax-free; gol_tpu/cache/fingerprint.py). Packed bodies key
+            # through the frame's own payload CRC (packed_body_fingerprint
+            # — no unpack; format-scoped, so packed repeats of a board
+            # deterministically share an owner). A body the fingerprinter
+            # rejects falls back to bucket routing — the worker's full
+            # validation still answers the client.
+            from gol_tpu.cache import fingerprint as fp_mod
 
             try:
-                rank_label = "fp:" + body_fingerprint(body)
+                if packed:
+                    rank_label = "fp:" + fp_mod.packed_body_fingerprint(raw)
+                else:
+                    rank_label = "fp:" + fp_mod.body_fingerprint(body)
                 self.registry.inc("jobs_cache_routed_total")
             except (ValueError, TypeError, KeyError):
                 rank_label = None
@@ -537,11 +581,12 @@ class RouterServer:
         # `gol trace-report` measures. Disabled (the default), this block
         # allocates nothing and the forwarded request is byte-identical
         # to the headerless PR-8 wire format (test-pinned).
+        wire_ct = wire.CONTENT_TYPE if packed else None
         if not obs_trace.enabled():
             # The disabled path builds NOTHING extra — no header, no span
             # attributes, no candidate-ranking string: byte-identical
             # requests and PR-8 work per submit (test-pinned).
-            return self._forward_submit(raw, key, order, None)
+            return self._forward_submit(raw, key, order, None, wire_ct)
         trace_id = propagate.new_trace_id()
         headers = {propagate.TRACE_HEADER: propagate.encode(
             trace_id, propagate.sender_label()
@@ -552,17 +597,24 @@ class RouterServer:
             candidates=",".join(w.id for w in order),
             cache_route=bool(rank_label),
         ):
-            return self._forward_submit(raw, key, order, headers)
+            return self._forward_submit(raw, key, order, headers, wire_ct)
 
     def _forward_submit(self, raw: bytes, key: placement.PlacementKey,
-                        order: list[Worker], headers: dict | None):
+                        order: list[Worker], headers: dict | None,
+                        content_type: str | None = None):
         """The spillover walk: try workers in ranked order; spans/events
-        record each hop without ever changing a status code."""
+        record each hop without ever changing a status code. ``raw`` is
+        forwarded verbatim under ``content_type`` (the zero-copy contract:
+        a packed frame leaves this process as the byte buffer it arrived
+        in; the kwarg is omitted entirely for text, keeping the pre-wire
+        call shape byte-identical)."""
         last = (503, {"error": "no worker accepted the job"})
         small = key.max_edge <= self.big_edge
         shed_seen = False  # any 429: keep it as the client's answer
         normal_shed = False  # a NORMAL worker shed: skip big-lane tails
         http_kwargs = {"headers": headers} if headers else {}
+        if content_type is not None:
+            http_kwargs["content_type"] = content_type
         for worker in order:
             if worker.big and small and normal_shed:
                 # The big lane is the last resort for small jobs ONLY
@@ -637,11 +689,19 @@ class RouterServer:
             return status, payload
         return last
 
-    def forward_job(self, method: str, job_id: str, suffix: str = ""):
+    def forward_job(self, method: str, job_id: str, suffix: str = "",
+                    accept: str | None = None):
         """(status, payload) for the per-job endpoints: the mapped worker
         first, then broadcast (the map is memory-only; after a router
         restart the workers' journals are the only truth and whoever
-        answers non-404 owns the job)."""
+        answers non-404 owns the job).
+
+        ``accept`` forwards the client's Accept header (the packed wire
+        result fetch): when the worker answers in the packed content type,
+        ``payload`` comes back as the raw frame BYTES — relayed verbatim,
+        never decoded here — and the handler writes them out under the
+        worker's content type. Every other response (and every error)
+        stays the parsed-JSON contract."""
         path = ("/result/" if suffix == "result" else "/jobs/") + job_id
         if suffix not in ("", "result"):
             path = f"/jobs/{job_id}/{suffix}"
@@ -658,8 +718,18 @@ class RouterServer:
         unreachable = sum(1 for w in workers if not w.url)
         for worker in ordered:
             try:
-                status, payload = self.http(method, worker.url + path,
-                                            timeout=30)
+                if accept is not None:
+                    status, ctype, body = self.http_exchange(
+                        method, worker.url + path, timeout=30,
+                        headers={"Accept": accept},
+                    )
+                    if wire.is_packed(ctype):
+                        payload = body  # relay the frame bytes untouched
+                    else:
+                        payload = client._parse(body)
+                else:
+                    status, payload = self.http(method, worker.url + path,
+                                                timeout=30)
             except (urllib.error.URLError, ConnectionError, OSError):
                 unreachable += 1
                 continue
@@ -835,11 +905,12 @@ def _make_handler(router: RouterServer):
 
         def _reply(self, code: int, payload, content_type="application/json",
                    headers=None):
-            body = (
-                json.dumps(payload).encode("utf-8")
-                if content_type == "application/json"
-                else payload.encode("utf-8")
-            )
+            if isinstance(payload, (bytes, bytearray)):
+                body = bytes(payload)  # packed wire frames relay verbatim
+            elif content_type == "application/json":
+                body = json.dumps(payload).encode("utf-8")
+            else:
+                body = payload.encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
@@ -853,15 +924,19 @@ def _make_handler(router: RouterServer):
 
         def _read_raw(self) -> bytes:
             length = int(self.headers.get("Content-Length", 0))
-            if length > _MAX_BODY:
-                raise ValueError(f"body of {length} bytes exceeds {_MAX_BODY}")
+            cap = wire.max_body_bytes(self.headers.get("Content-Type"))
+            if length > cap:
+                raise ValueError(f"body of {length} bytes exceeds {cap}")
             return self.rfile.read(length) if length else b"{}"
 
         def do_POST(self):
             path = urlparse(self.path).path
             try:
                 if path == "/jobs":
-                    status, payload = router.route_submit(self._read_raw())
+                    status, payload = router.route_submit(
+                        self._read_raw(),
+                        content_type=self.headers.get("Content-Type"),
+                    )
                     headers = None
                     if status == 429 and "retry_after_s" in (payload or {}):
                         headers = {"Retry-After":
@@ -873,6 +948,10 @@ def _make_handler(router: RouterServer):
                 else:
                     self._read_raw()
                     self._reply(404, {"error": f"no such endpoint {path}"})
+            except wire.UnsupportedWire as e:
+                # A newer wire revision than this router speaks: 415, the
+                # client's retry-as-text signal (same as the workers).
+                self._reply(415, {"error": str(e)})
             except (ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {"error": str(e)})
@@ -897,9 +976,24 @@ def _make_handler(router: RouterServer):
                 else:
                     self._reply(*router.forward_job("GET", rest))
             elif path.startswith("/result/"):
-                self._reply(*router.forward_job(
-                    "GET", path[len("/result/"):], "result"
-                ))
+                accept = self.headers.get("Accept")
+                if wire.accepts_packed(accept):
+                    status, payload = router.forward_job(
+                        "GET", path[len("/result/"):], "result",
+                        accept=wire.CONTENT_TYPE,
+                    )
+                    self._reply(
+                        status, payload,
+                        content_type=(
+                            wire.CONTENT_TYPE
+                            if isinstance(payload, (bytes, bytearray))
+                            else "application/json"
+                        ),
+                    )
+                else:
+                    self._reply(*router.forward_job(
+                        "GET", path[len("/result/"):], "result"
+                    ))
             elif path == "/metrics":
                 fmt = parse_qs(parsed.query).get("format", ["prometheus"])[0]
                 if fmt == "json":

@@ -44,13 +44,21 @@ def row_stride(width: int) -> int:
     return width + 1
 
 
-def decode(data: bytes | np.ndarray, width: int, height: int) -> np.ndarray:
+def decode(
+    data: bytes | np.ndarray, width: int, height: int, exact: bool = False
+) -> np.ndarray:
     """Parse text-grid bytes into a uint8 {0,1} array of shape (height, width).
 
     Fast path: the file is exactly the height x (width+1) matrix the format
     contract promises — one reshape, no scan. Fallback: the reference's
     skip-newlines scan (src/game.c:154-165) for files with stray newlines or
     trailing bytes.
+
+    ``exact`` rejects any cell-count mismatch instead of truncating extra
+    cells the way the reference's parser does — the serving API's contract
+    (a submit body whose ``cells`` disagrees with its declared geometry is
+    a client error, never a silently-cropped board), while file readers
+    keep the reference's lenient scan.
     """
     raw = np.frombuffer(data, dtype=np.uint8) if isinstance(data, bytes) else data
     stride = row_stride(width)
@@ -62,9 +70,13 @@ def decode(data: bytes | np.ndarray, width: int, height: int) -> np.ndarray:
         ):
             return (mat[:, :width] == ONE).astype(np.uint8)
     cells = raw[raw != NEWLINE]
-    if cells.size < height * width:
+    if cells.size < height * width or (
+        exact and cells.size != height * width
+    ):
         raise ValueError(
-            f"input holds {cells.size} cells; need {height}x{width}={height * width}"
+            f"input holds {cells.size} cells; need "
+            f"{'exactly ' if exact else ''}{height}x{width}="
+            f"{height * width}"
         )
     return (cells[: height * width] == ONE).astype(np.uint8).reshape(height, width)
 

@@ -12,7 +12,7 @@ scheduler identically across the classic depth-1, pipelined
 
     accepted        admission succeeded (journal submit record durable)
     claimed         a forming batch took the job (batch formation ended)
-    stage_start     host staging began (stack + np.packbits)
+    stage_start     host staging began (stack + packbits)
     staged          host staging done
     dispatched      async device dispatch posted
     readback_start  the completer began blocking on device results

@@ -149,7 +149,7 @@ class InflightServeBatch:
 def stage(key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
     """Host half of a dispatch: validate membership, stack, pad, pack.
 
-    Pure CPU work (the ``np.packbits`` staging for packed buckets lives
+    Pure CPU work (the ``packbits`` staging for packed buckets lives
     here), so the pipelined scheduler runs it while the device computes a
     previous batch. Raises on empty/oversized batches and foreign jobs —
     the same checks ``run_batch`` has always enforced."""
@@ -170,6 +170,13 @@ def stage(key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
         padded_shape=(key.height, key.width),
         pad_batch_to=pad_batch(len(jobs)),
         temporal_depth=_plan().temporal_depth,
+        # Packed wire submits retained their payload words (Job.words):
+        # when every job of a packed-kernel bucket has them, the engine
+        # stages straight from the wire layout — no cell canvas, no
+        # np.packbits pass (engine_stage_packs_total visibly drops).
+        packed_boards=(
+            [job.words for job in jobs] if key.kernel == "packed" else None
+        ),
     )
     return StagedServeBatch(key=key, jobs=list(jobs), staged=staged)
 
@@ -187,7 +194,7 @@ def complete(inflight: InflightServeBatch) -> list[JobResult]:
     results = engine.complete_batch(inflight.inflight)
     return [
         JobResult(grid=r.grid, generations=r.generations,
-                  exit_reason=r.exit_reason)
+                  exit_reason=r.exit_reason, words=r.words)
         for r in results
     ]
 
@@ -226,7 +233,8 @@ def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
             temporal_depth=_plan().temporal_depth,
         )
     return [
-        JobResult(grid=r.grid, generations=r.generations, exit_reason=r.exit_reason)
+        JobResult(grid=r.grid, generations=r.generations,
+                  exit_reason=r.exit_reason, words=r.words)
         for r in results
     ]
 

@@ -84,6 +84,13 @@ class JobResult:
     # submission's engine run completed it. Journaled in the done record so
     # restarted servers keep reporting it (clients print the marker).
     cached: str | None = None
+    # The grid's packed wire words (io/wire.py row layout), when a hop
+    # already had them in hand — a packed-kernel engine readback or a
+    # packed CAS payload. Lets a packed GET /result answer without a
+    # re-pack; None (replayed results, masked/byte kernels) means the
+    # responder packs from ``grid`` on demand. Process-local, never
+    # journaled (the journal's done records stay text).
+    words: np.ndarray | None = None
 
 
 @dataclasses.dataclass
@@ -106,6 +113,13 @@ class Job:
     # scheduler at admission when a cache is mounted; None otherwise (and
     # for no_cache jobs). Process-local — replayed jobs re-derive it.
     fingerprint: str | None = None
+    # The board's packed wire words, retained from a packed submit
+    # (io/wire.py) when the width packs (W % 32 == 0): the batcher hands
+    # them straight to the packed-kernel staging lane, skipping the
+    # ``np.packbits`` pass the text path pays (engine_stage_packs_total
+    # visibly drops under packed traffic). Process-local like the stamps
+    # below — never journaled; replayed jobs re-stage from ``board``.
+    words: np.ndarray | None = None
     # The propagated fleet trace id (obs/propagate.py): set at admission
     # when the router stamped an ``X-Gol-Trace`` header AND tracing is
     # enabled in this process — the job's flow events then carry the
@@ -173,6 +187,14 @@ class Job:
                 f"board shape {self.board.shape} does not match declared "
                 f"{self.height}x{self.width}"
             )
+        # Retained wire words are a pure staging accelerator: anything that
+        # does not exactly match the packed-kernel operand shape is dropped
+        # (the board stages through the classic pack), never trusted.
+        if self.words is not None and (
+            self.width % 32 != 0
+            or self.words.shape != (self.height, self.width // 32)
+        ):
+            self.words = None
 
     @property
     def config(self) -> GameConfig:

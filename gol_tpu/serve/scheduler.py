@@ -46,7 +46,7 @@ of it. The cache is an accelerator, never a source of truth.
 readback, journal strictly in series, host idle while the device computes
 and vice versa — is replaced by a two-thread pipeline over a bounded
 in-flight window: a *dispatcher* claims batches, stages host operands
-(``batcher.stage``: stacking + ``np.packbits``), and posts the async device
+(``batcher.stage``: stacking + ``packbits``), and posts the async device
 dispatch without blocking; a *completer* blocks on readback, journals, and
 finalizes — so the device computes batch N while the host stages N+1 and
 journals N-1 (the iwrite/wait-at-next-boundary discipline of the
@@ -420,6 +420,10 @@ class Scheduler:
             generations=entry.generations,
             exit_reason=entry.exit_reason,
             cached=tier,
+            # A packed CAS payload's words ride through to the response:
+            # a binary hit answers a packed GET /result with the stored
+            # word bytes — no decode→re-encode round trip.
+            words=entry.words,
         )
         job.transition(DONE)
         self.metrics.inc("jobs_completed_total")
@@ -714,6 +718,10 @@ class Scheduler:
                         grid=r.grid,
                         generations=r.generations,
                         exit_reason=r.exit_reason,
+                        # Packed-kernel readbacks carry their word layout:
+                        # the CAS packed payload then writes without a
+                        # re-pack, exactly as a packed response serves.
+                        words=r.words,
                     ))
         followers = self._take_followers(batch)
         for f in followers:
@@ -725,6 +733,7 @@ class Scheduler:
                 generations=leader.generations,
                 exit_reason=leader.exit_reason,
                 cached="coalesced",
+                words=leader.words,
             )
             f.transition(DONE)
             self.metrics.inc("jobs_completed_total")

@@ -12,11 +12,29 @@ Endpoints (all JSON unless noted):
   ``X-Gol-Trace`` header (a tracing fleet router's stamp) is adopted as
   the job's flow id when tracing is enabled here, and ignored otherwise —
   requests and responses are byte-identical either way (obs/propagate.py).
+
+  **Wire negotiation** (``io/wire.py``): with ``Content-Type:
+  application/x-gol-packed`` the body is ONE packed wire frame — the
+  header carries width/height, the frame meta carries the remaining
+  fields (everything above except ``cells``), the payload carries the
+  board at a bit per cell (~8x smaller than text). The retained payload
+  words stage straight into packed-kernel buckets (no text decode, no
+  ``packbits`` pass). Unknown ``application/x-gol-*`` types (and
+  newer frame versions) answer 415 — the client's retry-as-text signal;
+  anything else takes the JSON path, byte-identically to pre-wire
+  servers (test-pinned). The body cap is content-type-aware: both
+  formats accept the same universe of board AREAS
+  (``wire.max_body_bytes``), not the same byte count.
 - ``GET /jobs/<id>``  — lifecycle state + timings.
 - ``GET /result/<id>``— final grid (text-grid string), generations, exit
   reason; 409 while the job is not DONE, 410 for FAILED/CANCELLED. A
   result served by the cache (or a coalesced duplicate) carries
-  ``"cached": "memory"|"disk"|"coalesced"``.
+  ``"cached": "memory"|"disk"|"coalesced"``. With
+  ``Accept: application/x-gol-packed`` the 200 answer is a packed wire
+  frame instead (meta: id/generations/exit_reason/cached; payload: the
+  grid) — encoded from result words already in hand when the packed
+  kernel or a packed CAS payload produced them, so a binary hit never
+  decodes and re-encodes. Error statuses stay JSON for all clients.
 - ``DELETE /jobs/<id>`` — cancel a still-QUEUED job; 409 once it has been
   claimed by a batch (dispatch is not interruptible), 404 if unknown.
 - ``GET /jobs/<id>/timeline`` — the job's milestone/segment decomposition
@@ -57,7 +75,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
 
-from gol_tpu.io import text_grid
+from gol_tpu.io import text_grid, wire
 from gol_tpu.obs import (
     history as obs_history,
     propagate as obs_propagate,
@@ -74,7 +92,32 @@ from gol_tpu.serve.scheduler import Draining, QueueFull, Scheduler
 
 logger = logging.getLogger(__name__)
 
-_MAX_BODY = 64 << 20  # 64 MiB: a 4096^2 text board is ~17 MB
+# Body caps live in io/wire.py (wire.max_body_bytes, shared with the
+# jax-free router so both tiers agree): 64 MiB for text/JSON —
+# byte-identical to the pre-wire cap, test-pinned — and the same
+# board-AREA universe for packed bodies.
+
+
+def _decode_cells(cells, width: int, height: int):
+    """Strict submit-body board decode: the ``cells`` field must be an
+    ASCII string whose cell count matches the declared geometry EXACTLY.
+    Every malformed shape — wrong type, non-ASCII bytes, too short, too
+    long — raises ValueError/TypeError here, which the handler maps to the
+    400 error contract (the reference parser's lenient truncation is for
+    FILES; an API body that disagrees with its own geometry is a client
+    error, never a silently-cropped board)."""
+    if not isinstance(cells, str):
+        raise TypeError(
+            f"cells must be a string, got {type(cells).__name__}"
+        )
+    try:
+        raw = cells.encode("ascii")
+    except UnicodeEncodeError:
+        raise ValueError(
+            "cells must be ASCII ('0'/'1' rows, newline-separated); "
+            "got non-ASCII characters"
+        ) from None
+    return text_grid.decode(raw, width, height, exact=True)
 
 
 def _tuned_marginal_rates() -> dict[str, float]:
@@ -110,7 +153,7 @@ class GolServer:
         result_cache: bool = False,
         cache_dir: str | None = None,
         cache_entries: int = 1024,
-        cache_payload: str = "text",
+        cache_payload: str = "packed",
         history_dir: str | None = None,
         history_bytes: int | None = None,
         **scheduler_kwargs,
@@ -246,9 +289,38 @@ class GolServer:
         width, height = int(body["width"]), int(body["height"])
         if width <= 0 or height <= 0:
             raise ValueError(f"dimensions must be positive, got {height}x{width}")
-        board = text_grid.decode(
-            str(body["cells"]).encode("ascii"), width, height
-        )
+        board = _decode_cells(body["cells"], width, height)
+        return self._submit_board(board, None, width, height, body,
+                                  trace_header)
+
+    def submit_packed(self, raw: bytes,
+                      trace_header: str | None = None) -> dict:
+        """``POST /jobs`` with the packed wire Content-Type: one frame in,
+        the same 202 payload out. The frame's payload words are retained
+        on the job (when the width packs), so a packed-kernel bucket
+        stages them without the text decode OR the ``packbits`` pass."""
+        frame = wire.decode_frame(raw)
+        clash = {"cells", "width", "height", "words"} & frame.meta.keys()
+        if clash:
+            raise ValueError(
+                f"packed frame meta must not carry {sorted(clash)} — "
+                "geometry rides the header, the board rides the payload"
+            )
+        width, height = frame.width, frame.height
+        if width <= 0 or height <= 0:
+            raise ValueError(f"dimensions must be positive, got {height}x{width}")
+        board = frame.grid()
+        words = frame.words if width % 32 == 0 else None
+        self.metrics.inc("wire_packed_submits_total")
+        return self._submit_board(board, words, width, height, frame.meta,
+                                  trace_header)
+
+    def _submit_board(self, board, words, width: int, height: int,
+                      body: dict, trace_header: str | None) -> dict:
+        """The format-independent half of a submit: field validation via
+        Job, trace adoption, scheduler admission. ``body`` is the JSON
+        object (text lane) or the frame meta (packed lane) — identical
+        field vocabulary, so the two lanes cannot drift."""
         kwargs = {}
         for field in (
             "convention", "gen_limit", "check_similarity",
@@ -258,7 +330,7 @@ class GolServer:
                 kwargs[field] = body[field]
         if body.get("deadline_s") is not None:
             kwargs["deadline_s"] = float(body["deadline_s"])
-        job = new_job(width, height, board, **kwargs)
+        job = new_job(width, height, board, words=words, **kwargs)
         # Trace-context adoption (obs/propagate.py): a router forwarding
         # under `--trace` stamps X-Gol-Trace; when tracing is enabled HERE
         # too, the job's flow events ride the fleet-wide id and chain onto
@@ -320,12 +392,18 @@ class GolServer:
             out["run_seconds"] = job.finished_at - job.started_at
         return out
 
-    def result_json(self, job_id: str):
-        """(status_code, payload) for GET /result/<id>."""
+    def _find_result(self, job_id: str):
+        """The job's JobResult when it is DONE (live or replayed), else
+        None — the format-independent half of GET /result/<id>."""
         job = self.scheduler.job(job_id)
         result = job.result if job is not None and job.state == DONE else None
         if result is None and job_id in self._replay_results:
             result = self._replay_results[job_id]
+        return job, result
+
+    def result_json(self, job_id: str):
+        """(status_code, payload) for GET /result/<id>."""
+        job, result = self._find_result(job_id)
         if result is not None:
             return 200, {
                 "id": job_id,
@@ -350,6 +428,30 @@ class GolServer:
         return 409, {"id": job_id, "state": job.state,
                      "error": "result not ready"}
 
+    def result_packed(self, job_id: str):
+        """GET /result/<id> under ``Accept: application/x-gol-packed``:
+        (status, frame bytes) on success — encoded from the result's
+        retained words when a packed kernel or packed CAS payload produced
+        them (zero re-pack), from the grid otherwise, byte-identically —
+        or (status, JSON payload) on every non-200 (errors stay JSON for
+        all clients)."""
+        _job, result = self._find_result(job_id)
+        if result is None:
+            return self.result_json(job_id)
+        meta = {
+            "id": job_id,
+            "generations": result.generations,
+            "exit_reason": result.exit_reason,
+            **({"cached": result.cached} if result.cached else {}),
+        }
+        height, width = (int(x) for x in result.grid.shape)
+        self.metrics.inc("wire_packed_results_total")
+        if result.words is not None:
+            return 200, wire.encode_frame(
+                meta, words=result.words, width=width, height=height
+            )
+        return 200, wire.encode_frame(meta, grid=result.grid)
+
 
 def _make_handler(server: GolServer):
     class Handler(BaseHTTPRequestHandler):
@@ -365,11 +467,12 @@ def _make_handler(server: GolServer):
 
         def _reply(self, code: int, payload, content_type="application/json",
                    headers=None):
-            body = (
-                json.dumps(payload).encode("utf-8")
-                if content_type == "application/json"
-                else payload.encode("utf-8")
-            )
+            if isinstance(payload, (bytes, bytearray)):
+                body = bytes(payload)  # packed wire frames go out verbatim
+            elif content_type == "application/json":
+                body = json.dumps(payload).encode("utf-8")
+            else:
+                body = payload.encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
@@ -384,12 +487,20 @@ def _make_handler(server: GolServer):
             self.end_headers()
             self.wfile.write(body)
 
-        def _read_body(self) -> dict:
+        def _read_raw(self) -> bytes:
+            """Read the request body under the CONTENT-TYPE-AWARE cap
+            (wire.max_body_bytes): the 64 MiB text cap was sized for
+            text's ~8x inflation, so packed bodies are capped by the
+            equivalent board AREA — the two formats accept the same
+            universe of board sizes (boundary-pinned by tests)."""
             length = int(self.headers.get("Content-Length", 0))
-            if length > _MAX_BODY:
-                raise ValueError(f"body of {length} bytes exceeds {_MAX_BODY}")
-            raw = self.rfile.read(length) if length else b"{}"
-            body = json.loads(raw.decode("utf-8"))
+            cap = wire.max_body_bytes(self.headers.get("Content-Type"))
+            if length > cap:
+                raise ValueError(f"body of {length} bytes exceeds {cap}")
+            return self.rfile.read(length) if length else b"{}"
+
+        def _read_body(self) -> dict:
+            body = json.loads(self._read_raw().decode("utf-8"))
             if not isinstance(body, dict):
                 raise ValueError("request body must be a JSON object")
             return body
@@ -422,13 +533,40 @@ def _make_handler(server: GolServer):
                             headers={"Retry-After": str(int(retry_after))},
                         )
                         return
+                    ctype = wire.content_type_of(
+                        self.headers.get("Content-Type")
+                    )
+                    trace_header = self.headers.get(
+                        obs_propagate.TRACE_HEADER
+                    )
                     try:
-                        out = server.submit_json(
-                            self._read_body(),
-                            trace_header=self.headers.get(
-                                obs_propagate.TRACE_HEADER
-                            ),
-                        )
+                        if ctype == wire.CONTENT_TYPE:
+                            out = server.submit_packed(
+                                self._read_raw(), trace_header=trace_header
+                            )
+                        elif ctype.startswith(wire.CONTENT_TYPE_FAMILY):
+                            # A gol wire format this server does not speak
+                            # (a future revision's content type): 415 is
+                            # the client's retry-as-text signal. Anything
+                            # OUTSIDE the family takes the JSON path — the
+                            # compat default, byte-identical to pre-wire
+                            # servers (test-pinned).
+                            self._discard_body()
+                            self._reply(415, {
+                                "error": f"unsupported content type "
+                                         f"{ctype}; this server speaks "
+                                         f"{wire.CONTENT_TYPE} and "
+                                         "application/json",
+                            })
+                            return
+                        else:
+                            out = server.submit_json(
+                                self._read_body(),
+                                trace_header=trace_header,
+                            )
+                    except wire.UnsupportedWire as e:
+                        self._reply(415, {"error": str(e)})
+                        return
                     except (QueueFull, Draining) as e:
                         self._reply(429, {"error": str(e)})
                         return
@@ -443,10 +581,13 @@ def _make_handler(server: GolServer):
                 else:
                     self._discard_body()
                     self._reply(404, {"error": f"no such endpoint {path}"})
-            except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            except (ValueError, KeyError, TypeError, OverflowError,
+                    json.JSONDecodeError) as e:
                 # TypeError covers wrong JSON *types* in otherwise-present
-                # fields (priority: null, gen_limit: "x") — a client error,
-                # never allowed past Job validation into the queue.
+                # fields (priority: null, gen_limit: "x"); OverflowError
+                # covers absurd numeric fields reaching numpy/struct
+                # boundaries — client errors all, never allowed past Job
+                # validation into the queue (and never a 500).
                 self._reply(400, {"error": str(e)})
 
         def do_DELETE(self):
@@ -482,8 +623,20 @@ def _make_handler(server: GolServer):
                 else:
                     self._reply(200, out)
             elif path.startswith("/result/"):
-                code, payload = server.result_json(path[len("/result/"):])
-                self._reply(code, payload)
+                job_id = path[len("/result/"):]
+                if wire.accepts_packed(self.headers.get("Accept")):
+                    code, payload = server.result_packed(job_id)
+                    self._reply(
+                        code, payload,
+                        content_type=(
+                            wire.CONTENT_TYPE
+                            if isinstance(payload, (bytes, bytearray))
+                            else "application/json"
+                        ),
+                    )
+                else:
+                    code, payload = server.result_json(job_id)
+                    self._reply(code, payload)
             elif path == "/metrics":
                 fmt = parse_qs(parsed.query).get("format", ["prometheus"])[0]
                 if fmt == "json":

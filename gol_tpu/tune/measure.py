@@ -162,8 +162,11 @@ def _pick_winner(trials: list[Trial], default_label: str):
 
 
 def _pack_words(grid: np.ndarray) -> np.ndarray:
-    packed = np.packbits(grid, axis=1, bitorder="little")
-    return np.ascontiguousarray(packed).view(np.uint32)
+    # The ONE bit-order rule lives in io/bitpack.py (tests/test_lint.py
+    # bans np.packbits elsewhere in the library).
+    from gol_tpu.io import bitpack
+
+    return bitpack.pack_words(grid)
 
 
 def run_engine_search(

@@ -343,10 +343,14 @@ class TestSchedulerCache:
         s1.stop()
         cas = DiskCAS(str(tmp_path / "cas"))
         fp = job_fingerprint(first)
-        meta = json.load(open(cas.meta_path(fp)))
-        meta["grid"] = meta["grid"][::-1]
-        with open(cas.meta_path(fp), "w") as f:
-            json.dump(meta, f)
+        # Poison the packed sidecar (the default payload): flip payload
+        # bytes without touching the meta commit point — the wire frame's
+        # CRC gate must catch it on read.
+        with open(cas.packed_path(fp), "rb") as f:
+            frame = bytearray(f.read())
+        frame[-1] ^= 0xFF
+        with open(cas.packed_path(fp), "wb") as f:
+            f.write(bytes(frame))
         s2, m2 = self._scheduler(tmp_path)
         s2.start()
         rerun = s2.submit(new_job(32, 32, g, gen_limit=8))

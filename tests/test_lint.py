@@ -149,6 +149,27 @@ def test_no_wall_clock_in_cache():
         )
 
 
+def test_bit_packing_only_in_bitpack():
+    """``np.packbits``/``np.unpackbits`` are banned everywhere in gol_tpu/
+    except ``io/bitpack.py`` — the ONE copy of the bit-order rule ("bit j
+    of word w = column 32w+j"). The rule now has FOUR would-be
+    re-implementation sites (engine staging, the CAS ts lane, the tuner's
+    packed-state trials, and the wire codec), and a change reaching only
+    some of them would silently scramble columns in the rest: a packed
+    wire submit would decode to a different board than the text form of
+    the same bytes, poisoning results and cache entries alike."""
+    for needle in ("np.packbits", "np.unpackbits"):
+        offenders = [
+            o for o in _offenders(_LIBRARY_ROOT, needle)
+            if not o.startswith(str(pathlib.Path("io") / "bitpack.py"))
+        ]
+        assert not offenders, (
+            f"{needle} outside gol_tpu/io/bitpack.py (route through "
+            f"bitpack.pack_words/unpack_words — the bit-order rule must "
+            f"stay single-copy): {offenders}"
+        )
+
+
 def test_no_wall_clock_in_engine():
     """Same rule for the engine module itself, which PR 6 made part of the
     serve hot path (the batched/ring runners and their staging live there):

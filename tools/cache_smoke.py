@@ -181,16 +181,19 @@ def main() -> int:
             "cached answer differs from a cache-disabled server's"
         print("cache-smoke: cache-disabled run byte-identical")
 
-        # 4. Corrupt the CAS entry: loud evict + correct re-run.
+        # 4. Corrupt the CAS entry: loud evict + correct re-run. The
+        # default payload is the packed wire sidecar (.golp); flip cell
+        # bits in its payload without touching the meta commit point —
+        # the CRC gate must catch the defect on read.
         proc.send_signal(signal.SIGTERM)
         proc.wait(timeout=60)
         meta_path = entries[0]
-        meta = json.load(open(meta_path))
-        flipped = ("1" + meta["grid"][1:] if meta["grid"][0] == "0"
-                   else "0" + meta["grid"][1:])
-        meta["grid"] = flipped
-        with open(meta_path, "w") as f:
-            json.dump(meta, f)
+        sidecar = meta_path[: -len(".json")] + ".golp"
+        with open(sidecar, "r+b") as f:
+            f.seek(-4, os.SEEK_END)
+            tail = f.read(4)
+            f.seek(-4, os.SEEK_END)
+            f.write(bytes(b ^ 0xFF for b in tail))
         port = _free_port()
         proc, base = _start_server(port, journal_dir, cache_dir)
         rerun_result = _submit_and_fetch(base, body)
