@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -35,6 +35,11 @@ bench:
 #       METRIC=lanes.warm.jobs_per_sec
 #   make bench-diff OLD=BENCH_r13.json NEW=/tmp/BENCH_r13.json \
 #       METRIC=sizes.b2048.bytes.ratio_roundtrip
+# The sparse suite's CI gate rides its 2^14^2 dense/sparse per-generation
+# ratio leaf (higher is better — an elision/batching regression shows up
+# as the ratio collapsing toward the dense floor):
+#   make bench-diff OLD=BENCH_r14.json NEW=/tmp/BENCH_r14.json \
+#       METRIC=sizes.u16384.ratio_dense_over_sparse
 bench-diff:
 	@test -n "$(OLD)" && test -n "$(NEW)" || \
 		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1] [METRIC=dot.path]"; exit 2; }
@@ -105,6 +110,14 @@ cache-smoke:
 # through the respawn.
 fleettrace-smoke:
 	python3 tools/fleettrace_smoke.py
+
+# Sparse-engine smoke (tools/sparse_smoke.py): a glider crossing >= 4 tile
+# boundaries is byte-checked against the dense engine + oracle for both
+# conventions, then a real `gol serve` running a long sparse job is
+# SIGKILLed mid-run and the restart must replay the journaled RLE spec to
+# an identical result with exactly one done record.
+sparse-smoke:
+	python3 tools/sparse_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

@@ -1906,6 +1906,133 @@ def _bench_wire(args) -> int:
 # its _bench_* function) — no if/elif chain to grow. Each entry is
 # (runner, one-line help shown by --list-suites). Suites pin their own
 # workloads; the size/config resolution in main() is for the solo lanes.
+def _bench_sparse(args) -> int:
+    """Sparse tiled engine suite (--suite sparse) -> BENCH_r14.json.
+
+    ISSUE 12's asymptotics claim: on sparse universes the dense engines
+    cost O(area) per generation regardless of liveness, while the sparse
+    lane costs O(active tiles). Fixed 5-glider load (the same five
+    gliders, spread far apart so they never interact) on universes
+    2^12^2 .. 2^16^2:
+
+    - **sparse** lane at every size: per-generation wall time + the
+      tiles-simulated counter (the load is ~5-20 active tiles at EVERY
+      size, so sparse cost is flat while area grows 256x);
+    - **dense** lane (the solo engine, kernel auto) where the canvas fits
+      (2^12..2^14) — at 2^14^2 the occupancy is ~0.1%, far inside the
+      <= 1% acceptance regime.
+
+    Headline: dense/sparse per-generation ratio at 2^14^2, gated by exit
+    code at >= 10x. CI gates the leaf via
+    ``tools/bench_diff.py --metric sizes.u16384.ratio_dense_over_sparse``.
+    """
+    import jax
+
+    from gol_tpu import engine
+    from gol_tpu.config import GameConfig
+    from gol_tpu.io import rle as rle_codec
+    from gol_tpu.sparse import SparseBoard, TileMemo, simulate_sparse
+
+    glider = rle_codec.read_file(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "patterns", "glider.rle")
+    )
+    tile = 256
+    sparse_gens = 24
+    dense_gens = 4
+    sizes = [1 << 12, 1 << 13, 1 << 14, 1 << 15, 1 << 16]
+    dense_max = 1 << 14
+    config_for = lambda g: GameConfig(gen_limit=g)  # noqa: E731
+
+    def five_gliders(u: int) -> SparseBoard:
+        board = SparseBoard(u, u, tile)
+        step = u // 5
+        for k in range(5):
+            board.place(glider, (k * step + step // 3) % (u - 8),
+                        ((4 - k) * step + step // 2) % (u - 8))
+        return board
+
+    print(f"bench sparse: 5-glider load, tile {tile}, "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+
+    sizes_out = {}
+    for u in sizes:
+        board = five_gliders(u)
+        occupancy = board.occupancy()
+        # Warm the tile-step programs outside the timer (one compile per
+        # ladder rung, paid once per process like every bucket program).
+        simulate_sparse(five_gliders(u), config_for(1), TileMemo())
+        t0 = time.perf_counter()
+        result = simulate_sparse(board, config_for(sparse_gens), TileMemo())
+        sparse_s = time.perf_counter() - t0
+        assert result.generations == sparse_gens, result.generations
+        entry = {
+            "universe": f"{u}x{u}",
+            "occupancy": occupancy,
+            "sparse_s_per_gen": sparse_s / sparse_gens,
+            "sparse_generations": sparse_gens,
+            "tiles_simulated": result.stats.tiles_active,
+            "tiles_per_generation": result.stats.tiles_per_generation(),
+        }
+        if u <= dense_max:
+            dense_grid = board.to_dense()
+            cfg = config_for(dense_gens)
+            runner = engine.make_runner((u, u), cfg)
+            device_grid = engine.put_grid(dense_grid)
+            # Time the COMPILED executable: calling the jitted runner after
+            # an AOT compile_runner would re-trace+re-compile inside the
+            # timer and inflate the dense column (and so the gated ratio).
+            compiled = engine.compile_runner(runner, device_grid)
+            t0 = time.perf_counter()
+            _final, gen = compiled(device_grid)
+            gens = int(gen)  # blocks until the loop finishes
+            dense_s = time.perf_counter() - t0
+            assert gens == dense_gens, gens
+            entry["dense_s_per_gen"] = dense_s / dense_gens
+            entry["dense_generations"] = dense_gens
+            entry["ratio_dense_over_sparse"] = (
+                entry["dense_s_per_gen"] / entry["sparse_s_per_gen"]
+            )
+        print(
+            f"  {u:>6}^2: sparse {entry['sparse_s_per_gen'] * 1000:9.2f} "
+            f"ms/gen ({entry['tiles_per_generation']:.1f} tiles/gen, "
+            f"occupancy {occupancy:.5f})"
+            + (
+                f"   dense {entry['dense_s_per_gen'] * 1000:9.2f} ms/gen "
+                f"-> {entry['ratio_dense_over_sparse']:.1f}x"
+                if "dense_s_per_gen" in entry else "   dense: skipped (area)"
+            ),
+            file=sys.stderr,
+        )
+        sizes_out[f"u{u}"] = entry
+
+    headline = sizes_out["u16384"]["ratio_dense_over_sparse"]
+    print(f"  sparse at 2^14^2 = {headline:.1f}x the dense engine per "
+          f"generation (acceptance >= 10x)", file=sys.stderr)
+    payload = {
+        "metric": "sparse_speedup_16384",
+        "value": headline,
+        "unit": "x dense wall time per generation",
+        "vs_baseline": headline / 10.0,  # over the acceptance floor
+        "sizes": sizes_out,
+        "load": {
+            "pattern": "glider x5",
+            "tile": tile,
+            "sparse_generations": sparse_gens,
+            "dense_generations": dense_gens,
+        },
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r14.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    print(json.dumps(payload))
+    return 0 if headline >= 10.0 else 1
+
+
 SUITES = {
     "batch": (
         _bench_batch,
@@ -1918,6 +2045,15 @@ SUITES = {
         "16 unique 256^2 boards): cold engine path vs warm hit path vs "
         "in-flight coalescing, hit-path latency vs engine-path latency "
         "(acceptance: warm >= 10x cold); writes BENCH_r11.json",
+    ),
+    "sparse": (
+        _bench_sparse,
+        "sparse tiled engine: per-generation wall time dense vs sparse on "
+        "a fixed 5-glider load over 2^12^2..2^16^2 universes with "
+        "tiles-simulated counters (acceptance: sparse >= 10x dense at "
+        "2^14^2, <= 1% occupancy; CI gates "
+        "--metric sizes.u16384.ratio_dense_over_sparse); writes "
+        "BENCH_r14.json",
     ),
     "tune": (
         _bench_tune,

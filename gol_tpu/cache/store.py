@@ -89,13 +89,22 @@ class CacheEntry:
 
 
 class MemoryLRU:
-    """Bounded thread-safe LRU of fingerprint -> CacheEntry."""
+    """Bounded thread-safe LRU of fingerprint -> CacheEntry.
 
-    def __init__(self, max_entries: int = 1024):
+    ``max_bytes`` adds a grid-byte budget on top of the entry count (the
+    tile memo's bound — 8192 entries of 256^2 tiles is half a GB, so an
+    entry count alone is not a memory bound when entries are big); None
+    keeps the PR-9 entries-only behavior byte-for-byte."""
+
+    def __init__(self, max_entries: int = 1024, max_bytes: int | None = None):
         if max_entries < 1:
             raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
         self.max_entries = max_entries
+        self.max_bytes = max_bytes
         self.evictions = 0
+        self._bytes = 0
         self._lock = threading.Lock()
         self._entries: collections.OrderedDict[str, CacheEntry] = (
             collections.OrderedDict()
@@ -104,6 +113,12 @@ class MemoryLRU:
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    @property
+    def grid_bytes(self) -> int:
+        """Resident grid payload bytes (the budget ``max_bytes`` caps)."""
+        with self._lock:
+            return self._bytes
 
     def get(self, fp: str) -> CacheEntry | None:
         with self._lock:
@@ -114,15 +129,26 @@ class MemoryLRU:
 
     def put(self, fp: str, entry: CacheEntry) -> None:
         with self._lock:
+            old = self._entries.get(fp)
+            if old is not None:
+                self._bytes -= old.grid.nbytes
             self._entries[fp] = entry
             self._entries.move_to_end(fp)
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
+            self._bytes += entry.grid.nbytes
+            while len(self._entries) > self.max_entries or (
+                self.max_bytes is not None
+                and self._bytes > self.max_bytes
+                and len(self._entries) > 1
+            ):
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.grid.nbytes
                 self.evictions += 1
 
     def pop(self, fp: str) -> None:
         with self._lock:
-            self._entries.pop(fp, None)
+            entry = self._entries.pop(fp, None)
+            if entry is not None:
+                self._bytes -= entry.grid.nbytes
 
 
 class DiskCAS:

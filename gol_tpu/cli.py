@@ -202,6 +202,13 @@ def _run(args) -> int:
     if height <= 0:
         height = DEFAULT_HEIGHT
 
+    if args.pattern is not None:
+        # The geometry-first lane: the board is a pattern placed into a
+        # declared universe — construction never materializes the canvas,
+        # so the engine choice (sparse above the area threshold) happens
+        # BEFORE any allocation the choice is supposed to avoid.
+        return _run_pattern(args, variant)
+
     if args.input_file is None:
         # Simulation skipped entirely (src/game.c:238-241).
         if variant.final_finished:
@@ -248,6 +255,13 @@ def _run(args) -> int:
             "add --packed-io to resume from it"
         )
 
+    if args.engine == "sparse":
+        # Sparse engine over a dense input FILE (the A/B lane): reading the
+        # file materializes the grid, so this only serves sizes the dense
+        # guard admits — giant universes come in as --pattern instead.
+        _validate_sparse_flags(args)
+        return _run_sparse_file(args, variant, config, width, height)
+
     if args.host:
         # lax is what the host oracle effectively is, so it stays accepted;
         # forcing an accelerator kernel alongside --host is a contradiction.
@@ -284,6 +298,17 @@ def _run(args) -> int:
                 f"{args.kernel!r} contradicts it"
             )
         return _run_packed_io(args, variant, config, width, height, output_path, mesh)
+
+    if mesh is None:
+        # The dense-path scaling trap: an oversized request used to OOM
+        # inside np.zeros/read_grid with a raw traceback. Fail it here,
+        # clearly, naming the lane that CAN run it. (Sharded mesh reads
+        # materialize per-shard, not the whole canvas — they keep their
+        # own per-device warning below; the packed lane branched off
+        # above and carries 32x smaller state.)
+        from gol_tpu.sparse.board import dense_cells_guard
+
+        dense_cells_guard(height, width)
 
     _warn_if_huge_byte_lane(width, height, mesh)
 
@@ -695,6 +720,173 @@ def _prepare_segmented(args, variant, config, mesh, device_grid, height, width):
     )
 
 
+def _validate_lane_flags(args, lane: str) -> None:
+    """Flags the pattern/sparse lanes cannot honor: both are single-device
+    and snapshot-free, and a silently-ignored flag would misreport what
+    ran. ``--kernel`` is deliberately NOT here — the dense pattern branch
+    honors it; only the sparse engine rejects it (below)."""
+    for flag, name in (
+        (args.mesh, "--mesh"),
+        (args.packed_io, "--packed-io"),
+        (args.host, "--host"),
+        (args.snapshot_every, "--snapshot-every"),
+        (args.resume_gen, "--resume-gen"),
+    ):
+        if flag:
+            raise ValueError(f"{name} does not apply to {lane}")
+    if _checkpointing(args):
+        raise ValueError(
+            f"checkpointing is not supported on {lane}; the serve path "
+            "replays sparse jobs from their journaled spec"
+        )
+
+
+def _validate_sparse_flags(args) -> None:
+    _validate_lane_flags(args, "the sparse engine lane")
+    if args.kernel != "auto":
+        raise ValueError(
+            "--kernel does not apply to the sparse engine lane (the tile "
+            "step is its own kernel family)"
+        )
+
+
+def _parse_universe(spec: str) -> tuple[int, int]:
+    m = re.fullmatch(r"(\d+)x(\d+)", spec)
+    if not m:
+        raise ValueError(f"--universe must look like WxH, got {spec!r}")
+    return int(m.group(1)), int(m.group(2))  # (width, height)
+
+
+def _parse_place(spec: str) -> tuple[int, int]:
+    m = re.fullmatch(r"(-?\d+),(-?\d+)", spec)
+    if not m:
+        raise ValueError(f"--place must look like X,Y, got {spec!r}")
+    return int(m.group(1)), int(m.group(2))  # (x=column, y=row)
+
+
+def _run_sparse(variant, config, board, read_ms, output_path) -> int:
+    """Drive a sparse simulation and write the result as RLE (a giant
+    universe's dense text grid must never be written), keeping the
+    reference's printed contract."""
+    from gol_tpu.sparse import TileMemo, simulate_sparse
+
+    if variant.io_timings:
+        print(f"Reading file:\t{read_ms:.2f} msecs")
+    t0 = time.perf_counter()
+    result = simulate_sparse(board, config, TileMemo())
+    exec_ms = (time.perf_counter() - t0) * 1000
+    comments = (
+        f"generations {result.generations} exit {result.exit_reason}",
+    )
+    return _report_and_write(
+        variant,
+        result.generations,
+        exec_ms,
+        lambda: _write_text(output_path, result.board.to_rle(comments)),
+    )
+
+
+def _write_text(path: str, text: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+
+
+def _run_pattern(args, variant) -> int:
+    """``--pattern FILE [--place X,Y] [--universe WxH]``: the RLE input
+    lane. Board construction is geometry-first — only the tiles the
+    pattern touches are allocated — so the engine choice (``--engine``,
+    default auto: sparse above the area threshold) happens before any
+    canvas could exist."""
+    from gol_tpu.io import rle as rle_codec
+    from gol_tpu.sparse.board import (
+        DEFAULT_TILE,
+        SparseBoard,
+        dense_cells_guard,
+    )
+
+    if args.input_file is not None:
+        raise ValueError("--pattern replaces the input file argument")
+    _validate_lane_flags(args, "the --pattern lane")
+    config = GameConfig(
+        gen_limit=args.gen_limit,
+        check_similarity=not args.no_check_similarity,
+        similarity_frequency=args.similarity_frequency,
+        convention=variant.convention,
+    )
+    t0 = time.perf_counter()
+    with open(args.pattern, "r", encoding="utf-8") as f:
+        pattern = rle_codec.parse(f.read())
+    read_ms = (time.perf_counter() - t0) * 1000
+    ph, pw = pattern.shape
+    if args.universe:
+        width, height = _parse_universe(args.universe)
+    else:
+        width, height = pw, ph
+    x, y = _parse_place(args.place)
+    tile = args.tile or DEFAULT_TILE
+    engine_pick = args.engine
+    if engine_pick == "auto":
+        from gol_tpu.sparse.engine import auto_engine
+
+        engine_pick = auto_engine(height, width, tile)
+    if engine_pick == "sparse":
+        if args.kernel != "auto":
+            raise ValueError(
+                "--kernel does not apply to the sparse engine (the tile "
+                "step is its own kernel family); add --engine dense to "
+                "force the dense lane"
+            )
+        board = SparseBoard.from_pattern(pattern, x, y, height, width, tile)
+        output_path = args.output or "./sparse_output.rle"
+        return _run_sparse(variant, config, board, read_ms, output_path)
+    # Dense engine on a pattern input: materialize (guarded), place, run
+    # the classic device lane.
+    dense_cells_guard(height, width, what="universe")
+    if x < 0 or y < 0 or y + ph > height or x + pw > width:
+        raise ValueError(
+            f"pattern {ph}x{pw} at ({x},{y}) does not fit the "
+            f"{height}x{width} universe"
+        )
+    grid = np.zeros((height, width), np.uint8)
+    grid[y:y + ph, x:x + pw] = pattern
+    if variant.io_timings:
+        print(f"Reading file:\t{read_ms:.2f} msecs")
+    device_grid = engine.put_grid(grid)
+    runner = engine.make_runner((height, width), config, None, args.kernel)
+    compiled = engine.compile_runner(runner, device_grid)
+    t0 = time.perf_counter()
+    final, gen = compiled(device_grid)
+    generations = int(gen)
+    exec_ms = (time.perf_counter() - t0) * 1000
+    output_path = args.output or f"./{variant.output_file}"
+    return _report_and_write(
+        variant,
+        generations,
+        exec_ms,
+        lambda: text_grid.write_grid(output_path,
+                                     np.asarray(final, dtype=np.uint8)),
+    )
+
+
+def _run_sparse_file(args, variant, config, width, height) -> int:
+    """``--engine sparse`` over a dense input file (the A/B lane: the same
+    file the dense engine reads, simulated tile-wise — byte-gating the
+    sparse lane against the dense one from the CLI)."""
+    from gol_tpu.sparse.board import (
+        DEFAULT_TILE,
+        SparseBoard,
+        dense_cells_guard,
+    )
+
+    dense_cells_guard(height, width, what="input file")
+    t0 = time.perf_counter()
+    grid = text_grid.read_grid(args.input_file, width, height)
+    read_ms = (time.perf_counter() - t0) * 1000
+    board = SparseBoard.from_dense(grid, args.tile or DEFAULT_TILE)
+    output_path = args.output or "./sparse_output.rle"
+    return _run_sparse(variant, config, board, read_ms, output_path)
+
+
 def _run_host(args, variant, config, width, height, output_path) -> int:
     """--host: the NumPy oracle path, no accelerator involved.
 
@@ -702,6 +894,9 @@ def _run_host(args, variant, config, width, height, output_path) -> int:
     the Reading/Writing lines of io_timings variants
     (src/game_mpi_collective.c:200-203,447-450) — so host-vs-device output
     is line-for-line comparable."""
+    from gol_tpu.sparse.board import dense_cells_guard
+
+    dense_cells_guard(height, width)
     t0 = time.perf_counter()
     grid = text_grid.read_grid(args.input_file, width, height)
     read_ms = (time.perf_counter() - t0) * 1000
@@ -1669,6 +1864,34 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--gen-limit", type=int, default=GameConfig().gen_limit)
     run.add_argument(
         "--similarity-frequency", type=int, default=GameConfig().similarity_frequency
+    )
+    run.add_argument(
+        "--pattern", default=None, metavar="FILE",
+        help="run an RLE pattern file (Gosper gun, r-pentomino, ...) placed "
+        "into an otherwise-empty --universe instead of reading a dense "
+        "input file — the giant-universe input path: the byte canvas is "
+        "never materialized on the sparse lane",
+    )
+    run.add_argument(
+        "--place", default="0,0", metavar="X,Y",
+        help="top-left cell of the --pattern placement (column X, row Y; "
+        "default 0,0)",
+    )
+    run.add_argument(
+        "--universe", default=None, metavar="WxH",
+        help="universe extents for --pattern (e.g. 65536x65536); defaults "
+        "to the pattern's own RLE extents",
+    )
+    run.add_argument(
+        "--engine", default="auto", choices=("auto", "dense", "sparse"),
+        help="engine family: dense (the classic O(area) lanes), sparse "
+        "(tiled O(live-area) — gol_tpu/sparse), or auto (sparse above "
+        "the area threshold when the extents tile evenly)",
+    )
+    run.add_argument(
+        "--tile", type=int, default=0, metavar="N",
+        help="sparse engine tile edge (default 256); universe extents "
+        "must be multiples of it",
     )
     run.add_argument("--no-check-similarity", action="store_true")
     run.add_argument("--output", default=None, help="override the output file path")

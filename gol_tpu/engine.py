@@ -1812,3 +1812,46 @@ def complete_ring(inflight: InflightRing) -> list[list[BatchBoardResult]]:
             staged, jax.device_get(inflight.finals[i]), gens[i], reasons[i],
         ))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Sparse tile-step runner (the gol_tpu/sparse/ compute entry).
+#
+# The engines above are dense: every lane pays O(width x height) per
+# generation even when the universe is 99.9% dead. The sparse engine
+# decomposes the board into fixed tiles with a live-occupancy index
+# (gol_tpu/sparse/board.py) and simulates only live tiles plus their
+# halo-activated neighbors; what the device runs per generation is this
+# runner — one generation of B halo-extended tiles, batched up the same
+# padding-bucket ladder the serve batcher uses (tiles ARE a bucket: the
+# tile shape is fixed, the batch dimension rounds up the ladder, so a tile
+# size compiles at most one program per rung — the <=7-compiled-programs
+# invariant — and the operand buffer is donated like every batch lane).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def make_tile_step_runner(tile: int, batch: int):
+    """Compile a B-tile halo step: ``(B, tile+2, tile+2) uint8 blocks ->
+    (interiors (B, tile, tile), alive (B,), changed (B,))``.
+
+    One generation per call by design — the halo ring must be re-exchanged
+    (host-side, from the occupancy index) between generations, exactly the
+    per-step halo exchange of the distributed lanes, at tile granularity.
+    Convention-independent: the loop accounting (C vs CUDA, similarity
+    phase, exits) lives entirely in the sparse host loop; a tile step is
+    the same pure function under every convention, which is also what
+    makes it memoizable (gol_tpu/sparse/memo.py).
+    """
+    if tile < 4:
+        raise ValueError(f"tile must be >= 4, got {tile}")
+    if batch < 1:
+        raise ValueError(f"batch must be >= 1, got {batch}")
+    from gol_tpu.ops import stencil_lax
+
+    def fn(blocks):
+        return stencil_lax.evolve_padded_batch(blocks)
+
+    # Donate the halo blocks: the interiors are written over the operand's
+    # pages and every caller stages blocks fresh per dispatch.
+    return jit_donating(fn, donate_argnums=(0,))

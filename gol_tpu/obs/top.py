@@ -106,6 +106,16 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
             f"/disk {int(counters.get('cache_hits_total_disk', 0))})"
             f"  coalesced {int(coalesced)}  misses {int(misses)}"
         )
+    # Sparse lane (only when sparse jobs have run — the counters exist
+    # then): tile-steps executed and the last universe's live-tile
+    # occupancy, the numbers that say how much dead area was elided.
+    sparse_tiles = counters.get("sparse_tiles_simulated_total")
+    if sparse_tiles is not None:
+        occ = gauges.get("sparse_occupancy", 0.0)
+        lines.append(
+            f"  sparse: tiles {int(sparse_tiles)}"
+            f"   occupancy {_bar(occ)} {occ:.4f}"
+        )
 
     # -- rings / dispatch gap ----------------------------------------------
     ring_occ = pgauges.get("ring_slot_occupancy")

@@ -17,6 +17,7 @@ Equivalent of the reference's evolve kernels. Two forms:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -55,3 +56,25 @@ def evolve_padded(padded: jnp.ndarray) -> jnp.ndarray:
         + padded[2:, 2:]
     )
     return _apply_rule(neighbors, center)
+
+
+def evolve_padded_batch(blocks: jnp.ndarray):
+    """One generation over B independent halo-extended blocks, with the
+    per-block flags the sparse tile engine consumes.
+
+    ``blocks`` is (B, h+2, w+2): each block is a tile plus its 1-cell halo
+    ring (assembled host-side from the tile's 8 torus neighbors —
+    gol_tpu/sparse/engine.py). Interior cells read only in-block
+    neighbors, so the step is exact for the interior regardless of what a
+    torus/dead-wall rule would do to the discarded outer ring. Returns
+    ``(interiors, alive, changed)``: the (B, h, w) next interiors plus
+    per-block any-live and interior-changed flags — the two reductions the
+    sparse host loop needs every generation, computed in the same memory
+    pass as the stencil rather than as host-side scans.
+    """
+    def one(block):
+        new = evolve_padded(block)
+        old = block[1:-1, 1:-1]
+        return new, jnp.any(new), jnp.any(new != old)
+
+    return jax.vmap(one)(blocks)

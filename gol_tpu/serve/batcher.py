@@ -48,6 +48,13 @@ PAD_QUANTUM = 32
 BATCH_SIZES = (1, 2, 4, 8, 16, 32, 64)
 MAX_BATCH = BATCH_SIZES[-1]
 
+# The sparse-lane bucket kernel tag (gol_tpu/sparse/): jobs submitted as
+# RLE patterns over giant universes. A sparse bucket's jobs are not
+# stacked into one canvas — each job batches its own active TILES through
+# this module's ladder inside the sparse engine — so the stage/dispatch/
+# complete split below routes sparse keys to gol_tpu/sparse/serve.
+SPARSE_KERNEL = "sparse"
+
 _PLAN = None  # resolved once per process; tests reset via _reset_plan()
 
 
@@ -115,7 +122,20 @@ def bucket_for(job: Job) -> BucketKey:
     shape. The quantum is 32, so every uniform bucket width packs — "byte"
     only arises for hypothetical non-multiple-of-32 quanta, but the routing
     stays honest via ``engine.resolve_batch_mode`` rather than assuming.
+
+    Sparse (RLE) jobs get the sparse bucket of their universe extents —
+    no padding (the extents never reach a compiled program's shape; the
+    tile size does, inside the sparse engine).
     """
+    if job.rle is not None:
+        return BucketKey(
+            height=job.height,
+            width=job.width,
+            convention=job.convention,
+            kernel=SPARSE_KERNEL,
+            check_similarity=job.check_similarity,
+            similarity_frequency=job.similarity_frequency,
+        )
     ph, pw = pad_dim(job.height), pad_dim(job.width)
     mode = engine.resolve_batch_mode([job.height], [job.width], (ph, pw))
     return BucketKey(
@@ -153,6 +173,10 @@ def stage(key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
     here), so the pipelined scheduler runs it while the device computes a
     previous batch. Raises on empty/oversized batches and foreign jobs —
     the same checks ``run_batch`` has always enforced."""
+    if key.kernel == SPARSE_KERNEL:
+        from gol_tpu.sparse import serve as sparse_serve
+
+        return sparse_serve.stage(key, jobs)
     if not jobs:
         raise ValueError("cannot stage an empty batch")
     if len(jobs) > MAX_BATCH:
@@ -183,6 +207,10 @@ def stage(key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
 
 def dispatch(staged: StagedServeBatch) -> InflightServeBatch:
     """Dispatch a staged batch; returns immediately (JAX async dispatch)."""
+    if staged.key.kernel == SPARSE_KERNEL:
+        from gol_tpu.sparse import serve as sparse_serve
+
+        return sparse_serve.dispatch(staged)
     return InflightServeBatch(
         key=staged.key, jobs=staged.jobs,
         inflight=engine.dispatch_batch(staged.staged),
@@ -191,6 +219,10 @@ def dispatch(staged: StagedServeBatch) -> InflightServeBatch:
 
 def complete(inflight: InflightServeBatch) -> list[JobResult]:
     """Block on an in-flight batch and crop per-job results (job order)."""
+    if inflight.key.kernel == SPARSE_KERNEL:
+        from gol_tpu.sparse import serve as sparse_serve
+
+        return sparse_serve.complete(inflight)
     results = engine.complete_batch(inflight.inflight)
     return [
         JobResult(grid=r.grid, generations=r.generations,
@@ -211,6 +243,10 @@ def run_batch(key: BucketKey, jobs: list[Job]) -> list[JobResult]:
     staged split back to back, one thread); the pipelined scheduler calls
     ``stage``/``dispatch``/``complete`` from its own threads instead.
     """
+    if key.kernel == SPARSE_KERNEL:
+        from gol_tpu.sparse import serve as sparse_serve
+
+        return sparse_serve.run_batch(key, jobs)
     if not jobs:
         return []
     if len(jobs) > MAX_BATCH:
@@ -251,6 +287,8 @@ def warm(key: BucketKey, batch: int = MAX_BATCH) -> None:
     """
     import jax.numpy as jnp
 
+    if key.kernel == SPARSE_KERNEL:
+        return  # sparse buckets compile per tile size, not per canvas
     total = pad_batch(min(batch, MAX_BATCH))
     runner = engine.make_batch_runner(
         (key.height, key.width),

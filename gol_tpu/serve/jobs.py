@@ -74,9 +74,14 @@ _TRANSITIONS = {
 
 @dataclasses.dataclass
 class JobResult:
-    """What a finished job hands back (mirrors engine.BatchBoardResult)."""
+    """What a finished job hands back (mirrors engine.BatchBoardResult).
 
-    grid: np.ndarray  # uint8 {0,1}, (height, width)
+    Sparse jobs (gol_tpu/sparse/) answer with ``grid=None`` and the final
+    universe as RLE instead — a giant universe's dense cells must never
+    travel the stack; ``universe`` carries the (height, width) the dense
+    path reads off ``grid.shape``."""
+
+    grid: np.ndarray | None  # uint8 {0,1}, (height, width); None = sparse
     generations: int
     exit_reason: str  # engine.EXIT_REASONS member
     # How the answer was produced: None = the engine ran it; "memory"/"disk"
@@ -91,16 +96,36 @@ class JobResult:
     # responder packs from ``grid`` on demand. Process-local, never
     # journaled (the journal's done records stay text).
     words: np.ndarray | None = None
+    # Sparse-lane result fields (gol_tpu/sparse/): the final universe as
+    # an RLE document + its live-cell count, with the universe extents
+    # (height, width) the dense path reads off ``grid.shape``. RLE and
+    # population are journaled (they ARE the result); the work accounting
+    # below is process-local (serving metrics only — tile-steps executed
+    # and the cell updates they represent, the sparse analog of
+    # height x width x generations).
+    rle: str | None = None
+    population: int | None = None
+    universe: tuple[int, int] | None = None
+    tiles_simulated: int | None = None
+    cell_updates: int | None = None
+    occupancy: float | None = None
 
 
 @dataclasses.dataclass
 class Job:
-    """One simulation request moving through the service."""
+    """One simulation request moving through the service.
+
+    Two input forms: dense (``board`` holds the (height, width) cells —
+    the classic lane) and sparse (``rle`` holds a pattern placed at
+    ``(place_x, place_y)`` in an otherwise-empty ``height x width``
+    universe; ``board`` is None and the job runs on the sparse tiled
+    engine). ``width``/``height`` are the universe extents either way, so
+    routing (fleet placement, bucket keys) reads one vocabulary."""
 
     id: str
     width: int
     height: int
-    board: np.ndarray  # uint8 {0,1}, (height, width)
+    board: np.ndarray | None  # uint8 {0,1}, (height, width); None = sparse
     convention: str = Convention.C
     gen_limit: int = GameConfig().gen_limit
     check_similarity: bool = True
@@ -108,6 +133,15 @@ class Job:
     priority: int = 0  # higher dispatches first within a bucket
     deadline_s: float | None = None  # seconds from acceptance; orders dispatch
     no_cache: bool = False  # opt this submission out of the result cache
+    # Sparse job fields (gol_tpu/sparse/): an RLE pattern document placed
+    # with its top-left cell at column place_x, row place_y of the
+    # universe; tile 0 means the engine default. All journaled — a
+    # replayed sparse job re-runs from exactly this spec (the occupancy
+    # index is rebuilt from it, so replay needs no dense cells).
+    rle: str | None = None
+    place_x: int = 0
+    place_y: int = 0
+    tile: int = 0
     state: str = QUEUED
     # The result-cache key (gol_tpu/cache/fingerprint.py), computed by the
     # scheduler at admission when a cache is mounted; None otherwise (and
@@ -181,12 +215,17 @@ class Job:
             raise ValueError(f"unknown convention: {self.convention!r}")
         if self.deadline_s is not None and self.deadline_s < 0:
             raise ValueError(f"deadline_s must be >= 0, got {self.deadline_s}")
-        self.board = np.ascontiguousarray(np.asarray(self.board, dtype=np.uint8))
-        if self.board.shape != (self.height, self.width):
-            raise ValueError(
-                f"board shape {self.board.shape} does not match declared "
-                f"{self.height}x{self.width}"
+        if self.rle is not None:
+            self._init_sparse()
+        else:
+            self.board = np.ascontiguousarray(
+                np.asarray(self.board, dtype=np.uint8)
             )
+            if self.board.shape != (self.height, self.width):
+                raise ValueError(
+                    f"board shape {self.board.shape} does not match declared "
+                    f"{self.height}x{self.width}"
+                )
         # Retained wire words are a pure staging accelerator: anything that
         # does not exactly match the packed-kernel operand shape is dropped
         # (the board stages through the classic pack), never trusted.
@@ -195,6 +234,42 @@ class Job:
             or self.words.shape != (self.height, self.width // 32)
         ):
             self.words = None
+
+    def _init_sparse(self) -> None:
+        """Validate + pre-parse a sparse (RLE) job at admission: every
+        malformed shape raises here, inside the server's 400 mapping,
+        never on a worker thread. The full byte canvas is NEVER built —
+        only the small pattern array (process-local; replay re-parses)."""
+        from gol_tpu.io import rle as rle_codec
+        from gol_tpu.sparse.board import DEFAULT_TILE, MIN_TILE
+
+        if not isinstance(self.rle, str):
+            raise TypeError(
+                f"rle must be a string, got {type(self.rle).__name__}"
+            )
+        if self.board is not None:
+            raise ValueError("a job carries either cells or rle, not both")
+        self.place_x = int(self.place_x)
+        self.place_y = int(self.place_y)
+        self.tile = int(self.tile)
+        if self.tile == 0:
+            self.tile = DEFAULT_TILE
+        if self.tile < MIN_TILE:
+            raise ValueError(f"tile must be >= {MIN_TILE}, got {self.tile}")
+        if self.height % self.tile or self.width % self.tile:
+            raise ValueError(
+                f"universe {self.height}x{self.width} does not divide into "
+                f"{self.tile}^2 tiles"
+            )
+        self.pattern = rle_codec.parse(self.rle)
+        ph, pw = self.pattern.shape
+        if (self.place_x < 0 or self.place_y < 0
+                or self.place_y + ph > self.height
+                or self.place_x + pw > self.width):
+            raise ValueError(
+                f"pattern {ph}x{pw} at ({self.place_x},{self.place_y}) does "
+                f"not fit the {self.height}x{self.width} universe"
+            )
 
     @property
     def config(self) -> GameConfig:
@@ -230,7 +305,20 @@ class Job:
         return (-self.priority, deadline, self.accepted_at, self.id)
 
     def to_record(self) -> dict:
-        """The journaled (durable) fields — everything needed to re-run."""
+        """The journaled (durable) fields — everything needed to re-run.
+
+        Sparse jobs journal their RLE spec (pattern + placement + tile)
+        instead of dense cells: the occupancy index is a pure function of
+        the spec, so replay rebuilds it without a canvas ever existing."""
+        if self.rle is not None:
+            payload = {
+                "rle": self.rle,
+                "x": self.place_x,
+                "y": self.place_y,
+                "tile": self.tile,
+            }
+        else:
+            payload = {"cells": text_grid.encode(self.board).decode("ascii")}
         return {
             "id": self.id,
             "width": self.width,
@@ -241,7 +329,7 @@ class Job:
             "similarity_frequency": self.similarity_frequency,
             "priority": self.priority,
             "deadline_s": self.deadline_s,
-            "cells": text_grid.encode(self.board).decode("ascii"),
+            **payload,
             # Only when set: default-path submit records stay byte-stable,
             # and old journals replay with the default (cache allowed).
             **({"no_cache": True} if self.no_cache else {}),
@@ -249,14 +337,24 @@ class Job:
 
     @classmethod
     def from_record(cls, rec: dict) -> "Job":
-        board = text_grid.decode(
+        sparse = "rle" in rec
+        board = None if sparse else text_grid.decode(
             rec["cells"].encode("ascii"), rec["width"], rec["height"]
         )
+        extra = {}
+        if sparse:
+            extra = {
+                "rle": rec["rle"],
+                "place_x": rec.get("x", 0),
+                "place_y": rec.get("y", 0),
+                "tile": rec.get("tile", 0),
+            }
         return cls(
             id=rec["id"],
             width=rec["width"],
             height=rec["height"],
             board=board,
+            **extra,
             convention=rec.get("convention", Convention.C),
             gen_limit=rec.get("gen_limit", GameConfig().gen_limit),
             check_similarity=rec.get("check_similarity", True),
@@ -345,6 +443,21 @@ class JobJournal:
     @staticmethod
     def _done_record(job: Job) -> dict:
         r = job.result
+        if r.grid is None:
+            # Sparse result: the final universe travels as RLE (O(live
+            # runs) — a 2^16-square answer must never be journaled dense).
+            h, w = r.universe
+            return {
+                "event": "done",
+                "id": job.id,
+                "generations": r.generations,
+                "exit_reason": r.exit_reason,
+                "width": int(w),
+                "height": int(h),
+                "rle": r.rle,
+                "population": int(r.population or 0),
+                **({"cached": r.cached} if r.cached else {}),
+            }
         return {
             "event": "done",
             "id": job.id,
@@ -417,17 +530,28 @@ class JobJournal:
                         job = Job.from_record(rec["job"])
                         pending[job.id] = job
                     elif event == "done":
-                        grid = text_grid.decode(
-                            rec["grid"].encode("ascii"),
-                            rec["width"],
-                            rec["height"],
-                        )
-                        results[rec["id"]] = JobResult(
-                            grid=grid,
-                            generations=rec["generations"],
-                            exit_reason=rec["exit_reason"],
-                            cached=rec.get("cached"),
-                        )
+                        if "rle" in rec:
+                            results[rec["id"]] = JobResult(
+                                grid=None,
+                                generations=rec["generations"],
+                                exit_reason=rec["exit_reason"],
+                                rle=rec["rle"],
+                                population=rec.get("population"),
+                                universe=(rec["height"], rec["width"]),
+                                cached=rec.get("cached"),
+                            )
+                        else:
+                            grid = text_grid.decode(
+                                rec["grid"].encode("ascii"),
+                                rec["width"],
+                                rec["height"],
+                            )
+                            results[rec["id"]] = JobResult(
+                                grid=grid,
+                                generations=rec["generations"],
+                                exit_reason=rec["exit_reason"],
+                                cached=rec.get("cached"),
+                            )
                         pending.pop(rec["id"], None)
                     elif event == "failed":
                         failed[rec["id"]] = rec.get("error", "")

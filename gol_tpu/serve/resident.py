@@ -240,10 +240,17 @@ class ResidentEngine:
     def stage(self, key: BucketKey, jobs: list[Job]) -> StagedServeBatch:
         return batcher.stage(key, jobs)
 
-    def dispatch(self, sstaged: StagedServeBatch) -> RingTicket:
+    def dispatch(self, sstaged: StagedServeBatch):
+        # Sparse buckets have no ring lane (their tile batching lives in
+        # the sparse engine): they take the plain batcher split, so a
+        # resident server serves sparse jobs through the same scheduler.
+        if sstaged.key.kernel == batcher.SPARSE_KERNEL:
+            return batcher.dispatch(sstaged)
         return self._lane(sstaged.key).submit(sstaged)
 
-    def complete(self, ticket: RingTicket) -> list[JobResult]:
+    def complete(self, ticket) -> list[JobResult]:
+        if not isinstance(ticket, RingTicket):
+            return batcher.complete(ticket)
         results = ticket.lane.complete(ticket)
         return [
             JobResult(grid=r.grid, generations=r.generations,

@@ -282,6 +282,8 @@ class GolServer:
     # -- request-level operations (handler methods stay thin) -------------
 
     def submit_json(self, body: dict, trace_header: str | None = None) -> dict:
+        if "rle" in body:
+            return self._submit_sparse(body, trace_header)
         required = ("width", "height", "cells")
         missing = [k for k in required if k not in body]
         if missing:
@@ -292,6 +294,41 @@ class GolServer:
         board = _decode_cells(body["cells"], width, height)
         return self._submit_board(board, None, width, height, body,
                                   trace_header)
+
+    def _submit_sparse(self, body: dict,
+                       trace_header: str | None = None) -> dict:
+        """``POST /jobs`` with an ``rle`` field: a sparse job — a pattern
+        placed at (``x``, ``y``) of an otherwise-empty ``width x height``
+        universe, run on the sparse tiled engine. Same contract shape as a
+        dense submit (202 + id); the full canvas never exists anywhere."""
+        required = ("width", "height", "rle")
+        missing = [k for k in required if k not in body]
+        if missing:
+            raise ValueError(f"missing required field(s): {missing}")
+        if "cells" in body:
+            raise ValueError("a job carries either cells or rle, not both")
+        width, height = int(body["width"]), int(body["height"])
+        if width <= 0 or height <= 0:
+            raise ValueError(f"dimensions must be positive, got {height}x{width}")
+        kwargs = {}
+        for field in (
+            "convention", "gen_limit", "check_similarity",
+            "similarity_frequency", "priority", "no_cache",
+        ):
+            if field in body:
+                kwargs[field] = body[field]
+        if body.get("deadline_s") is not None:
+            kwargs["deadline_s"] = float(body["deadline_s"])
+        job = new_job(
+            width, height, None,
+            rle=body["rle"],
+            place_x=body.get("x", 0),
+            place_y=body.get("y", 0),
+            tile=body.get("tile", 0),
+            **kwargs,
+        )
+        self.metrics.inc("sparse_submits_total")
+        return self._admit(job, trace_header)
 
     def submit_packed(self, raw: bytes,
                       trace_header: str | None = None) -> dict:
@@ -331,12 +368,19 @@ class GolServer:
         if body.get("deadline_s") is not None:
             kwargs["deadline_s"] = float(body["deadline_s"])
         job = new_job(width, height, board, words=words, **kwargs)
-        # Trace-context adoption (obs/propagate.py): a router forwarding
-        # under `--trace` stamps X-Gol-Trace; when tracing is enabled HERE
-        # too, the job's flow events ride the fleet-wide id and chain onto
-        # the router's trace. Tracing disabled (the default) never looks at
-        # the header — an old client (no header) and a headered forward are
-        # byte-identical through this path, response included (test-pinned).
+        return self._admit(job, trace_header)
+
+    def _admit(self, job, trace_header: str | None) -> dict:
+        """Trace adoption + scheduler admission (shared by the dense text,
+        packed wire, and sparse RLE submit lanes).
+
+        Trace-context adoption (obs/propagate.py): a router forwarding
+        under `--trace` stamps X-Gol-Trace; when tracing is enabled HERE
+        too, the job's flow events ride the fleet-wide id and chain onto
+        the router's trace. Tracing disabled (the default) never looks at
+        the header — an old client (no header) and a headered forward are
+        byte-identical through this path, response included (test-pinned).
+        """
         if trace_header is not None and obs_trace.enabled():
             ctx = obs_propagate.decode(trace_header)
             if ctx is not None:
@@ -405,6 +449,20 @@ class GolServer:
         """(status_code, payload) for GET /result/<id>."""
         job, result = self._find_result(job_id)
         if result is not None:
+            if result.grid is None:
+                # Sparse result: the universe answers as RLE (O(live runs)
+                # — never dense), plus its live-cell count.
+                h, w = result.universe
+                return 200, {
+                    "id": job_id,
+                    "generations": result.generations,
+                    "exit_reason": result.exit_reason,
+                    "width": int(w),
+                    "height": int(h),
+                    "rle": result.rle,
+                    "population": int(result.population or 0),
+                    **({"cached": result.cached} if result.cached else {}),
+                }
             return 200, {
                 "id": job_id,
                 "generations": result.generations,
@@ -436,7 +494,10 @@ class GolServer:
         or (status, JSON payload) on every non-200 (errors stay JSON for
         all clients)."""
         _job, result = self._find_result(job_id)
-        if result is None:
+        if result is None or result.grid is None:
+            # No result yet, or a sparse (RLE) result — a giant universe
+            # has no packed-frame form; clients parse by response
+            # content type, so the JSON answer degrades transparently.
             return self.result_json(job_id)
         meta = {
             "id": job_id,

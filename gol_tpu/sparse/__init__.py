@@ -1,0 +1,22 @@
+"""Sparse tiled engine: O(live-area) simulation for giant universes.
+
+- ``board``  — the tiled occupancy index (numpy-only, geometry-first)
+- ``engine`` — the host loop: activation, halo assembly, batched tile steps
+- ``memo``   — tile-result memoization on the PR-9 CAS machinery
+- ``serve``  — the sparse job lane of the serving stack
+"""
+
+from gol_tpu.sparse.board import (  # noqa: F401
+    DEFAULT_TILE,
+    MAX_DENSE_CELLS,
+    SparseBoard,
+    dense_cells_guard,
+)
+from gol_tpu.sparse.engine import (  # noqa: F401
+    SPARSE_AUTO_AREA,
+    SparseResult,
+    SparseStats,
+    auto_engine,
+    simulate_sparse,
+)
+from gol_tpu.sparse.memo import TileMemo  # noqa: F401

@@ -183,3 +183,16 @@ def test_no_wall_clock_in_engine():
             f"wall-clock {needle} in gol_tpu/engine.py (use "
             f"time.perf_counter() on every serving path): {offenders}"
         )
+
+
+def test_no_wall_clock_in_sparse():
+    """Same rule for gol_tpu/sparse/: the sparse engine sits on the serve
+    dispatch path (sparse buckets ride the scheduler) and its run stats
+    feed the serving work series — any timing it ever grows must be
+    ``time.perf_counter()`` only, like every other serving-path package."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "sparse", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/sparse/ (use "
+            f"time.perf_counter() for any timing path): {offenders}"
+        )

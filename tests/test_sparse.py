@@ -1,0 +1,585 @@
+"""Sparse tiled engine (gol_tpu/sparse) + RLE codec (io/rle) tests.
+
+The acceptance surface of ISSUE 12:
+
+- tile activation/elision correctness at every tile boundary (the glider
+  crossing a tile corner is the canonical trap);
+- sparse-vs-dense byte-identity — cells, generation count, exit reason —
+  on overlapping shapes for BOTH conventions, all three exit reasons;
+- occupancy-index replay through the journal machinery (a replayed
+  sparse job re-runs from its RLE spec to an identical result);
+- tile-memo hits byte-identical to memo-disabled runs;
+- RLE round-trips and golden patterns.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu import engine, oracle
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.io import rle
+from gol_tpu.serve import batcher
+from gol_tpu.serve.jobs import DONE, Job, JobJournal, JobResult, new_job
+from gol_tpu.serve.scheduler import Scheduler
+from gol_tpu.sparse import (
+    SparseBoard,
+    TileMemo,
+    auto_engine,
+    dense_cells_guard,
+    simulate_sparse,
+)
+from gol_tpu.sparse import engine as sparse_engine
+
+GLIDER_RLE = "x = 3, y = 3, rule = B3/S23\nbob$2bo$3o!"
+GLIDER = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8)
+
+GOSPER_RLE = """#N Gosper glider gun
+x = 36, y = 9, rule = B3/S23
+24bo$22bobo$12b2o6b2o12b2o$11bo3bo4b2o12b2o$2o8bo5bo3b2o$2o8bo3bob2o4b
+obo$10bo5bo7bo$11bo3bo$12b2o!"""
+
+CONVENTIONS = (Convention.C, Convention.CUDA)
+
+
+def _assert_matches_dense(grid, config, tile, memo=None):
+    """The byte-gate: sparse vs oracle AND vs the dense engine — cells,
+    generation count, and (via the engine's batch lane) exit reason."""
+    ref = oracle.run(grid.copy(), config)
+    board = SparseBoard.from_dense(grid, tile)
+    result = simulate_sparse(board, config, memo)
+    assert result.generations == ref.generations
+    assert np.array_equal(result.board.to_dense(), ref.grid)
+    # Exit reason against the batched engine's per-board classification.
+    [batch] = engine.simulate_batch([grid.copy()], [config])
+    assert result.exit_reason == batch.exit_reason
+    assert result.generations == batch.generations
+    assert np.array_equal(result.board.to_dense(), batch.grid)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# RLE codec
+# ---------------------------------------------------------------------------
+
+
+class TestRle:
+    def test_glider_golden(self):
+        assert np.array_equal(rle.parse(GLIDER_RLE), GLIDER)
+
+    def test_gosper_gun_golden(self):
+        gun = rle.parse(GOSPER_RLE)
+        assert gun.shape == (9, 36)
+        assert int(gun.sum()) == 36
+
+    def test_r_pentomino_golden(self):
+        pent = rle.parse("x = 3, y = 3, rule = B3/S23\nb2o$2o$bo!")
+        assert np.array_equal(
+            pent, np.array([[0, 1, 1], [1, 1, 0], [0, 1, 0]], np.uint8)
+        )
+
+    def test_round_trip_random(self):
+        rng = np.random.default_rng(3)
+        for _ in range(10):
+            h, w = rng.integers(1, 40, size=2)
+            grid = (rng.random((h, w)) < 0.3).astype(np.uint8)
+            assert np.array_equal(rle.parse(rle.encode(grid)), grid)
+
+    def test_round_trip_empty_and_full(self):
+        for grid in (np.zeros((5, 7), np.uint8), np.ones((5, 7), np.uint8)):
+            assert np.array_equal(rle.parse(rle.encode(grid)), grid)
+
+    def test_missing_count_means_one_and_short_rows_pad(self):
+        grid = rle.parse("x = 4, y = 2, rule = B3/S23\no$2bo!")
+        assert np.array_equal(
+            grid, np.array([[1, 0, 0, 0], [0, 0, 1, 0]], np.uint8)
+        )
+
+    def test_non_b3s23_rule_rejected(self):
+        with pytest.raises(ValueError, match="B3/S23"):
+            rle.parse("x = 3, y = 3, rule = B36/S23\n3o!")
+
+    def test_legacy_rule_spelling_accepted(self):
+        assert rle.parse("x = 1, y = 1, rule = 23/3\no!").sum() == 1
+
+    def test_overrun_rejected(self):
+        with pytest.raises(ValueError, match="overruns"):
+            rle.parse("x = 2, y = 1, rule = B3/S23\n3o!")
+        with pytest.raises(ValueError, match="overruns"):
+            rle.parse("x = 3, y = 1, rule = B3/S23\n3o$3o!")
+
+    def test_garbage_token_rejected(self):
+        with pytest.raises(ValueError, match="malformed"):
+            rle.parse("x = 3, y = 1, rule = B3/S23\n3;!")
+
+    def test_missing_header_rejected(self):
+        with pytest.raises(ValueError, match="header"):
+            rle.parse("#C no header here\n")
+
+    def test_dense_parse_cap(self):
+        with pytest.raises(ValueError, match="cap"):
+            rle.parse("x = 100000, y = 100000, rule = B3/S23\no!")
+
+    def test_line_wrap_under_70_columns(self):
+        rng = np.random.default_rng(9)
+        grid = (rng.random((60, 60)) < 0.5).astype(np.uint8)
+        text = rle.encode(grid)
+        assert all(len(line) <= 70 for line in text.splitlines())
+        assert np.array_equal(rle.parse(text), grid)
+
+
+# ---------------------------------------------------------------------------
+# SparseBoard
+# ---------------------------------------------------------------------------
+
+
+class TestSparseBoard:
+    def test_from_dense_round_trip(self):
+        rng = np.random.default_rng(1)
+        grid = (rng.random((24, 32)) < 0.3).astype(np.uint8)
+        board = SparseBoard.from_dense(grid, tile=8)
+        assert np.array_equal(board.to_dense(), grid)
+
+    def test_dead_tiles_elided(self):
+        grid = np.zeros((32, 32), np.uint8)
+        grid[0, 0] = 1  # one live cell -> one live tile
+        board = SparseBoard.from_dense(grid, tile=8)
+        assert board.live_tiles == 1
+        assert board.occupancy() == 1 / 16
+        assert board.population() == 1
+
+    def test_invariant_no_dead_tiles_stored(self):
+        board = SparseBoard(32, 32, 8)
+        board.set_tile((1, 1), np.zeros((8, 8), np.uint8))
+        assert board.live_tiles == 0
+
+    def test_place_spans_tile_boundaries(self):
+        board = SparseBoard(32, 32, 8)
+        board.place(GLIDER, 6, 6)  # straddles 4 tiles at the 8x8 corner
+        assert board.live_tiles == 4
+        dense = np.zeros((32, 32), np.uint8)
+        dense[6:9, 6:9] = GLIDER
+        assert np.array_equal(board.to_dense(), dense)
+
+    def test_place_out_of_bounds_rejected(self):
+        board = SparseBoard(16, 16, 8)
+        with pytest.raises(ValueError, match="does not fit"):
+            board.place(GLIDER, 14, 0)
+
+    def test_indivisible_universe_rejected(self):
+        with pytest.raises(ValueError, match="divide"):
+            SparseBoard(30, 32, 8)
+
+    def test_rle_round_trip_sparse(self):
+        board = SparseBoard(64, 64, 8)
+        board.place(rle.parse(GOSPER_RLE), 10, 20)
+        board.place(GLIDER, 50, 3)
+        again = SparseBoard.from_rle(board.to_rle(), 64, 64, 8)
+        assert again == board
+
+    def test_giant_universe_never_dense(self):
+        board = SparseBoard.from_pattern(GLIDER, 60000, 60000,
+                                         1 << 16, 1 << 16, 256)
+        assert board.population() == 5
+        assert board.live_tiles <= 4
+        text = board.to_rle()
+        assert SparseBoard.from_rle(text, 1 << 16, 1 << 16, 256) == board
+        with pytest.raises(ValueError, match="ceiling"):
+            board.to_dense()
+
+    def test_from_rle_content_must_fit_universe(self):
+        """Review regression: explicit universe extents smaller than the
+        RLE header's must reject, never write phantom out-of-grid tiles."""
+        doc = "x = 16, y = 16, rule = B3/S23\n12$12bo!"
+        with pytest.raises(ValueError, match="does not fit"):
+            SparseBoard.from_rle(doc, 8, 8, 4)
+        with pytest.raises(ValueError, match="does not fit"):
+            SparseBoard.from_rle(GLIDER_RLE, 8, 8, 4, x=7)
+
+    def test_memory_lru_byte_bound(self):
+        """Review regression: the tile memo's memory tier is byte-bounded
+        (an entry count alone is no memory bound when entries are 64 KB
+        tile interiors)."""
+        from gol_tpu.cache.store import CacheEntry, MemoryLRU
+
+        lru = MemoryLRU(max_entries=1000, max_bytes=300)
+        for i in range(10):
+            lru.put(f"k{i}", CacheEntry(
+                grid=np.zeros((10, 10), np.uint8),  # 100 bytes each
+                generations=0, exit_reason="tile",
+            ))
+        assert lru.grid_bytes <= 300
+        assert len(lru) == 3
+        assert lru.get("k9") is not None  # newest survive
+        assert lru.get("k0") is None
+        lru.pop("k9")
+        assert lru.grid_bytes == 200
+
+    def test_dense_cells_guard_message(self):
+        with pytest.raises(ValueError, match="sparse lane"):
+            dense_cells_guard(1 << 16, 1 << 16)
+        dense_cells_guard(1024, 1024)  # small boards pass
+
+
+# ---------------------------------------------------------------------------
+# Sparse engine: byte-identity vs dense on overlapping shapes
+# ---------------------------------------------------------------------------
+
+
+class TestSparseEngine:
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_glider_crosses_tile_corner(self, convention):
+        """The canonical trap: a glider's leading cell touches a tile
+        corner, so the diagonal neighbor must activate through the corner
+        halo cell. 300 generations crosses every 8-cell boundary of a
+        64x64 universe many times (with toroidal wrap)."""
+        grid = np.zeros((64, 64), np.uint8)
+        grid[1:4, 1:4] = GLIDER
+        cfg = GameConfig(gen_limit=300, convention=convention)
+        result = _assert_matches_dense(grid, cfg, tile=8)
+        assert result.exit_reason == "gen_limit"
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_all_three_exit_reasons(self, convention):
+        # gen_limit: a glider never stabilizes
+        g = np.zeros((32, 32), np.uint8)
+        g[1:4, 1:4] = GLIDER
+        r = _assert_matches_dense(
+            g, GameConfig(gen_limit=40, convention=convention), tile=8)
+        assert r.exit_reason == "gen_limit"
+        # similar: a still-life block
+        g = np.zeros((16, 16), np.uint8)
+        g[4:6, 4:6] = 1
+        r = _assert_matches_dense(
+            g, GameConfig(gen_limit=40, convention=convention), tile=8)
+        assert r.exit_reason == "similar"
+        # empty: a lone cell dies
+        g = np.zeros((16, 16), np.uint8)
+        g[3, 3] = 1
+        r = _assert_matches_dense(
+            g, GameConfig(gen_limit=40, convention=convention), tile=8)
+        assert r.exit_reason == "empty"
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_soup_byte_identity(self, convention):
+        rng = np.random.default_rng(11)
+        grid = (rng.random((24, 24)) < 0.4).astype(np.uint8)
+        _assert_matches_dense(
+            grid, GameConfig(gen_limit=60, convention=convention), tile=8)
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_single_tile_universe_self_wraps(self, convention):
+        """A one-tile universe's halo wraps onto itself — the tile-grid
+        torus degenerates to the dense torus exactly."""
+        grid = np.zeros((8, 8), np.uint8)
+        grid[0:3, 0:3] = GLIDER
+        _assert_matches_dense(
+            grid, GameConfig(gen_limit=50, convention=convention), tile=8)
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_wrap_across_universe_edge(self, convention):
+        """Live cells on the universe boundary: tile halos must wrap to
+        the opposite side, including both corners."""
+        grid = np.zeros((16, 24), np.uint8)
+        grid[0, 0] = grid[0, 23] = grid[15, 0] = grid[15, 23] = 1
+        grid[0, 1] = grid[1, 0] = grid[15, 22] = 1
+        _assert_matches_dense(
+            grid, GameConfig(gen_limit=20, convention=convention), tile=8)
+
+    def test_similarity_disabled(self):
+        g = np.zeros((16, 16), np.uint8)
+        g[4:6, 4:6] = 1  # block would similar-exit; without the check it
+        r = _assert_matches_dense(  # must run to the limit
+            g, GameConfig(gen_limit=25, check_similarity=False), tile=8)
+        assert r.exit_reason == "gen_limit"
+        assert r.generations == 25
+
+    def test_gen_limit_zero(self):
+        g = np.zeros((16, 16), np.uint8)
+        g[4:6, 4:6] = 1
+        for convention in CONVENTIONS:
+            _assert_matches_dense(
+                g, GameConfig(gen_limit=0, convention=convention), tile=8)
+
+    def test_dead_interior_tile_elided(self):
+        """A dead tile with no live-ring neighbor is never simulated: the
+        glider sits in one corner tile, so per-generation active tiles
+        stay far below the 16-tile total."""
+        grid = np.zeros((32, 32), np.uint8)
+        grid[9:12, 9:12] = GLIDER  # interior of tile (1,1)
+        board = SparseBoard.from_dense(grid, tile=8)
+        result = simulate_sparse(board, GameConfig(gen_limit=4))
+        # 4 generations of a glider touch at most a few tiles each step,
+        # never all 16 — elision is doing its job.
+        assert result.stats.tiles_active < 4 * 8
+        assert result.stats.tiles_per_generation() < 8
+
+    def test_activation_only_on_live_ring(self):
+        """A live blob strictly interior to its tile (no ring cells) must
+        not wake any neighbor."""
+        grid = np.zeros((32, 32), np.uint8)
+        grid[3:5, 3:5] = 1  # block, interior of tile (0,0)
+        board = SparseBoard.from_dense(grid, tile=8)
+        active = sparse_engine._active_set(board)
+        assert active == {(0, 0)}
+
+    def test_activation_corner(self):
+        """A live cell ON a tile corner wakes all 8 neighbors (the
+        diagonal neighbor sees it only through the corner halo cell)."""
+        grid = np.zeros((32, 32), np.uint8)
+        grid[15, 15] = 1  # bottom-right corner cell of tile (1, 1)
+        board = SparseBoard.from_dense(grid, tile=8)
+        active = sparse_engine._active_set(board)
+        assert active == {(ty, tx) for ty in (0, 1, 2) for tx in (0, 1, 2)}
+
+    def test_auto_engine_threshold(self):
+        assert auto_engine(1 << 13, 1 << 13, 256) == "sparse"
+        assert auto_engine(1 << 16, 1 << 16, 256) == "sparse"
+        assert auto_engine(512, 512, 256) == "dense"
+        # Indivisible extents stay dense even above the threshold.
+        assert auto_engine((1 << 13) + 1, 1 << 13, 256) == "dense"
+
+
+# ---------------------------------------------------------------------------
+# Tile memo
+# ---------------------------------------------------------------------------
+
+
+class TestTileMemo:
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_memo_hits_byte_identical(self, convention):
+        """The central memo gate: a memo'd run's bytes — cells, count,
+        exit — equal a memo-disabled run's, while the memo visibly
+        absorbs kernel dispatches."""
+        rng = np.random.default_rng(5)
+        grid = (rng.random((24, 24)) < 0.35).astype(np.uint8)
+        cfg = GameConfig(gen_limit=50, convention=convention)
+        bare = simulate_sparse(SparseBoard.from_dense(grid, 8), cfg)
+        memo = TileMemo(entries=4096)
+        memod = simulate_sparse(SparseBoard.from_dense(grid, 8), cfg, memo)
+        assert memod.generations == bare.generations
+        assert memod.exit_reason == bare.exit_reason
+        assert memod.board == bare.board
+        # A second identical run is almost entirely memo hits.
+        again = simulate_sparse(SparseBoard.from_dense(grid, 8), cfg, memo)
+        assert again.board == bare.board
+        assert again.stats.tiles_computed < bare.stats.tiles_computed
+        assert again.stats.memo_hits > 0
+
+    def test_repeated_pattern_stamps_hit(self):
+        """Identical tile content ANYWHERE on the board shares memo
+        entries: two far-apart glider stamps cost ~one stamp's kernels."""
+        cfg = GameConfig(gen_limit=8)
+        memo = TileMemo(entries=4096)
+        board = SparseBoard(64, 64, 8)
+        board.place(GLIDER, 9, 9)    # interior of tile (1,1)
+        board.place(GLIDER, 41, 41)  # same intra-tile offset in (5,5)
+        result = simulate_sparse(board, cfg, memo)
+        assert result.stats.memo_hits > 0
+        assert result.stats.tiles_computed < result.stats.tiles_active
+
+    def test_memo_disk_tier_round_trip(self, tmp_path):
+        block = np.zeros((10, 10), np.uint8)
+        block[4, 4] = block[4, 5] = block[5, 4] = 1
+        memo = TileMemo(entries=4, cas_dir=str(tmp_path))
+        key = TileMemo.key(block, 8)
+        from gol_tpu.sparse.memo import TileStep
+
+        interior = np.ones((8, 8), np.uint8)
+        memo.put(key, TileStep(interior=interior, alive=True, changed=False))
+        # A fresh memo over the same directory serves from the CAS tier.
+        memo2 = TileMemo(entries=4, cas_dir=str(tmp_path))
+        hit = memo2.get(key)
+        assert hit is not None
+        assert hit.alive is True and hit.changed is False
+        assert np.array_equal(hit.interior, interior)
+
+    def test_memo_key_scoped_by_tile_size(self):
+        block = np.zeros((10, 10), np.uint8)
+        assert TileMemo.key(block, 8) != TileMemo.key(block, 16)
+
+
+# ---------------------------------------------------------------------------
+# Serve lane: sparse jobs through the scheduler + journal replay
+# ---------------------------------------------------------------------------
+
+
+def _sparse_job(**over):
+    spec = dict(rle=GLIDER_RLE, place_x=5, place_y=9, tile=8, gen_limit=40)
+    spec.update(over)
+    return new_job(64, 64, None, **spec)
+
+
+def _await(jobs, timeout=60):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if all(j.state == DONE for j in jobs):
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"jobs stuck: {[(j.id, j.state, j.error) for j in jobs]}"
+    )
+
+
+class TestSparseServe:
+    def test_bucket_key_is_sparse(self):
+        job = _sparse_job()
+        key = batcher.bucket_for(job)
+        assert key.kernel == batcher.SPARSE_KERNEL
+        assert (key.height, key.width) == (64, 64)
+
+    def test_scheduler_runs_sparse_job(self):
+        sched = Scheduler(flush_age=0.01)
+        sched.start()
+        try:
+            job = sched.submit(_sparse_job())
+            _await([job])
+        finally:
+            sched.stop()
+        ref_grid = np.zeros((64, 64), np.uint8)
+        ref_grid[9:12, 5:8] = GLIDER
+        ref = oracle.run(ref_grid, GameConfig(gen_limit=40))
+        got = SparseBoard.from_rle(job.result.rle, 64, 64, 8)
+        assert np.array_equal(got.to_dense(), ref.grid)
+        assert job.result.generations == ref.generations
+        assert job.result.grid is None
+        assert job.result.population == 5
+        assert job.result.tiles_simulated > 0
+
+    def test_mixed_sparse_and_dense_buckets(self):
+        rng = np.random.default_rng(2)
+        dense_board = (rng.random((32, 32)) < 0.4).astype(np.uint8)
+        sched = Scheduler(flush_age=0.01)
+        sched.start()
+        try:
+            sparse = sched.submit(_sparse_job())
+            dense = sched.submit(new_job(32, 32, dense_board, gen_limit=30))
+            _await([sparse, dense])
+        finally:
+            sched.stop()
+        ref = oracle.run(dense_board.copy(), GameConfig(gen_limit=30))
+        assert np.array_equal(dense.result.grid, ref.grid)
+        assert sparse.result.rle is not None
+
+    def test_sparse_serving_metrics(self):
+        sched = Scheduler(flush_age=0.01)
+        sched.start()
+        try:
+            job = sched.submit(_sparse_job())
+            _await([job])
+        finally:
+            sched.stop()
+        counters = sched.metrics.snapshot()["counters"]
+        gauges = sched.metrics.snapshot()["gauges"]
+        assert counters["sparse_tiles_simulated_total"] > 0
+        assert 0 < gauges["sparse_occupancy"] <= 1
+        # Achieved work counts tiles x tile-area, not universe x gens.
+        assert counters["serve_cell_updates_total"] == \
+            job.result.cell_updates
+
+    def test_sparse_job_not_result_cached(self):
+        from gol_tpu.cache import ResultCache
+
+        sched = Scheduler(flush_age=0.01, cache=ResultCache(memory_entries=8))
+        sched.start()
+        try:
+            a = sched.submit(_sparse_job())
+            _await([a])
+            b = sched.submit(_sparse_job())
+            _await([b])
+        finally:
+            sched.stop()
+        assert a.fingerprint is None and b.fingerprint is None
+        assert b.result.cached is None
+        # Same answer both times regardless.
+        assert a.result.rle == b.result.rle
+
+    def test_occupancy_index_replay_via_journal(self, tmp_path):
+        """The SIGKILL-shaped replay: a journaled-but-unfinished sparse
+        job replays from its RLE spec (the occupancy index is rebuilt
+        from the record — no dense cells anywhere in the journal) and
+        re-runs to a byte-identical result."""
+        journal = JobJournal(str(tmp_path))
+        # "Crash" before any worker ran: submit into a scheduler that is
+        # never started, so only the submit record lands.
+        sched = Scheduler(journal=journal, flush_age=0.01)
+        job = sched.submit(_sparse_job())
+        journal.close()
+        # Verify the journal record carries the spec, not cells.
+        with open(journal.path, encoding="utf-8") as f:
+            rec = json.loads(f.readline())
+        assert rec["event"] == "submit"
+        assert rec["job"]["rle"] == GLIDER_RLE
+        assert "cells" not in rec["job"]
+        # Restart: replay hands the job back; a fresh scheduler re-runs it.
+        journal2 = JobJournal(str(tmp_path))
+        replay = journal2.replay()
+        assert [j.id for j in replay.pending] == [job.id]
+        sched2 = Scheduler(journal=journal2, flush_age=0.01)
+        sched2.resubmit_replayed(replay.pending)
+        sched2.start()
+        try:
+            replayed = sched2.job(job.id)
+            _await([replayed])
+        finally:
+            sched2.stop()
+        # Identical to a direct sparse run of the same spec.
+        direct = simulate_sparse(
+            SparseBoard.from_pattern(GLIDER, 5, 9, 64, 64, 8),
+            GameConfig(gen_limit=40),
+        )
+        assert replayed.result.rle == direct.board.to_rle()
+        assert replayed.result.generations == direct.generations
+        # And the done record replays as a sparse result on a THIRD boot.
+        journal2.close()
+        journal3 = JobJournal(str(tmp_path))
+        replay3 = journal3.replay()
+        journal3.close()
+        assert replay3.pending == []
+        restored = replay3.results[job.id]
+        assert restored.grid is None
+        assert restored.rle == replayed.result.rle
+        assert restored.universe == (64, 64)
+
+    def test_sparse_job_validation(self):
+        with pytest.raises(ValueError, match="divide"):
+            _sparse_job(tile=7)
+        with pytest.raises(ValueError, match="does not fit"):
+            _sparse_job(place_x=63)
+        with pytest.raises(TypeError, match="string"):
+            _sparse_job(rle=7)
+        with pytest.raises(ValueError, match="either cells or rle"):
+            Job(id="x", width=64, height=64,
+                board=np.zeros((64, 64), np.uint8), rle=GLIDER_RLE)
+        with pytest.raises(ValueError, match="B3/S23"):
+            _sparse_job(rle="x = 3, y = 3, rule = B36/S23\n3o!")
+
+
+# ---------------------------------------------------------------------------
+# JobResult plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestSparseJobResult:
+    def test_done_record_round_trip(self, tmp_path):
+        journal = JobJournal(str(tmp_path))
+        job = _sparse_job()
+        job.transition("scheduled")
+        job.transition("running")
+        job.result = JobResult(
+            grid=None, generations=7, exit_reason="gen_limit",
+            rle="x = 64, y = 64, rule = B3/S23\n!", population=0,
+            universe=(64, 64),
+        )
+        job.transition(DONE)
+        journal.record_done(job)
+        journal.close()
+        replay = JobJournal(str(tmp_path)).replay()
+        got = replay.results[job.id]
+        assert got.grid is None
+        assert got.rle == job.result.rle
+        assert got.population == 0
+        assert got.universe == (64, 64)
+        assert got.generations == 7

@@ -1,0 +1,225 @@
+"""Sparse-engine smoke: tile-boundary byte-gate + SIGKILL auto-resume.
+
+The `make sparse-smoke` harness, exercising ISSUE 12's two end-to-end
+acceptance behaviors against real processes:
+
+1. **Glider flight across tile boundaries** — a glider crosses >= 4 tile
+   boundaries (64x64 universe, 8^2 tiles, 300 generations with toroidal
+   wrap) and the sparse lane's final universe is byte-checked against the
+   dense engine AND the NumPy oracle, for BOTH conventions, with the tile
+   memo on (the production configuration).
+
+2. **SIGKILL mid-run -> auto-resume identical** — a real `gol serve`
+   process takes a long sparse job (journaled as its RLE spec), is
+   SIGKILLed before the job completes, and a restart on the same journal
+   replays the spec — the occupancy index is rebuilt from it — and
+   re-runs to a result byte-identical to an uninterrupted reference
+   server's, with exactly one done record in the journal.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/sparse_smoke.py
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GLIDER_RLE = "x = 3, y = 3, rule = B3/S23\nbob$2bo$3o!"
+
+
+def fail(msg: str) -> None:
+    print(f"SPARSE-SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_tile_boundaries() -> None:
+    """Glider across >= 4 tile boundaries, byte-gated vs dense + oracle."""
+    from gol_tpu import engine, oracle
+    from gol_tpu.config import GameConfig
+    from gol_tpu.io import rle
+    from gol_tpu.sparse import SparseBoard, TileMemo, simulate_sparse
+
+    glider = rle.parse(GLIDER_RLE)
+    for convention in ("c", "cuda"):
+        cfg = GameConfig(gen_limit=300, convention=convention)
+        dense = np.zeros((64, 64), np.uint8)
+        dense[1:4, 1:4] = glider
+        ref = oracle.run(dense.copy(), cfg)
+        eng = engine.simulate(dense.copy(), cfg)
+        if not np.array_equal(ref.grid, eng.grid) \
+                or ref.generations != eng.generations:
+            fail(f"dense engine disagrees with oracle ({convention})")
+        board = SparseBoard.from_dense(dense, tile=8)
+        result = simulate_sparse(board, cfg, TileMemo())
+        if result.generations != ref.generations:
+            fail(
+                f"sparse generations {result.generations} != "
+                f"{ref.generations} ({convention})"
+            )
+        if not np.array_equal(result.board.to_dense(), ref.grid):
+            fail(f"sparse cells differ from dense ({convention})")
+        # 300 generations moves the glider ~75 cells diagonally (with
+        # wrap): many 8-cell tile boundaries crossed, corners included.
+        print(
+            f"  boundary gate ({convention}): {result.generations} gens, "
+            f"{result.stats.tiles_active} tile-steps, byte-identical",
+            file=sys.stderr,
+        )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _start_server(port: int, journal_dir: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "serve",
+            "--port", str(port),
+            "--journal-dir", journal_dir,
+            "--flush-age", "0.02",
+        ],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            fail(f"server died at boot:\n{proc.stdout.read()}")
+        try:
+            _http("GET", base + "/metrics?format=json", timeout=2)
+            return proc
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.1)
+    fail("server did not become ready")
+
+
+SPARSE_JOB = {
+    "width": 512, "height": 512, "rle": GLIDER_RLE,
+    "x": 40, "y": 80, "tile": 64, "gen_limit": 600,
+}
+
+
+def _submit(base: str) -> str:
+    status, out = _http("POST", base + "/jobs", SPARSE_JOB)
+    if status != 202:
+        fail(f"submit answered {status}")
+    return out["id"]
+
+
+def _await_done(base: str, job_id: str, timeout=300) -> dict:
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        try:
+            status, out = _http("GET", f"{base}/result/{job_id}")
+        except urllib.error.HTTPError as e:
+            if e.code in (409, 503):
+                time.sleep(0.2)
+                continue
+            raise
+        if status == 200:
+            return out
+        time.sleep(0.2)
+    fail(f"job {job_id} did not finish in {timeout}s")
+
+
+def check_sigkill_resume() -> None:
+    """SIGKILL mid-sparse-run; restart replays the RLE spec to an
+    identical result (the occupancy-index replay path)."""
+    workdir = tempfile.mkdtemp(prefix="sparse-smoke-")
+    try:
+        # Reference: an uninterrupted server runs the same job to DONE.
+        ref_journal = os.path.join(workdir, "ref-journal")
+        port = _free_port()
+        proc = _start_server(port, ref_journal)
+        base = f"http://127.0.0.1:{port}"
+        ref = _await_done(base, _submit(base))
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+
+        # Victim: submit, SIGKILL while the job is (very likely) running,
+        # restart on the same journal, expect replay to re-run it.
+        journal = os.path.join(workdir, "journal")
+        port = _free_port()
+        proc = _start_server(port, journal)
+        base = f"http://127.0.0.1:{port}"
+        job_id = _submit(base)
+        time.sleep(0.6)  # let the worker claim the job mid-run
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        port = _free_port()
+        proc = _start_server(port, journal)
+        base = f"http://127.0.0.1:{port}"
+        out = _await_done(base, job_id)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+
+        for key in ("rle", "generations", "exit_reason", "population"):
+            if out.get(key) != ref.get(key):
+                fail(
+                    f"post-SIGKILL result differs on {key!r}: "
+                    f"{str(out.get(key))[:80]} != {str(ref.get(key))[:80]}"
+                )
+        # Exactly one done record for the id across the whole journal.
+        done = 0
+        with open(os.path.join(journal, "journal.jsonl"),
+                  encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "done" and rec.get("id") == job_id:
+                    done += 1
+        if done != 1:
+            fail(f"{done} done records for {job_id} (want exactly 1)")
+        print(
+            f"  SIGKILL gate: replayed job {job_id[:8]} re-ran to an "
+            f"identical result (gens {out['generations']}, "
+            f"population {out['population']}, 1 done record)",
+            file=sys.stderr,
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main() -> int:
+    check_tile_boundaries()
+    check_sigkill_resume()
+    print("SPARSE-SMOKE PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
