@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke autoscale-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -40,6 +40,12 @@ bench:
 # as the ratio collapsing toward the dense floor):
 #   make bench-diff OLD=BENCH_r14.json NEW=/tmp/BENCH_r14.json \
 #       METRIC=sizes.u16384.ratio_dense_over_sparse
+# The autoscale suite's CI gate rides the autoscaled lane's steady-state
+# throughput leaf (higher is better) rather than the headline ratio — a
+# regression in the scaled-out fleet fails the gate even when the static
+# baseline moved with it:
+#   make bench-diff OLD=BENCH_r15.json NEW=/tmp/BENCH_r15.json \
+#       METRIC=lanes.autoscaled.jobs_per_sec
 bench-diff:
 	@test -n "$(OLD)" && test -n "$(NEW)" || \
 		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1] [METRIC=dot.path]"; exit 2; }
@@ -118,6 +124,15 @@ fleettrace-smoke:
 # an identical result with exactly one done record.
 sparse-smoke:
 	python3 tools/sparse_smoke.py
+
+# Elastic-fleet smoke (tools/autoscale_smoke.py): a real 1-worker
+# `gol fleet --autoscale` under a step load must scale up, survive a
+# SIGKILL of a scaled worker mid-load (respawn + replay), finish every
+# job oracle-identically, retire back to the 1-worker floor when the
+# load stops, and audit exactly-once done records across ALL journal
+# partitions — including retired workers'.
+autoscale-smoke:
+	python3 tools/autoscale_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

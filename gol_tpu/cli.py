@@ -1063,7 +1063,7 @@ def _fleet(args) -> int:
     import signal
 
     from gol_tpu.fleet.router import RouterServer
-    from gol_tpu.fleet.workers import Fleet
+    from gol_tpu.fleet.workers import Fleet, core_slice_prefix
 
     if args.workers < 0:
         raise ValueError(f"--workers must be >= 0, got {args.workers}")
@@ -1088,6 +1088,41 @@ def _fleet(args) -> int:
         raise ValueError(
             f"--history-bytes must be >= 4096, got {args.history_bytes}"
         )
+    if args.cores_per_worker < 0:
+        raise ValueError(
+            f"--cores-per-worker must be >= 0, got {args.cores_per_worker}"
+        )
+    if args.cores_per_worker > (os.cpu_count() or args.cores_per_worker):
+        # Validated BEFORE any worker spawns (the history-flags contract):
+        # taskset fails outright on a range naming CPUs the host lacks,
+        # and every worker would boot-crash with a raw log tail instead
+        # of a `gol:` error.
+        raise ValueError(
+            f"--cores-per-worker {args.cores_per_worker} exceeds the "
+            f"host's {os.cpu_count()} cores"
+        )
+    # Autoscaler bounds resolve against --workers; AutoscaleConfig's own
+    # validation (min >= 1, max >= min, threshold ordering) runs HERE,
+    # before any worker spawns — same contract as the history flags.
+    autoscale_cfg = None
+    if args.autoscale:
+        from gol_tpu.fleet.autoscale import AutoscaleConfig
+
+        min_workers = (args.min_workers if args.min_workers is not None
+                       else max(1, args.workers))
+        max_workers = (args.max_workers if args.max_workers is not None
+                       else max(4, args.workers))
+        autoscale_cfg = AutoscaleConfig(
+            min_workers=min_workers,
+            max_workers=max_workers,
+            up_saturation=args.scale_up_saturation,
+            up_sustain=args.scale_up_sustain,
+            down_occupancy=args.scale_down_occupancy,
+            down_sustain=args.scale_down_sustain,
+            cooldown_s=args.scale_cooldown,
+        )
+    elif args.min_workers is not None or args.max_workers is not None:
+        raise ValueError("--min-workers/--max-workers need --autoscale")
     # Worker flags forwarded verbatim to every spawned `gol serve` —
     # including --warm-plans, so a tuned fleet pre-compiles each worker's
     # bucket programs (and the plan cache is shared via GOL_PLAN_CACHE /
@@ -1127,7 +1162,18 @@ def _fleet(args) -> int:
         if args.history_bytes is not None:
             serve_args += ["--history-bytes", str(args.history_bytes)]
 
-    fleet = Fleet(args.fleet_dir, serve_args=serve_args)
+    # --cores-per-worker: pin worker k to its own equal `taskset` slice
+    # (the fixed per-worker budget of a one-worker-per-device deployment,
+    # on a shared host) and weight it for --affinity placement. Autoscaled
+    # spawns ride the same hook, so new workers land on distinct slices.
+    spawn_prefix = None
+    spawn_weight = None
+    if args.cores_per_worker:
+        spawn_prefix = core_slice_prefix(args.cores_per_worker)
+        spawn_weight = float(args.cores_per_worker)
+
+    fleet = Fleet(args.fleet_dir, serve_args=serve_args,
+                  spawn_prefix=spawn_prefix, spawn_weight=spawn_weight)
     recovered = fleet.load()
     if recovered:
         print(f"reattached {recovered} worker partition(s) from "
@@ -1142,7 +1188,32 @@ def _fleet(args) -> int:
     fleet.start_health(args.health_interval)
     router = RouterServer(fleet, host=args.host, port=args.port,
                           big_edge=args.big_edge,
-                          cache_route=args.cache_route)
+                          cache_route=args.cache_route,
+                          affinity_route=args.affinity)
+    if autoscale_cfg is not None:
+        from gol_tpu.fleet.autoscale import Autoscaler
+        from gol_tpu.obs.history import HistoryWriter
+
+        # Every decision lands in a PR-10 durable ring beside the router's
+        # — `gol history-report` and the bench suite replay why the fleet
+        # grew. The tick rides the health loop: one cadence, and the /slo
+        # payloads the loop fetched this tick ARE the burn signal.
+        autoscaler = Autoscaler(
+            fleet, router, autoscale_cfg,
+            queue_capacity=args.max_queue_depth,
+            history=HistoryWriter(
+                os.path.join(args.fleet_dir, "autoscaler-history"),
+                source="autoscaler",
+            ),
+        )
+        router.autoscaler = autoscaler
+        fleet.add_tick_hook(autoscaler.tick)
+        print(f"autoscaler: {autoscale_cfg.min_workers}"
+              f"..{autoscale_cfg.max_workers} workers "
+              f"(up at {autoscale_cfg.up_saturation:.2f} saturation or "
+              f"SLO-critical burn, down below "
+              f"{autoscale_cfg.down_occupancy:.2f} occupancy, "
+              f"{autoscale_cfg.cooldown_s:.0f}s cooldown)", flush=True)
     if args.metrics_history:
         # The router's durable record is the fleet-MERGED snapshot, floored
         # by MonotonicCounters — the series an incident review replays stay
@@ -1320,6 +1391,25 @@ def _tune(args) -> int:
         print(f"  winner {result.winner.label()} at "
               f"{result.speedup:.3f}x the default geometry", file=sys.stderr)
 
+    if args.sparse_crossover:
+        # The `--engine auto` dense/sparse threshold, measured on THIS
+        # host instead of hard-coded: fit dense cost (linear in area)
+        # against the sparse engine's flat cost and persist the solved
+        # crossover (tune.select.sparse_auto_area consults it).
+        print("tune sparse-crossover: dense-vs-sparse per-generation cost",
+              file=sys.stderr)
+        crossover = measure.run_sparse_crossover_search(
+            iters=args.iters, quick=args.quick,
+        )
+        store.put(
+            select.sparse_fingerprint(),
+            {"auto_area": crossover.auto_area},
+            measured=crossover.to_dict(),
+        )
+        print(f"  dense overtakes sparse at ~{crossover.auto_area} cells "
+              f"(~{int(crossover.auto_area ** 0.5)}^2); persisted as the "
+              "--engine auto threshold", file=sys.stderr)
+
     report = measure.render_report(results)
     if args.report:
         with open(args.report, "w", encoding="utf-8") as f:
@@ -1382,27 +1472,31 @@ def _submit(args) -> int:
     # --shard-across: against a fleet router, fan the multi-board submit
     # round-robin over the fleet's workers directly (GET /fleet lists
     # them); against a single `gol serve` — no /fleet endpoint — the flag
-    # is a no-op and every job goes to --server as always.
-    targets = [base]
-    if args.shard_across:
-        membership = _fetch_json(f"{base}/fleet")
-        urls = [
-            str(w["url"]).rstrip("/")
-            for w in membership.get("workers", [])
-            if w.get("url") and w.get("healthy", True) and not w.get("big")
-        ]
-        if urls:
-            targets = urls
-            print(f"gol submit: sharding {len(args.input_files)} board(s) "
-                  f"across {len(urls)} fleet worker(s)", file=sys.stderr)
+    # is a no-op and every job goes to --server as always. Membership is
+    # re-fetched on an interval (and on a 429) rather than snapshotted
+    # once: against an autoscaled fleet, workers appear mid-submission —
+    # exactly because of the load this loop is applying — and a one-shot
+    # snapshot would never send them a job.
+    targets = _ShardTargets(
+        base, args.shard_across,
+        refresh_s=getattr(args, "shard_refresh", 5.0),
+        fetch=_fetch_json,
+    )
+    targets.refresh(force=True)
+    if args.shard_across and len(targets.targets) > 1:
+        print(f"gol submit: sharding {len(args.input_files)} board(s) "
+              f"across {len(targets.targets)} fleet worker(s)",
+              file=sys.stderr)
     # --wire packed: boards travel as binary wire frames (io/wire.py, ~8x
     # fewer bytes). Degradation is PER TARGET: a server that answers 415
     # (or 400 — an old server's JSON parser rejecting the frame) gets ONE
     # logged retry as text and every later submit to it goes text too.
-    wire_mode = {t: getattr(args, "wire", "text") for t in targets}
+    wire_default = getattr(args, "wire", "text")
+    wire_mode = {}  # per target; new targets default to the flag's mode
     ids = {}  # job id -> (input path, server base the job lives on)
-    for i, path in enumerate(args.input_files):
-        target = targets[i % len(targets)]
+    for path in args.input_files:
+        target = targets.next()
+        wire_mode.setdefault(target, wire_default)
         grid = text_grid.read_grid(path, width, height)
         meta = {
             "convention": variant.convention,
@@ -1415,25 +1509,44 @@ def _submit(args) -> int:
             # Per-job result-cache opt-out (Job.no_cache); servers without
             # a cache ignore the field after type validation.
             meta["no_cache"] = True
-        if wire_mode[target] == "packed":
-            from gol_tpu.io import wire
 
-            status, payload = _http_json(
-                "POST", f"{target}/jobs",
-                raw=wire.encode_frame(meta, grid=grid),
-                content_type=wire.CONTENT_TYPE,
-            )
-            if status in (400, 415):
-                print(
-                    f"gol submit: {target} does not accept the packed wire "
-                    f"format (HTTP {status}); retrying as text",
-                    file=sys.stderr,
+        def submit_to(target):
+            if wire_mode[target] == "packed":
+                from gol_tpu.io import wire
+
+                status, payload = _http_json(
+                    "POST", f"{target}/jobs",
+                    raw=wire.encode_frame(meta, grid=grid),
+                    content_type=wire.CONTENT_TYPE,
                 )
-                wire_mode[target] = "text"
-        if wire_mode[target] != "packed":
-            body = {"width": width, "height": height,
-                    "cells": text_grid.encode(grid).decode("ascii"), **meta}
-            status, payload = _http_json("POST", f"{target}/jobs", body)
+                if status in (400, 415):
+                    print(
+                        f"gol submit: {target} does not accept the packed "
+                        f"wire format (HTTP {status}); retrying as text",
+                        file=sys.stderr,
+                    )
+                    wire_mode[target] = "text"
+            if wire_mode[target] != "packed":
+                body = {"width": width, "height": height,
+                        "cells": text_grid.encode(grid).decode("ascii"),
+                        **meta}
+                status, payload = _http_json("POST", f"{target}/jobs", body)
+            return status, payload
+
+        status, payload = submit_to(target)
+        if status == 429:
+            # A shed burst: the membership that 429'd may already be
+            # stale — an autoscaled fleet is likely scaling up RIGHT NOW
+            # because of this very load. Re-fetch and retry ONCE against
+            # the next (possibly brand-new) target before giving up.
+            targets.on_429()
+            retry = targets.next()
+            wire_mode.setdefault(retry, wire_default)
+            print(f"gol submit: {target} shed the job (HTTP 429); "
+                  f"refreshed membership, retrying on {retry}",
+                  file=sys.stderr)
+            target = retry
+            status, payload = submit_to(target)
         if status != 202:
             print(f"gol submit: {path}: HTTP {status}: "
                   f"{payload.get('error', payload)}", file=sys.stderr)
@@ -1449,6 +1562,67 @@ def _submit(args) -> int:
     if outdir:
         os.makedirs(outdir, exist_ok=True)
     return _collect_results(dict(ids), args, outdir)
+
+
+class _ShardTargets:
+    """The --shard-across target set, kept fresh through the submission.
+
+    ``gol submit`` used to snapshot GET /fleet once at startup, so a long
+    submission never saw workers an autoscaler added mid-run — the fleet
+    would scale up under the load and the client would keep hammering the
+    original N workers. This object re-fetches membership every
+    ``refresh_s`` seconds of submission (and immediately on a 429 burst,
+    via ``on_429``) and rotates round-robin over the CURRENT healthy
+    non-big workers. Disabled (``--shard-across`` absent) or against a
+    single ``gol serve`` (no /fleet endpoint, fetch returns {}), the
+    target list stays ``[base]`` — the pinned no-op behavior.
+
+    Clock: ``time.perf_counter`` (interval arithmetic only)."""
+
+    def __init__(self, base: str, enabled: bool, refresh_s: float = 5.0,
+                 fetch=None, clock=time.perf_counter):
+        self.base = base
+        self.enabled = enabled
+        self.refresh_s = refresh_s
+        self._fetch = fetch if fetch is not None else _fetch_json
+        self._clock = clock
+        self.targets = [base]
+        self._i = 0
+        self._fetched_at: float | None = None
+
+    def refresh(self, force: bool = False) -> None:
+        if not self.enabled:
+            return
+        now = self._clock()
+        if (not force and self._fetched_at is not None
+                and now - self._fetched_at < self.refresh_s):
+            return
+        self._fetched_at = now
+        membership = self._fetch(f"{self.base}/fleet")
+        urls = [
+            str(w["url"]).rstrip("/")
+            for w in (membership.get("workers") or [])
+            if w.get("url") and w.get("healthy", True) and not w.get("big")
+            and not w.get("retiring")
+        ]
+        if not urls:
+            return  # single server / unreachable: keep what we have
+        if urls != self.targets:
+            print(f"gol submit: fleet membership now {len(urls)} "
+                  f"worker(s)", file=sys.stderr)
+        self.targets = urls
+
+    def next(self) -> str:
+        """The next round-robin target, after an interval-gated refresh."""
+        self.refresh()
+        target = self.targets[self._i % len(self.targets)]
+        self._i += 1
+        return target
+
+    def on_429(self) -> None:
+        """A shed answer: whatever membership produced it is suspect —
+        re-fetch NOW regardless of the interval."""
+        self.refresh(force=True)
 
 
 def _collect_results(pending: dict, args, outdir) -> int:
@@ -2252,6 +2426,62 @@ def build_parser() -> argparse.ArgumentParser:
     flt.add_argument("--history-bytes", type=int, default=None, metavar="N",
                      help="per-process history ring cap in bytes "
                      "(default 16 MiB)")
+    # The elastic fleet (gol_tpu/fleet/autoscale.py + affinity.py).
+    flt.add_argument(
+        "--autoscale", action="store_true",
+        help="close the loop: spawn workers when SLO burn rates or queue "
+        "saturation climb (up to --max-workers), drain+retire the "
+        "emptiest when occupancy stays below the floor (down to "
+        "--min-workers). Every decision is journaled to "
+        "<fleet-dir>/autoscaler-history and visible in `gol top`",
+    )
+    flt.add_argument(
+        "--min-workers", type=int, default=None, metavar="N",
+        help="autoscaler floor (default: the --workers count)",
+    )
+    flt.add_argument(
+        "--max-workers", type=int, default=None, metavar="N",
+        help="autoscaler ceiling (default: max(4, --workers))",
+    )
+    flt.add_argument(
+        "--scale-up-saturation", type=float, default=0.8, metavar="F",
+        help="scale up when merged queue depth exceeds this fraction of "
+        "the fleet-wide admission cap, sustained --scale-up-sustain ticks "
+        "(default 0.8); SLO-critical burn on every window also triggers",
+    )
+    flt.add_argument(
+        "--scale-down-occupancy", type=float, default=0.05, metavar="F",
+        help="retire a worker when queued+inflight stays below this "
+        "fraction of the cap for --scale-down-sustain ticks (default "
+        "0.05; the wide gap to --scale-up-saturation is the hysteresis "
+        "dead band)",
+    )
+    flt.add_argument("--scale-up-sustain", type=int, default=2, metavar="T",
+                     help="consecutive health ticks the up condition must "
+                     "hold (default 2)")
+    flt.add_argument("--scale-down-sustain", type=int, default=10,
+                     metavar="T",
+                     help="consecutive health ticks the down condition "
+                     "must hold (default 10)")
+    flt.add_argument(
+        "--scale-cooldown", type=float, default=30.0, metavar="S",
+        help="seconds after any scale event before the next decision can "
+        "fire (default 30; flap protection on top of the sustain windows)",
+    )
+    flt.add_argument(
+        "--cores-per-worker", type=int, default=0, metavar="N",
+        help="pin worker k to its own N-core `taskset` slice (local "
+        "spawns only; autoscaled workers land on distinct slices) and "
+        "weight it N for --affinity placement. 0 = no pinning (default)",
+    )
+    flt.add_argument(
+        "--affinity", action="store_true",
+        help="affinity-aware placement: rank workers by weighted HRW over "
+        "per-worker capacity weights (--cores-per-worker pins, or each "
+        "worker's tuned marginal rate advertised on /healthz) instead of "
+        "hash rank alone. Off (the default) — and on with no weights "
+        "configured — is byte-identical to plain HRW placement",
+    )
     flt.set_defaults(func=_fleet)
 
     tun = sub.add_parser(
@@ -2284,6 +2514,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--packed", action="store_true",
         help="also tune the packed-state family (the --packed-io lane "
         "consults its own plans; widths must divide by 32)",
+    )
+    tun.add_argument(
+        "--sparse-crossover", action="store_true",
+        help="also measure the dense/sparse engine crossover on this host "
+        "and persist it as the `--engine auto` area threshold (default: "
+        "the bundled BENCH_r14 crossover, 2^25 cells)",
     )
     tun.add_argument(
         "--serve-board", default=None, metavar="HxW",
@@ -2421,7 +2657,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="against a fleet router (`gol fleet`), fan the boards "
         "round-robin over the fleet's workers directly (GET /fleet lists "
         "them) instead of routing every submit through the front-end; "
+        "membership is re-fetched every --shard-refresh seconds (and on "
+        "a 429) so autoscaled workers absorb the load mid-submission; "
         "a no-op against a single `gol serve`",
+    )
+    sbm.add_argument(
+        "--shard-refresh", type=float, default=5.0, metavar="S",
+        help="seconds between --shard-across membership re-fetches "
+        "(default 5)",
     )
     sbm.set_defaults(func=_submit)
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import math
 
 # The serve batcher's built-in PAD_QUANTUM, restated here so the router
 # never imports the jax-loading serve stack. tests/test_fleet.py pins the
@@ -114,3 +115,44 @@ def rank(bucket_label: str, worker_ids) -> list[str]:
     the (bucket, ids) pair alone."""
     return sorted(worker_ids, key=lambda w: _score(bucket_label, w),
                   reverse=True)
+
+
+def _weighted_score(bucket_label: str, worker_id: str,
+                    weight: float) -> tuple[float, str]:
+    """Logarithm-method weighted rendezvous score (Thaler/Ravishankar):
+    map the hash to h in (0, 1) and score ``-weight / ln(h)``. The score
+    distribution makes each bucket's owner worker ``i`` with probability
+    w_i / sum(w) while keeping HRW's minimal-disruption property — and
+    because the score is strictly increasing in h at any fixed weight,
+    EQUAL weights order exactly like the raw hash, i.e. like ``rank``."""
+    digest, _ = _score(bucket_label, worker_id)
+    # (digest + 0.5) / 2^64 keeps h strictly inside (0, 1): ln(0) and
+    # ln(1) are both poles of the formula.
+    h = (digest + 0.5) / float(1 << 64)
+    return -weight / math.log(h), worker_id
+
+
+def rank_weighted(bucket_label: str, weights: dict[str, float]) -> list[str]:
+    """``rank`` with per-worker capacity weights (the affinity layer,
+    gol_tpu/fleet/affinity.py): a worker with twice the weight owns about
+    twice the buckets. Deterministic in (bucket, weights) alone; changing
+    one worker's weight only moves buckets between that worker and the
+    rest (never reshuffles third parties — the weighted-rendezvous
+    analog of the minimal-disruption property, test-pinned).
+
+    All-equal weights DELEGATE to plain ``rank`` — not just
+    order-equivalent but the same code path, so ``--affinity`` with
+    no weights configured is byte-identical to affinity off (pinned).
+    Non-positive weights are treated as the 1.0 default (a zero weight
+    would be "never place here", which is membership's job, not
+    placement's)."""
+    ids = list(weights)
+    cleaned = {w: (float(weights[w]) if float(weights[w]) > 0 else 1.0)
+               for w in ids}
+    if len(set(cleaned.values())) <= 1:
+        return rank(bucket_label, ids)
+    return sorted(
+        ids,
+        key=lambda w: _weighted_score(bucket_label, w, cleaned[w]),
+        reverse=True,
+    )

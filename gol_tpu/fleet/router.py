@@ -55,7 +55,7 @@ from urllib.parse import urlparse, parse_qs
 import errno
 import socket
 
-from gol_tpu.fleet import client, placement
+from gol_tpu.fleet import affinity, client, placement
 from gol_tpu.fleet.workers import Fleet, Worker
 from gol_tpu.io import wire
 from gol_tpu.obs import propagate, registry as obs_registry, trace as obs_trace
@@ -314,6 +314,7 @@ class RouterServer:
         http_exchange=client.http_exchange,
         submit_timeout: float = 120.0,
         cache_route: bool = False,
+        affinity_route: bool = False,
     ):
         if big_edge < placement.PLACEMENT_QUANTUM:
             raise ValueError(
@@ -340,6 +341,16 @@ class RouterServer:
         # skips its engine run. ``no_cache`` submissions keep bucket
         # routing; spillover/health/big-lane ordering is identical.
         self.cache_route = cache_route
+        # Affinity-aware placement (fleet/affinity.py): rank by weighted
+        # HRW over per-worker capacity weights instead of the raw hash.
+        # Default OFF, and OFF is byte-identical plain HRW (test-pinned);
+        # ON with no weights configured delegates back to plain HRW, so
+        # the flag is safe before any weight exists.
+        self.affinity_route = affinity_route
+        # The autoscaler (fleet/autoscale.py), attached by the CLI after
+        # construction (it needs this router's merged scrape): surfaces
+        # in /metrics, /fleet, and `gol top` when present.
+        self.autoscaler = None
         self.registry = Registry(prefix="gol_fleet")
         self._counter_floors = MonotonicCounters()
         # Single-flight scrape state (all guarded by the condition).
@@ -461,6 +472,11 @@ class RouterServer:
         """Stop serving; with ``cascade`` (the SIGTERM path) drain the
         whole fleet and SIGTERM local workers first. ``cascade=False``
         abandons the workers untouched — the router-restart lane."""
+        if self.autoscaler is not None:
+            # Before anything else: an in-flight scale action must resolve
+            # (a spawn the shutdown's kill sweep never saw would outlive
+            # the fleet), and a closed autoscaler makes no new decisions.
+            self.autoscaler.close()
         if self._history_thread is not None:
             self._history_stop.set()
             self._history_thread.join(timeout=5)
@@ -492,19 +508,19 @@ class RouterServer:
         overrides the HRW key (the cache tier ranks by fingerprint; the
         health/big-lane ordering is identical either way)."""
         label = rank_label if rank_label is not None else key.label()
-        workers = {w.id: w for w in self.fleet.workers() if w.url}
+        # Retiring workers are mid-drain (fleet/autoscale.py): they finish
+        # what they hold but take NOTHING new — excluded from the walk
+        # entirely, unlike backpressured workers, which tail it.
+        workers = {w.id: w for w in self.fleet.workers()
+                   if w.url and not w.retiring}
         if not workers:
             return []
         normal = [w for w in workers.values() if not w.big]
         bigs = [w for w in workers.values() if w.big]
         pool = normal or list(workers.values())
-        ranked = [workers[wid] for wid in placement.rank(
-            label, [w.id for w in pool]
-        )]
+        ranked = [workers[wid] for wid in self._rank(label, pool)]
         if bigs and key.max_edge > self.big_edge:
-            big_ranked = [workers[wid] for wid in placement.rank(
-                label, [w.id for w in bigs]
-            )]
+            big_ranked = [workers[wid] for wid in self._rank(label, bigs)]
             ranked = big_ranked + [w for w in ranked if not w.big]
         order = [w for w in ranked if w.healthy and not w.backpressure]
         order += [w for w in ranked if w.healthy and w.backpressure]
@@ -517,6 +533,15 @@ class RouterServer:
         in_order = {w.id for w in order}
         order += [w for w in bigs if w.healthy and w.id not in in_order]
         return order
+
+    def _rank(self, label: str, pool: list[Worker]) -> list[str]:
+        """One pool's HRW order: plain rank, or — with ``--affinity`` —
+        weighted rank over the pool's capacity weights. The weighted path
+        with all-equal weights delegates to plain rank inside placement,
+        so affinity-on-with-no-weights is byte-identical to off."""
+        if self.affinity_route:
+            return placement.rank_weighted(label, affinity.weights_for(pool))
+        return placement.rank(label, [w.id for w in pool])
 
     def route_submit(self, raw: bytes, content_type: str | None = None):
         """(status, payload) for POST /jobs: place, forward, spill.
@@ -863,6 +888,8 @@ class RouterServer:
             **self.fleet.stats(),
             "draining": self._draining,
             "router": self.registry.snapshot(),
+            **({"autoscaler": self.autoscaler.public()}
+               if self.autoscaler is not None else {}),
         }
         return merged
 
@@ -880,6 +907,14 @@ class RouterServer:
             "route_sheds_total": self.registry.counter("route_sheds_total"),
             "route_errors_total": self.registry.counter("route_errors_total"),
         }
+        if self.autoscaler is not None:
+            snap = self.registry.snapshot()
+            for name, value in (snap.get("gauges") or {}).items():
+                if name.startswith("autoscaler_"):
+                    fleet_gauges[name] = value
+            for name, value in (snap.get("counters") or {}).items():
+                if name.startswith("autoscaler_"):
+                    fleet_counters[name] = value
         return merged_prometheus(merged, fleet_gauges, fleet_counters)
 
     def slo_json(self) -> dict:
@@ -891,6 +926,9 @@ class RouterServer:
             "draining": self._draining,
             "big_edge": self.big_edge,
             "cache_route": self.cache_route,
+            "affinity": self.affinity_route,
+            **({"autoscaler": self.autoscaler.public()}
+               if self.autoscaler is not None else {}),
             "workers": [w.public() for w in self.fleet.workers()],
         }
 

@@ -50,6 +50,34 @@ MANIFEST = "manifest.json"
 _URL_RE = re.compile(rb"serving on (http://\S+)")
 
 
+def core_slice_prefix(width: int, ncores: int | None = None):
+    """A ``Fleet(spawn_prefix=...)`` hook pinning worker k to its own
+    equal ``taskset`` core slice (the fixed per-worker budget of a
+    one-worker-per-device deployment, on a shared host).
+
+    The slice index comes from the digits of the worker id, so a respawn
+    keeps its slice and an autoscaled spawn lands on a distinct one. The
+    modulo wraps slices once the host runs out of distinct cores — the
+    CLI rejects ``width > cpu_count`` up front, because taskset fails
+    outright on a range that names CPUs the host does not have. One
+    definition shared by ``gol fleet --cores-per-worker`` and the bench
+    fleet lanes: the bench must pin exactly like production."""
+    if width < 1:
+        raise ValueError(f"core slice width must be >= 1, got {width}")
+    ncores = ncores or os.cpu_count() or width
+    if width > ncores:
+        raise ValueError(
+            f"core slice width {width} exceeds the host's {ncores} cores"
+        )
+
+    def prefix(worker):
+        index = int("".join(c for c in worker.id if c.isdigit()) or 0)
+        lo = (index * width) % max(1, ncores - width + 1)
+        return ["taskset", "-c", f"{lo}-{lo + width - 1}"]
+
+    return prefix
+
+
 @dataclasses.dataclass
 class Worker:
     """One serving worker as the fleet sees it."""
@@ -68,6 +96,17 @@ class Worker:
     failures: int = 0  # consecutive failed liveness probes
     restarts: int = 0
     respawning: bool = False  # a background respawn is in flight
+    retiring: bool = False  # autoscaler drain->retire in flight: no NEW work
+    # Affinity weights (fleet/affinity.py): ``weight`` is the operator-
+    # pinned capacity (e.g. the --cores-per-worker slice width, manifest-
+    # persisted so routers agree across restarts); ``advertised_weight``
+    # is what the worker's own /healthz reported (its tuned marginal
+    # kernel rate) — adopted by the health loop, never persisted.
+    weight: float | None = None
+    advertised_weight: float | None = None
+    # The worker's last GET /slo payload, stored by the health tick so
+    # the autoscaler reads burn rates without a second probe fan-out.
+    slo: dict | None = None
 
     def manifest_record(self) -> dict:
         return {
@@ -78,6 +117,7 @@ class Worker:
             "big": self.big,
             "attached": self.attached,
             "pid": self.pid,
+            **({"weight": self.weight} if self.weight is not None else {}),
         }
 
     def public(self) -> dict:
@@ -89,8 +129,10 @@ class Worker:
             "attached": self.attached,
             "healthy": self.healthy,
             "backpressure": self.backpressure,
+            "retiring": self.retiring,
             "pid": self.pid,
             "restarts": self.restarts,
+            **({"weight": self.weight} if self.weight is not None else {}),
         }
 
 
@@ -106,6 +148,7 @@ class Fleet:
         probe=client.probe,
         http=client.http_json,
         spawn_prefix=None,
+        spawn_weight: float | None = None,
     ):
         self.fleet_dir = fleet_dir
         os.makedirs(fleet_dir, exist_ok=True)
@@ -115,6 +158,10 @@ class Fleet:
         # fixed resource budget on a shared host (the bench suite's
         # scale-out control; a real fleet gives each worker its own device).
         self._spawn_prefix = spawn_prefix
+        # Default pinned affinity weight for local spawns (the
+        # --cores-per-worker slice width): every spawned worker — incl.
+        # autoscaled ones — carries it unless spawn() pins its own.
+        self._spawn_weight = spawn_weight
         self.fail_after = fail_after
         self.boot_timeout = boot_timeout
         self._probe = probe
@@ -125,6 +172,10 @@ class Fleet:
         self._health_stop = threading.Event()
         self._respawns: dict[str, threading.Thread] = {}
         self._manifest_lock = threading.Lock()
+        # Per-tick hooks (the autoscaler's ride on the health loop): each
+        # is called after the worker probes of every health tick, inside
+        # the tick's own exception guard.
+        self._tick_hooks: list = []
 
     # -- membership --------------------------------------------------------
 
@@ -153,7 +204,7 @@ class Fleet:
             return f"{prefix}{n}"
 
     def attach(self, url: str, worker_id: str | None = None,
-               big: bool = False) -> Worker:
+               big: bool = False, weight: float | None = None) -> Worker:
         """Adopt an externally managed worker by URL (multi-host lane).
 
         Idempotent on the URL: a restarted ``gol fleet`` passes the same
@@ -170,14 +221,37 @@ class Fleet:
             url=url,
             attached=True,
             big=big,
+            weight=weight,
         ))
 
-    def spawn(self, worker_id: str | None = None, big: bool = False) -> Worker:
-        """Spawn one local worker and wait until it serves."""
+    def spawn(self, worker_id: str | None = None, big: bool = False,
+              weight: float | None = None) -> Worker:
+        """Spawn one local worker and wait until it serves.
+
+        A boot that never becomes ready ROLLS BACK: the half-booted
+        process is killed and the membership entry removed, so a failed
+        autoscaler scale-up leaves no zombie for the health loop to
+        respawn in a tight loop (the autoscaler's cooldown, not the
+        supervisor, paces retries against a broken boot) and no phantom
+        worker inflating the fleet's apparent capacity."""
+        if weight is None:
+            weight = self._spawn_weight
         worker = self._launch(Worker(id=worker_id or self._next_id(big),
-                                     big=big))
+                                     big=big, weight=weight))
         self._add(worker)
-        self._await_ready(worker)
+        try:
+            self._await_ready(worker)
+        except BaseException:
+            if worker.proc is not None and worker.proc.poll() is None:
+                worker.proc.kill()
+                try:
+                    worker.proc.wait(timeout=10)
+                except (subprocess.TimeoutExpired, OSError):
+                    pass
+            with self._lock:
+                self._workers.pop(worker.id, None)
+            self.write_manifest()
+            raise
         self.write_manifest()
         return worker
 
@@ -191,11 +265,13 @@ class Fleet:
                        if not w.attached and not w.big]
             have_big = any(w.big for w in self._workers.values())
         for _ in range(max(0, n_workers - len(locals_))):
-            worker = self._launch(Worker(id=self._next_id(big=False)))
+            worker = self._launch(Worker(id=self._next_id(big=False),
+                                         weight=self._spawn_weight))
             self._add(worker)
             launched.append(worker)
         if big_lane and not have_big:
-            worker = self._launch(Worker(id=self._next_id(big=True), big=True))
+            worker = self._launch(Worker(id=self._next_id(big=True), big=True,
+                                         weight=self._spawn_weight))
             self._add(worker)
             launched.append(worker)
         for worker in launched:
@@ -417,6 +493,7 @@ class Fleet:
             doc = json.load(f)
         n = 0
         for rec in doc.get("partitions", []):
+            weight = rec.get("weight")
             worker = Worker(
                 id=rec["id"],
                 url=rec.get("url"),
@@ -425,6 +502,7 @@ class Fleet:
                 big=bool(rec.get("big")),
                 attached=bool(rec.get("attached")),
                 pid=rec.get("pid"),
+                weight=float(weight) if weight else None,
             )
             alive = worker.url is not None and self._probe(worker.url) is not None
             if alive:
@@ -459,6 +537,11 @@ class Fleet:
         /slo, respawn for dead local processes."""
         if worker.respawning:
             return  # a background respawn owns this worker right now
+        if worker.retiring:
+            # The autoscaler's drain->retire thread owns this worker: no
+            # respawn (a retiring worker dying mid-drain is the retire
+            # thread's failure to handle), no backpressure churn.
+            return
         if worker.proc is not None and worker.proc.poll() is not None:
             logger.warning("fleet: worker %s (pid %s) exited rc=%s",
                            worker.id, worker.pid, worker.proc.returncode)
@@ -494,7 +577,16 @@ class Fleet:
             return
         worker.failures = 0
         worker.healthy = True
+        if worker.weight is None and isinstance(hz, dict):
+            # Affinity (fleet/affinity.py): a worker with no operator-
+            # pinned weight may advertise its measured capacity on
+            # /healthz (the tuned marginal kernel rate of its own plan
+            # cache). Adopted, not persisted — it re-advertises per boot.
+            advertised = hz.get("weight")
+            if isinstance(advertised, (int, float)) and advertised > 0:
+                worker.advertised_weight = float(advertised)
         slo = self._probe(worker.url, "/slo")
+        worker.slo = slo  # the autoscaler's burn signal: one probe per tick
         if slo is not None:
             burning = (
                 slo.get("status") == "critical"
@@ -511,6 +603,14 @@ class Fleet:
     def health_tick(self) -> None:
         for worker in self.workers():
             self.check_worker(worker)
+        for hook in list(self._tick_hooks):
+            hook()
+
+    def add_tick_hook(self, hook) -> None:
+        """Ride the health loop: ``hook()`` runs after every tick's worker
+        probes (the autoscaler's cadence), under the loop's exception
+        guard — a raising hook costs one tick, never the loop."""
+        self._tick_hooks.append(hook)
 
     def start_health(self, interval: float = 1.0) -> None:
         if self._health_thread is not None:
@@ -541,6 +641,93 @@ class Fleet:
             self._respawns.clear()
         for thread in respawns:
             thread.join(timeout=self.boot_timeout + 15)
+
+    # -- scale-down: drain -> retire ---------------------------------------
+
+    def retire(self, worker_id: str, drain_timeout: float = 600.0) -> bool:
+        """Retire one LOCAL worker: cascade drain -> stop -> remove.
+
+        The scale-down actuator (fleet/autoscale.py). Ordering is the
+        whole contract:
+
+        1. mark ``retiring`` — the router stops routing NEW work there
+           (and the health loop stops supervising it) immediately;
+        2. ``POST /drain`` — the worker finishes every accepted job and
+           journals its done records; a drain that fails or times out
+           ABORTS the retire (losing capacity must never risk losing
+           jobs). A drain may have REACHED the worker before failing
+           here, and a draining scheduler 429s new work forever — so the
+           abort path restores the worker via the supervised respawn on
+           its own partition (journal replay finishes anything the
+           partial drain left; exactly-once holds as for any crash)
+           rather than pretending the old process still serves;
+        3. stop the process (SIGTERM first — it already drained, so this
+           is quick — SIGKILL past ``timeout``) and remove the worker
+           from membership + manifest.
+
+        The journal partition STAYS on disk, fully drained: every job it
+        ever accepted has a done record, and the next scale-up reuses the
+        lowest free worker id — landing on this same partition, whose
+        replay finds only terminal records. Retired capacity is never an
+        orphaned journal. Attached workers are not ours to retire."""
+        worker = self.worker(worker_id)
+        if worker is None or worker.attached or worker.big:
+            return False
+        if worker.retiring or worker.respawning:
+            return False
+        worker.retiring = True
+        drained = False
+        if worker.url is not None:
+            try:
+                status, payload = self._http(
+                    "POST", worker.url + "/drain", body={},
+                    timeout=drain_timeout,
+                )
+                drained = status == 200 and bool(
+                    isinstance(payload, dict) and payload.get("drained")
+                )
+            except (OSError, ValueError) as err:
+                logger.error("fleet: drain of retiring worker %s failed "
+                             "(%s)", worker_id, err)
+        if not drained:
+            # The drain may have landed (its scheduler then refuses new
+            # work forever — there is no un-drain), so "keep serving" is
+            # not an option: respawn on the same partition. The replay
+            # finishes whatever the partial drain left, and the fresh
+            # process admits work again.
+            logger.error("fleet: worker %s did not drain; ABORTING its "
+                         "retire and respawning it on its partition "
+                         "(journal replays; a possibly-draining process "
+                         "cannot be returned to service)", worker_id)
+            try:
+                self._respawn(worker)
+            finally:
+                worker.retiring = False
+            return False
+        if worker.proc is not None:
+            if worker.proc.poll() is None:
+                worker.proc.terminate()
+                try:
+                    worker.proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    worker.proc.kill()
+                    try:
+                        worker.proc.wait(timeout=10)
+                    except (subprocess.TimeoutExpired, OSError):
+                        pass
+        elif worker.pid is not None and self._looks_like_worker(worker.pid):
+            try:
+                os.kill(worker.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            self._ensure_dead(worker.pid)
+        with self._lock:
+            self._workers.pop(worker_id, None)
+        self.write_manifest()
+        logger.warning("fleet: retired worker %s (partition %s drained; "
+                       "its journal holds only terminal records)",
+                       worker_id, worker.journal_dir)
+        return True
 
     # -- fleet-wide drain / shutdown ---------------------------------------
 
@@ -620,6 +807,7 @@ class Fleet:
             "workers": len(workers),
             "healthy": sum(w.healthy for w in workers),
             "backpressured": sum(w.backpressure for w in workers),
+            "retiring": sum(w.retiring for w in workers),
             "big_lane": any(w.big for w in workers),
             "restarts": sum(w.restarts for w in workers),
         }

@@ -69,8 +69,39 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
             f"{int(fleet.get('healthy', 0))} healthy, "
             f"{int(fleet.get('backpressured', 0))} backpressured, "
             f"{int(fleet.get('restarts', 0))} restart(s)"
+            + (f", {int(fleet['retiring'])} retiring"
+               if fleet.get("retiring") else "")
             + ("   DRAINING" if fleet.get("draining") else "")
         )
+    autoscaler = (fleet or {}).get("autoscaler") or {}
+    if autoscaler.get("enabled"):
+        # The elastic-fleet panel: target vs actual N inside the
+        # [min..max] band, plus the signal behind the last decision — the
+        # one-line answer to "why is the fleet this size right now".
+        last = autoscaler.get("last_decision") or {}
+        target = autoscaler.get("target")
+        action = last.get("action", "-")
+        status = ("warning" if autoscaler.get("scaling")
+                  else "ok" if action == "hold" else "warning")
+        line = (
+            f"autoscale: {int(autoscaler.get('workers', 0))} workers"
+            f" (target {int(target) if target is not None else '-'},"
+            f" min {int(autoscaler.get('min', 0))}"
+            f" max {int(autoscaler.get('max', 0))})"
+            + ("   SCALING" if autoscaler.get("scaling") else "")
+        )
+        if last:
+            line += (
+                f"   sat {_fmt(last.get('saturation'))}"
+                f" occ {_fmt(last.get('occupancy'))}"
+                f" burn {_fmt(last.get('burn'))}"
+            )
+            if last.get("action") not in (None, "hold") or last.get("reason"):
+                line += f"   last: {action}"
+                if last.get("reason"):
+                    line += f" ({last['reason']})"
+        lines.append(_color(status, line, ansi) if action != "hold"
+                     else line)
     lines.append("")
 
     # -- queue / flow -------------------------------------------------------

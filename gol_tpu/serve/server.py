@@ -217,6 +217,15 @@ class GolServer:
             history=self.history,
         )
         self._sample_interval = sample_interval
+        # The capacity weight this worker advertises on /healthz (the
+        # affinity layer's measured-capacity source, fleet/affinity.py):
+        # the tuned per-bucket marginal rates folded to one number — the
+        # mean, so two hosts of the same class compare regardless of
+        # which buckets each tuned. None when untuned (key omitted).
+        rates = self.sampler.marginal_rates
+        self.advertised_weight = (
+            sum(rates.values()) / len(rates) if rates else None
+        )
         self.replayed = 0
         self._replay_results = {}
         self._replay_failed = {}
@@ -727,7 +736,16 @@ def _make_handler(server: GolServer):
                     "registry": obs_registry.default().snapshot(),
                 })
             elif path == "/healthz":
-                self._reply(200, {"ok": True, "stats": server.scheduler.stats()})
+                payload = {"ok": True, "stats": server.scheduler.stats()}
+                # Affinity advertisement (fleet/affinity.py): the tuned
+                # marginal kernel rate of THIS host's plan cache, when one
+                # was measured — a fleet router with --affinity weights
+                # bucket placement by it. Absent (the untuned default),
+                # the key is omitted and the payload is byte-identical to
+                # the pre-affinity contract.
+                if server.advertised_weight is not None:
+                    payload["weight"] = server.advertised_weight
+                self._reply(200, payload)
             else:
                 self._reply(404, {"error": f"no such endpoint {path}"})
 

@@ -43,10 +43,17 @@ from gol_tpu.obs import registry as obs_registry, trace as obs_trace
 from gol_tpu.sparse.board import SparseBoard
 from gol_tpu.sparse.memo import TileMemo, TileStep
 
-# Above this universe area the CLI's auto lane prefers the sparse engine
-# (2^26 cells = 8192^2): dense per-generation cost there is ~64 MB of
-# cells touched twice, where a sparse universe's cost is its live area.
-SPARSE_AUTO_AREA = 1 << 26
+# Above this universe area the CLI's auto lane prefers the sparse engine.
+# The shipped default is the MEASURED dense/sparse crossover, not a guess:
+# BENCH_r14 has dense still winning at 4096^2 = 2^24 (ratio 0.81) and
+# losing 4.7x at 8192^2 = 2^26 — dense cost grows linearly with area while
+# sparse stays flat at the live tiles, so the crossover sits near the
+# geometric middle, 2^25. The value is plan-cached per machine:
+# ``gol tune --sparse-crossover`` measures THIS host's crossover and
+# persists it (tune.select.sparse_auto_area consults it; this constant is
+# the bundled-default/last-resort fallback, kept equal to
+# default_plans.json's entry).
+SPARSE_AUTO_AREA = 1 << 25
 
 EXIT_GEN_LIMIT = "gen_limit"
 EXIT_EMPTY = "empty"
@@ -82,10 +89,23 @@ class SparseResult:
     stats: SparseStats
 
 
-def auto_engine(height: int, width: int, tile: int) -> str:
+def auto_engine(height: int, width: int, tile: int,
+                area_threshold: int | None = None) -> str:
     """The auto lane's dense/sparse pick for a universe: sparse above the
-    area threshold when the extents tile evenly, dense otherwise."""
-    if height * width >= SPARSE_AUTO_AREA and height % tile == 0 \
+    area threshold when the extents tile evenly, dense otherwise.
+
+    The threshold is the tuned/plan-cached crossover when one exists
+    (``gol tune --sparse-crossover`` measures it; absent or unreadable
+    cache degrades to the bundled default — the usual plan-cache
+    contract), or ``area_threshold`` when the caller pins one."""
+    if area_threshold is None:
+        try:
+            from gol_tpu.tune import select
+
+            area_threshold = select.sparse_auto_area(SPARSE_AUTO_AREA)
+        except Exception:  # noqa: BLE001 - cache trouble = default
+            area_threshold = SPARSE_AUTO_AREA
+    if height * width >= area_threshold and height % tile == 0 \
             and width % tile == 0:
         return "sparse"
     return "dense"

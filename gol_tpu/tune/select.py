@@ -136,6 +136,47 @@ def marginal_rates() -> dict[str, float]:
     return out
 
 
+def sparse_fingerprint() -> str:
+    """The sparse-engine crossover covers the whole universe space on one
+    device — grid/convention/family wildcarded like the serve geometry."""
+    return plans.fingerprint("sparse", 0, 0, "any", "any", (1, 1),
+                             plans.device_kind())
+
+
+# The admissible crossover band: below 2^16 cells even a lone glider's
+# dense canvas is trivial; above 2^36 the dense lane is ruled out by the
+# cells guard long before the threshold matters. A cached value outside
+# the band is a corrupt/hand-edited entry and degrades loudly.
+SPARSE_AREA_FLOOR = 1 << 16
+SPARSE_AREA_CEIL = 1 << 36
+
+
+def sparse_auto_area(default: int) -> int:
+    """The measured dense/sparse crossover area for `--engine auto`
+    (``gol run --pattern``): the plan-cached value this host measured
+    (``gol tune --sparse-crossover``), else the bundled default, else
+    ``default`` (the engine's shipped constant). Invalid entries are
+    rejected loudly — a corrupt cache must not flip giant universes onto
+    the dense lane."""
+    entry = _store().get(sparse_fingerprint())
+    if entry is None:
+        entry = _store().get_default("sparse")
+    if not entry:
+        return default
+    try:
+        area = int(entry["auto_area"])
+        if not SPARSE_AREA_FLOOR <= area <= SPARSE_AREA_CEIL:
+            raise ValueError(f"auto_area {area} outside "
+                             f"[{SPARSE_AREA_FLOOR}, {SPARSE_AREA_CEIL}]")
+    except (KeyError, TypeError, ValueError) as err:
+        logger.warning("unusable sparse crossover plan (%s: %s); using the "
+                       "built-in threshold", type(err).__name__, err)
+        return default
+    if area != default:
+        logger.info("tuned sparse auto threshold: %d cells", area)
+    return area
+
+
 def warm_entries() -> list[dict]:
     """Shapes recorded by the offline tuner for server warmup: each entry is
     ``{"height", "width", "convention", ...}`` — `gol serve --warm-plans`

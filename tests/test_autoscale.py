@@ -1,0 +1,943 @@
+"""The elastic fleet (gol_tpu/fleet/autoscale.py + affinity.py): weighted
+placement, scale-event disruption bounds, the autoscaler decision loop,
+drain->retire, the tuned sparse auto threshold, and the shard-across
+membership refresh.
+
+The load-bearing pins:
+
+- weighted HRW with EQUAL weights is byte-identical to plain HRW (it
+  delegates — affinity off and affinity-on-with-no-weights are the same
+  code path);
+- a scale event moves ONLY the affected worker's buckets: adding a worker
+  moves exactly the buckets it now owns, retiring one moves exactly its
+  buckets, and the survivors' relative order never changes (the
+  compile-budget story under autoscaling);
+- scale-down NEVER loses a job: ``Fleet.retire`` aborts unless the drain
+  completed, and the partition's journal keeps every done record.
+"""
+
+import json
+import os
+import threading
+import types
+
+import pytest
+
+from gol_tpu.fleet import affinity, placement
+from gol_tpu.fleet.autoscale import (
+    DOWN, HOLD, UP, AutoscaleConfig, Autoscaler,
+)
+from gol_tpu.fleet.workers import Fleet, Worker
+from gol_tpu.obs import history as obs_history
+from gol_tpu.obs.registry import Registry
+
+
+def _labels(n=40):
+    return [f"{32 * i}x{32 * i}/c" for i in range(1, n + 1)]
+
+
+class TestWeightedPlacement:
+    def test_equal_weights_byte_identical_to_plain(self):
+        """The --affinity pin: all-equal weights (any value) must rank
+        exactly like plain HRW — rank_weighted delegates to rank."""
+        ids = ["w0", "w1", "w2", "w3"]
+        for value in (1.0, 2.5, 7):
+            weights = {w: value for w in ids}
+            for lbl in _labels():
+                assert placement.rank_weighted(lbl, weights) == \
+                    placement.rank(lbl, ids)
+
+    def test_deterministic_and_complete(self):
+        weights = {"w0": 1.0, "w1": 4.0, "w2": 2.0}
+        for lbl in _labels(10):
+            first = placement.rank_weighted(lbl, weights)
+            assert first == placement.rank_weighted(lbl, weights)
+            assert sorted(first) == sorted(weights)
+
+    def test_weight_biases_ownership_proportionally(self):
+        """An 8x-weight worker owns ~8x the buckets (the 2-core vs
+        8-core slice story). Loose bounds — this is a hash distribution,
+        not an exact split."""
+        weights = {"w0": 1.0, "w1": 8.0, "w2": 1.0}
+        owners = {w: 0 for w in weights}
+        for lbl in _labels(400):
+            owners[placement.rank_weighted(lbl, weights)[0]] += 1
+        assert owners["w1"] > 4 * owners["w0"]
+        assert owners["w1"] > 4 * owners["w2"]
+        assert owners["w0"] > 0 and owners["w2"] > 0
+
+    def test_non_positive_weights_default(self):
+        """A zero/negative/garbage weight is the 1.0 default, not a
+        crash and not never-place-here (membership's job)."""
+        got = placement.rank_weighted("64x64/c", {"w0": 0.0, "w1": -3.0})
+        assert sorted(got) == ["w0", "w1"]
+        # All non-positive -> all default -> the plain-HRW delegation.
+        assert got == placement.rank("64x64/c", ["w0", "w1"])
+
+
+class TestScaleEventDisruption:
+    """The ISSUE's placement-disruption contract: every scale event moves
+    only the affected buckets, for BOTH the plain and weighted layers."""
+
+    def _assert_only_victims_move(self, rank_before, rank_after, added=None,
+                                  removed=None):
+        moved = []
+        for lbl in _labels():
+            before, after = rank_before(lbl), rank_after(lbl)
+            if removed is not None:
+                # Survivors keep their relative order in full.
+                assert after == [w for w in before if w != removed], lbl
+                if before[0] == removed:
+                    moved.append(lbl)
+            if added is not None:
+                assert [w for w in after if w != added] == before, lbl
+                if after[0] == added:
+                    moved.append(lbl)
+        # A scale event that moves nothing at all would be suspicious too:
+        # the hash must actually hand the new/removed worker some buckets.
+        assert moved
+
+    def test_add_worker_moves_only_its_buckets_plain(self):
+        ids = ["w0", "w1", "w2"]
+        self._assert_only_victims_move(
+            lambda lbl: placement.rank(lbl, ids),
+            lambda lbl: placement.rank(lbl, ids + ["w3"]),
+            added="w3",
+        )
+
+    def test_retire_worker_moves_only_its_buckets_plain(self):
+        ids = ["w0", "w1", "w2", "w3"]
+        self._assert_only_victims_move(
+            lambda lbl: placement.rank(lbl, ids),
+            lambda lbl: placement.rank(lbl, [w for w in ids if w != "w1"]),
+            removed="w1",
+        )
+
+    def test_add_worker_moves_only_its_buckets_weighted(self):
+        weights = {"w0": 2.0, "w1": 4.0, "w2": 1.0}
+        grown = {**weights, "w3": 4.0}
+        self._assert_only_victims_move(
+            lambda lbl: placement.rank_weighted(lbl, weights),
+            lambda lbl: placement.rank_weighted(lbl, grown),
+            added="w3",
+        )
+
+    def test_retire_worker_moves_only_its_buckets_weighted(self):
+        weights = {"w0": 2.0, "w1": 4.0, "w2": 1.0, "w3": 3.0}
+        shrunk = {w: v for w, v in weights.items() if w != "w2"}
+        self._assert_only_victims_move(
+            lambda lbl: placement.rank_weighted(lbl, weights),
+            lambda lbl: placement.rank_weighted(lbl, shrunk),
+            removed="w2",
+        )
+
+    def test_reweighting_one_worker_never_reshuffles_third_parties(self):
+        """Adopting an advertised weight for one worker must not move a
+        bucket between two OTHER workers (the weighted-rendezvous analog
+        of minimal disruption)."""
+        weights = {"w0": 2.0, "w1": 4.0, "w2": 3.0}
+        bumped = {**weights, "w1": 8.0}
+        for lbl in _labels():
+            before = placement.rank_weighted(lbl, weights)
+            after = placement.rank_weighted(lbl, bumped)
+            assert [w for w in after if w != "w1"] == \
+                [w for w in before if w != "w1"], lbl
+
+
+class TestAffinityWeights:
+    def test_pinned_weight_wins_and_suppresses_advertised(self):
+        """Cores and cells/s are different units: one pinned weight in
+        the pool switches the WHOLE pool to pinned-or-default."""
+        pool = [
+            Worker(id="w0", weight=8.0, advertised_weight=1e8),
+            Worker(id="w1", advertised_weight=5e7),
+            Worker(id="w2"),
+        ]
+        assert affinity.weights_for(pool) == {
+            "w0": 8.0, "w1": affinity.DEFAULT_WEIGHT,
+            "w2": affinity.DEFAULT_WEIGHT,
+        }
+
+    def test_advertised_weights_used_when_nothing_pinned(self):
+        pool = [
+            Worker(id="w0", advertised_weight=2e8),
+            Worker(id="w1", advertised_weight=1e8),
+            Worker(id="w2"),
+        ]
+        assert affinity.weights_for(pool) == {
+            "w0": 2e8, "w1": 1e8, "w2": affinity.DEFAULT_WEIGHT,
+        }
+
+    def test_all_default_is_plain_hrw(self):
+        pool = [Worker(id="w0"), Worker(id="w1"), Worker(id="w2")]
+        weights = affinity.weights_for(pool)
+        for lbl in _labels(10):
+            assert placement.rank_weighted(lbl, weights) == \
+                placement.rank(lbl, ["w0", "w1", "w2"])
+
+    def test_garbage_weights_degrade_to_default(self):
+        pool = [Worker(id="w0", weight=float("nan") if False else None,
+                       advertised_weight="fast")]
+        assert affinity.weights_for(pool) == {"w0": affinity.DEFAULT_WEIGHT}
+
+
+# -- autoscaler unit rig ----------------------------------------------------
+
+class _StubFleet:
+    """Just enough Fleet for the decision loop: live workers + recording
+    actuators whose behavior the test scripts."""
+
+    def __init__(self, n=1):
+        self._workers = [Worker(id=f"w{i}", url=f"http://w{i}")
+                         for i in range(n)]
+        self.spawned = 0
+        self.retired = []
+        self.retire_ok = True
+        self.spawn_error = None
+
+    def workers(self):
+        return list(self._workers)
+
+    def spawn(self, *a, **k):
+        if self.spawn_error is not None:
+            raise self.spawn_error
+        self.spawned += 1
+        worker = Worker(id=f"w{len(self._workers)}", url="http://new")
+        self._workers.append(worker)
+        return worker
+
+    def retire(self, worker_id, drain_timeout=600.0):
+        if not self.retire_ok:
+            return False
+        self.retired.append(worker_id)
+        self._workers = [w for w in self._workers if w.id != worker_id]
+        return True
+
+
+class _StubRouter:
+    """Signals come from per-worker snapshot gauges (what the scoped
+    ``Autoscaler.signals`` sums): ``queued``/``inflight`` land on the
+    first worker unless ``per_worker`` spells out a distribution."""
+
+    def __init__(self, fleet):
+        self.fleet = fleet
+        self.registry = Registry(prefix="gol_fleet")
+        self._draining = False
+        self.queued = 0.0
+        self.inflight = 0.0
+        self.per_worker = {}
+
+    def _merged_snapshot(self):
+        snaps = {}
+        for i, w in enumerate(self.fleet.workers()):
+            q = self.per_worker.get(w.id)
+            if q is None:
+                q = self.queued if i == 0 else 0.0
+            snaps[w.id] = {"gauges": {
+                "queue_depth": q,
+                "inflight_batches": self.inflight if i == 0 else 0.0,
+            }}
+        return snaps, {"gauges": {}}
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def _rig(n=1, history=None, **cfg):
+    config = AutoscaleConfig(**{
+        "min_workers": 1, "max_workers": 4, "up_sustain": 2,
+        "down_sustain": 3, "cooldown_s": 10.0, **cfg,
+    })
+    fleet = _StubFleet(n)
+    router = _StubRouter(fleet)
+    clock = _Clock()
+    scaler = Autoscaler(fleet, router, config, queue_capacity=100,
+                        history=history, clock=clock, sync_actions=True)
+    return types.SimpleNamespace(fleet=fleet, router=router, clock=clock,
+                                 scaler=scaler, config=config)
+
+
+class TestAutoscalerDecisions:
+    def test_saturation_scales_up_after_sustain(self):
+        rig = _rig(n=1)
+        rig.router.queued = 90.0  # 0.9 of the 100-cap, n=1
+        first = rig.scaler.tick()
+        assert first["action"] == HOLD and rig.fleet.spawned == 0
+        second = rig.scaler.tick()
+        assert second["action"] == UP
+        assert rig.fleet.spawned == 1
+        assert "saturation" in second["reason"]
+        assert rig.router.registry.counter("autoscaler_scale_ups_total") == 1
+
+    def test_blip_does_not_scale(self):
+        """One saturated tick then recovery: the sustain window holds."""
+        rig = _rig(n=1)
+        rig.router.queued = 95.0
+        rig.scaler.tick()
+        rig.router.queued = 10.0
+        rig.scaler.tick()
+        rig.router.queued = 95.0
+        rig.scaler.tick()
+        assert rig.fleet.spawned == 0
+
+    def test_cooldown_blocks_consecutive_events(self):
+        rig = _rig(n=1)
+        rig.router.queued = 95.0
+        rig.scaler.tick()
+        rig.scaler.tick()
+        assert rig.fleet.spawned == 1
+        # Still saturated (each worker adds 100 of cap; queue split): the
+        # cooldown must hold the second spawn until the clock passes it.
+        rig.router.queued = 190.0
+        rig.scaler.tick()
+        rig.scaler.tick()
+        rig.scaler.tick()
+        assert rig.fleet.spawned == 1
+        rig.clock.now += 11.0  # past cooldown_s=10
+        rig.scaler.tick()
+        rig.scaler.tick()
+        assert rig.fleet.spawned == 2
+
+    def test_slo_critical_burn_scales_up_without_saturation(self):
+        rig = _rig(n=1)
+        rig.fleet._workers[0].slo = {
+            "status": "critical",
+            "objectives": [{"name": "latency_p99_normal",
+                            "status": "critical", "burn": 3.2}],
+        }
+        rig.scaler.tick()
+        decision = rig.scaler.tick()
+        assert decision["action"] == UP
+        assert "slo critical" in decision["reason"]
+        assert "w0:latency_p99_normal" in decision["reason"]
+
+    def test_max_workers_clamps(self):
+        rig = _rig(n=4)
+        rig.router.queued = 400.0
+        rig.scaler.tick()
+        decision = rig.scaler.tick()
+        assert decision["action"] == HOLD
+        assert "max_workers" in decision["reason"]
+        assert rig.fleet.spawned == 0
+
+    def test_idle_scales_down_to_emptiest_after_sustain(self):
+        rig = _rig(n=3)
+        rig.router.queued = 0.0
+        rig.router.per_worker = {"w0": 4.0, "w1": 0.0, "w2": 2.0}
+        for _ in range(2):
+            assert rig.scaler.tick()["action"] == HOLD
+        decision = rig.scaler.tick()
+        assert decision["action"] == DOWN
+        assert decision["victim"] == "w1"  # the emptiest
+        assert rig.fleet.retired == ["w1"]
+        assert rig.router.registry.counter(
+            "autoscaler_scale_downs_total") == 1
+
+    def test_min_workers_floor(self):
+        rig = _rig(n=1)
+        for _ in range(5):
+            decision = rig.scaler.tick()
+        assert decision["action"] == HOLD
+        assert rig.fleet.retired == []
+
+    def test_burn_blocks_scale_down(self):
+        """An idle queue with a burning SLO is not idle capacity — a
+        drain would amplify exactly the burn being measured."""
+        rig = _rig(n=2)
+        rig.fleet._workers[0].slo = {
+            "status": "warning",
+            "objectives": [{"name": "x", "status": "warning", "burn": 1.4}],
+        }
+        for _ in range(5):
+            rig.scaler.tick()
+        assert rig.fleet.retired == []
+
+    def test_failed_spawn_counts_and_cooldown_still_applies(self):
+        rig = _rig(n=1)
+        rig.fleet.spawn_error = RuntimeError("boot died")
+        rig.router.queued = 95.0
+        rig.scaler.tick()
+        rig.scaler.tick()
+        assert rig.router.registry.counter(
+            "autoscaler_scale_failures_total") == 1
+        # The failure still starts the cooldown (retry pacing, not a
+        # tight respawn loop against a broken image).
+        rig.scaler.tick()
+        assert rig.fleet.spawned == 0
+
+    def test_failed_retire_counts_and_keeps_worker(self):
+        rig = _rig(n=2)
+        rig.fleet.retire_ok = False
+        for _ in range(4):
+            rig.scaler.tick()
+        assert rig.router.registry.counter(
+            "autoscaler_scale_failures_total") == 1
+        assert len(rig.fleet.workers()) == 2
+
+    def test_draining_router_freezes_decisions(self):
+        rig = _rig(n=1)
+        rig.router.queued = 95.0
+        rig.router._draining = True
+        assert rig.scaler.tick() is None
+        assert rig.fleet.spawned == 0
+
+    def test_gauges_exported_per_tick(self):
+        rig = _rig(n=2)
+        rig.router.queued = 50.0
+        rig.scaler.tick()
+        snap = rig.router.registry.snapshot()
+        assert snap["gauges"]["autoscaler_workers"] == 2
+        assert snap["gauges"]["autoscaler_queue_saturation"] == \
+            pytest.approx(0.25)
+        assert snap["counters"]["autoscaler_ticks_total"] == 1
+
+    def test_decisions_land_in_the_history_ring(self, tmp_path):
+        """Every tick is a durable record; scale events carry their
+        outcome — the series `gol history-report` and the bench suite
+        replay to answer WHY the fleet grew."""
+        writer = obs_history.HistoryWriter(str(tmp_path / "ring"),
+                                           source="autoscaler")
+        rig = _rig(n=1, history=writer)
+        rig.router.queued = 95.0
+        rig.scaler.tick()
+        rig.scaler.tick()
+        writer.close()
+        records = [r for r in obs_history.read_records(str(tmp_path / "ring"))
+                   if "autoscaler" in r]
+        assert len(records) == 3  # two decision ticks + one scale outcome
+        actions = [r["autoscaler"].get("action") for r in records]
+        assert actions.count(UP) == 2  # the decision AND its outcome record
+        outcome = next(r["autoscaler"] for r in records
+                       if r["autoscaler"].get("record_kind") == "scale")
+        assert outcome["ok"] is True
+
+    def test_down_demotes_to_hold_consistently(self):
+        """A DOWN with no retireable victim must read HOLD on EVERY
+        surface — gauges, the panel, and the durable ring never
+        disagree about what a tick decided."""
+        rig = _rig(n=2, down_sustain=1)
+        rig.scaler._pick_victim = lambda signals: None
+        decision = rig.scaler.tick()
+        assert decision["action"] == HOLD
+        assert decision["reason"] == "no retireable worker"
+        assert decision["target"] == 2
+        snap = rig.router.registry.snapshot()
+        assert snap["gauges"]["autoscaler_target_workers"] == 2
+        assert rig.scaler.public()["last_decision"]["action"] == HOLD
+
+    def test_big_and_retiring_workers_scoped_out_of_signals(self):
+        """Big-lane queues/burn cannot be absorbed by spawning normal
+        workers, and a retiring worker's stored /slo is frozen — neither
+        may drive (or veto) a decision about the normal pool."""
+        rig = _rig(n=2)
+        big = Worker(id="big0", url="http://big0", big=True,
+                     slo={"status": "critical",
+                          "objectives": [{"name": "x", "status": "critical",
+                                          "burn": 9.9}]})
+        rig.fleet._workers.append(big)
+        rig.router.per_worker = {"big0": 5000.0, "w0": 0.0, "w1": 0.0}
+        signals = rig.scaler.signals()
+        assert signals["queued"] == 0.0
+        assert signals["burn"] == 0.0 and signals["critical"] == []
+        # A retiring normal leaves both the capacity denominator and the
+        # burn signal.
+        rig.fleet._workers[0].retiring = True
+        rig.fleet._workers[0].slo = {
+            "status": "critical",
+            "objectives": [{"name": "y", "status": "critical", "burn": 5.0}],
+        }
+        signals = rig.scaler.signals()
+        assert signals["pool"] == 1
+        assert signals["critical"] == []
+
+    def test_public_shape(self):
+        rig = _rig(n=1)
+        rig.scaler.tick()
+        pub = rig.scaler.public()
+        assert pub["enabled"] is True
+        assert pub["min"] == 1 and pub["max"] == 4
+        assert pub["workers"] == 1
+        assert pub["last_decision"]["action"] == HOLD
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_workers=0)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_workers=3, max_workers=2)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(up_saturation=0.3, down_occupancy=0.4)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(cooldown_s=-1)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(up_sustain=0)
+
+
+class TestFleetRetire:
+    def _fleet(self, tmp_path, http):
+        fleet = Fleet(str(tmp_path / "fleet"),
+                      probe=lambda *a, **k: None, http=http)
+        worker = Worker(id="w0", url="http://w0",
+                        journal_dir=str(tmp_path / "fleet" / "w0"))
+        fleet._add(worker)
+        other = Worker(id="w1", url="http://w1",
+                       journal_dir=str(tmp_path / "fleet" / "w1"))
+        fleet._add(other)
+        return fleet, worker
+
+    def test_retire_drains_then_removes_from_membership(self, tmp_path):
+        calls = []
+
+        def http(method, url, body=None, timeout=0):
+            calls.append((method, url))
+            return 200, {"drained": True}
+
+        fleet, worker = self._fleet(tmp_path, http)
+        assert fleet.retire("w0") is True
+        assert calls == [("POST", "http://w0/drain")]
+        assert fleet.worker("w0") is None
+        assert fleet.worker("w1") is not None
+        with open(fleet.manifest_path) as f:
+            manifest = json.load(f)
+        assert [p["id"] for p in manifest["partitions"]] == ["w1"]
+
+    def test_failed_drain_aborts_the_retire_via_respawn(self, tmp_path):
+        """A failed drain may still have LANDED — and a draining
+        scheduler refuses work forever, so the abort path must respawn
+        the worker on its partition, never hand the old process back."""
+        def http(method, url, body=None, timeout=0):
+            return 200, {"drained": False}
+
+        fleet, worker = self._fleet(tmp_path, http)
+        respawned = []
+        fleet._respawn = lambda w: respawned.append(w.id)
+        assert fleet.retire("w0") is False
+        assert fleet.worker("w0") is not None  # still a member
+        assert respawned == ["w0"]
+        assert worker.retiring is False  # back under health supervision
+
+    def test_unreachable_drain_aborts_the_retire(self, tmp_path):
+        def http(method, url, body=None, timeout=0):
+            raise OSError("connection refused")
+
+        fleet, worker = self._fleet(tmp_path, http)
+        respawned = []
+        fleet._respawn = lambda w: respawned.append(w.id)
+        assert fleet.retire("w0") is False
+        assert respawned == ["w0"]
+        assert worker.retiring is False
+
+    def test_failed_spawn_rolls_back_membership(self, tmp_path, monkeypatch):
+        """A boot that never becomes ready must not leave a zombie in
+        membership: the health loop would respawn the same broken image
+        every tick, bypassing the autoscaler's cooldown pacing."""
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+
+        class _Proc:
+            killed = False
+
+            def poll(self):
+                return None if not self.killed else 1
+
+            def kill(self):
+                self.killed = True
+
+            def wait(self, timeout=None):
+                return 1
+
+        proc = _Proc()
+
+        def fake_launch(worker):
+            worker.proc = proc
+            worker.pid = 999999
+            return worker
+
+        monkeypatch.setattr(fleet, "_launch", fake_launch)
+
+        def never_ready(worker):
+            raise RuntimeError("boot died")
+
+        monkeypatch.setattr(fleet, "_await_ready", never_ready)
+        with pytest.raises(RuntimeError):
+            fleet.spawn()
+        assert fleet.workers() == []
+        assert proc.killed
+        with open(fleet.manifest_path) as f:
+            assert json.load(f)["partitions"] == []
+
+    def test_attached_and_big_and_unknown_refused(self, tmp_path):
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet._add(Worker(id="a0", url="http://a0", attached=True))
+        fleet._add(Worker(id="big0", url="http://b0", big=True))
+        assert fleet.retire("a0") is False
+        assert fleet.retire("big0") is False
+        assert fleet.retire("nope") is False
+
+    def test_health_tick_skips_retiring_workers(self, tmp_path):
+        probes = []
+
+        def probe(url, path="/healthz", **k):
+            probes.append((url, path))
+            return {"ok": True}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=probe)
+        worker = Worker(id="w0", url="http://w0", retiring=True)
+        fleet._add(worker)
+        fleet.health_tick()
+        assert probes == []  # mid-retire: the retire thread owns it
+
+    def test_tick_hooks_ride_the_health_tick(self, tmp_path):
+        fleet = Fleet(str(tmp_path / "fleet"),
+                      probe=lambda *a, **k: {"ok": True})
+        seen = []
+        fleet.add_tick_hook(lambda: seen.append(1))
+        fleet.health_tick()
+        fleet.health_tick()
+        assert seen == [1, 1]
+
+    def test_health_tick_adopts_advertised_weight(self, tmp_path):
+        def probe(url, path="/healthz", **k):
+            if path == "/healthz":
+                return {"ok": True, "weight": 2.5e8}
+            return {"status": "ok"}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=probe)
+        worker = Worker(id="w0", url="http://w0")
+        fleet._add(worker)
+        fleet.health_tick()
+        assert worker.advertised_weight == 2.5e8
+        assert worker.slo == {"status": "ok"}
+        # A pinned weight is never overwritten by advertisement.
+        pinned = Worker(id="w1", url="http://w1", weight=4.0)
+        fleet._add(pinned)
+        fleet.health_tick()
+        assert pinned.weight == 4.0
+        assert pinned.advertised_weight is None
+
+    def test_manifest_round_trips_weight(self, tmp_path):
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet._add(Worker(id="w0", url="http://w0", attached=True,
+                          weight=6.0))
+        fresh = Fleet(str(tmp_path / "fleet"),
+                      probe=lambda *a, **k: {"ok": True})
+        fresh.load()
+        assert fresh.worker("w0").weight == 6.0
+
+
+class TestRouterIntegration:
+    """Router-level affinity + retiring semantics, over a stub fleet (no
+    HTTP to workers; the router's own server binds a real port)."""
+
+    def _router(self, tmp_path, workers, **kwargs):
+        from gol_tpu.fleet.router import RouterServer
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        for worker in workers:
+            fleet._add(worker)
+        router = RouterServer(fleet, port=0, **kwargs)
+        return router
+
+    def test_affinity_off_and_equal_weights_byte_identical(self, tmp_path):
+        workers = [Worker(id=f"w{i}", url=f"http://w{i}") for i in range(3)]
+        plain = self._router(tmp_path, workers)
+        weighted = self._router(tmp_path / "b", [
+            Worker(id=f"w{i}", url=f"http://w{i}") for i in range(3)
+        ], affinity_route=True)
+        try:
+            for i in range(1, 20):
+                key = placement.key_for({"width": 32 * i, "height": 32 * i})
+                assert [w.id for w in plain.candidates(key)] == \
+                    [w.id for w in weighted.candidates(key)]
+        finally:
+            plain.httpd.server_close()
+            weighted.httpd.server_close()
+
+    def test_affinity_weights_change_ownership(self, tmp_path):
+        heavy = [
+            Worker(id="w0", url="http://w0", weight=1.0),
+            Worker(id="w1", url="http://w1", weight=16.0),
+            Worker(id="w2", url="http://w2", weight=1.0),
+        ]
+        router = self._router(tmp_path, heavy, affinity_route=True)
+        try:
+            owners = {}
+            for i in range(1, 60):
+                key = placement.key_for({"width": 32 * i, "height": 32 * i})
+                owner = router.candidates(key)[0].id
+                owners[owner] = owners.get(owner, 0) + 1
+            assert owners.get("w1", 0) > owners.get("w0", 0)
+            assert owners.get("w1", 0) > owners.get("w2", 0)
+        finally:
+            router.httpd.server_close()
+
+    def test_retiring_worker_excluded_from_submits_not_lookups(self, tmp_path):
+        workers = [
+            Worker(id="w0", url="http://w0"),
+            Worker(id="w1", url="http://w1", retiring=True),
+        ]
+        router = self._router(tmp_path, workers)
+        try:
+            key = placement.key_for({"width": 64, "height": 64})
+            assert [w.id for w in router.candidates(key)] == ["w0"]
+
+            # forward_job still reaches the retiring worker: its drain is
+            # finishing jobs whose results clients are polling for.
+            seen = []
+
+            def http(method, url, body=None, timeout=0, **k):
+                seen.append(url)
+                if "w1" in url:
+                    return 200, {"state": "done"}
+                return 404, {"error": "nope"}
+
+            router.http = http
+            status, payload = router.forward_job("GET", "job-1")
+            assert status == 200
+            assert any("w1" in url for url in seen)
+        finally:
+            router.httpd.server_close()
+
+    def test_metrics_and_fleet_carry_autoscaler_panel(self, tmp_path):
+        workers = [Worker(id="w0", url="http://w0")]
+        router = self._router(tmp_path, workers)
+        try:
+            # No autoscaler: no section (old payload shape pinned).
+            assert "autoscaler" not in router.fleet_json()
+            scaler = Autoscaler(
+                _StubFleet(1), _StubRouter(_StubFleet(1)),
+                AutoscaleConfig(), sync_actions=True,
+            )
+            router.autoscaler = scaler
+            assert router.fleet_json()["autoscaler"]["enabled"] is True
+            router.http = lambda *a, **k: (200, {"counters": {},
+                                                 "gauges": {},
+                                                 "histograms": {}})
+            merged = router.metrics_json()
+            assert merged["fleet"]["autoscaler"]["min"] == 1
+        finally:
+            router.httpd.server_close()
+
+
+class TestTopPanel:
+    def test_autoscaler_line_renders(self):
+        from gol_tpu.obs import top
+
+        frame = top.render_frame({
+            "counters": {}, "gauges": {}, "histograms": {},
+            "fleet": {
+                "workers": 3, "healthy": 3, "backpressured": 0,
+                "restarts": 0, "retiring": 1,
+                "autoscaler": {
+                    "enabled": True, "min": 1, "max": 4, "workers": 3,
+                    "target": 4, "scaling": True,
+                    "last_decision": {
+                        "action": "up", "reason": "queue saturation "
+                        "0.93 >= 0.80", "saturation": 0.93,
+                        "occupancy": 0.95, "burn": 2.1,
+                    },
+                },
+            },
+        }, None, ansi=False)
+        assert "autoscale: 3 workers (target 4, min 1 max 4)" in frame
+        assert "SCALING" in frame
+        assert "last: up (queue saturation 0.93 >= 0.80)" in frame
+        assert "1 retiring" in frame
+
+    def test_no_autoscaler_no_line(self):
+        from gol_tpu.obs import top
+
+        frame = top.render_frame({
+            "counters": {}, "gauges": {}, "histograms": {},
+            "fleet": {"workers": 2, "healthy": 2, "backpressured": 0,
+                      "restarts": 0},
+        }, None, ansi=False)
+        assert "autoscale:" not in frame
+
+
+class TestShardTargets:
+    def _targets(self, payloads, enabled=True, refresh_s=5.0):
+        from gol_tpu.cli import _ShardTargets
+
+        clock = _Clock()
+        calls = []
+
+        def fetch(url):
+            calls.append(url)
+            return payloads[min(len(calls) - 1, len(payloads) - 1)]
+
+        t = _ShardTargets("http://router", enabled, refresh_s=refresh_s,
+                          fetch=fetch, clock=clock)
+        return t, clock, calls
+
+    def _fleet_payload(self, n):
+        return {"workers": [
+            {"id": f"w{i}", "url": f"http://w{i}", "healthy": True}
+            for i in range(n)
+        ]}
+
+    def test_round_robin_over_current_membership(self):
+        t, clock, calls = self._targets([self._fleet_payload(2)])
+        t.refresh(force=True)
+        assert [t.next() for _ in range(4)] == \
+            ["http://w0", "http://w1", "http://w0", "http://w1"]
+        assert len(calls) == 1  # interval-gated: no refetch per next()
+
+    def test_interval_refetch_sees_autoscaled_workers(self):
+        t, clock, calls = self._targets(
+            [self._fleet_payload(1), self._fleet_payload(3)],
+        )
+        t.refresh(force=True)
+        assert t.next() == "http://w0"
+        clock.now += 6.0  # past refresh_s
+        got = {t.next() for _ in range(3)}
+        assert got == {"http://w0", "http://w1", "http://w2"}
+        assert len(calls) == 2
+
+    def test_429_forces_refetch(self):
+        t, clock, calls = self._targets(
+            [self._fleet_payload(1), self._fleet_payload(2)],
+        )
+        t.refresh(force=True)
+        t.on_429()  # no clock advance: still refetches
+        assert len(calls) == 2
+        assert t.targets == ["http://w0", "http://w1"]
+
+    def test_single_server_stays_noop(self):
+        t, clock, calls = self._targets([{}])
+        t.refresh(force=True)
+        assert t.targets == ["http://router"]
+        assert t.next() == "http://router"
+
+    def test_disabled_never_fetches(self):
+        t, clock, calls = self._targets([self._fleet_payload(3)],
+                                        enabled=False)
+        t.refresh(force=True)
+        assert calls == []
+        assert t.next() == "http://router"
+
+    def test_unreachable_refetch_keeps_current_targets(self):
+        t, clock, calls = self._targets([self._fleet_payload(2), {}])
+        t.refresh(force=True)
+        clock.now += 6.0
+        t.refresh()
+        assert t.targets == ["http://w0", "http://w1"]
+
+    def test_retiring_and_big_workers_excluded(self):
+        payload = {"workers": [
+            {"id": "w0", "url": "http://w0", "healthy": True},
+            {"id": "w1", "url": "http://w1", "healthy": True,
+             "retiring": True},
+            {"id": "big0", "url": "http://b0", "healthy": True, "big": True},
+        ]}
+        t, clock, calls = self._targets([payload])
+        t.refresh(force=True)
+        assert t.targets == ["http://w0"]
+
+
+class TestSparseAutoThreshold:
+    def test_bundled_default_is_the_measured_crossover(self):
+        from gol_tpu.sparse.engine import SPARSE_AUTO_AREA
+        from gol_tpu.tune import select
+
+        assert SPARSE_AUTO_AREA == 1 << 25
+        # conftest points GOL_PLAN_CACHE at an empty tmp file, so this
+        # reads the bundled default entry — pinned equal to the constant.
+        assert select.sparse_auto_area(SPARSE_AUTO_AREA) == 1 << 25
+
+    def test_cached_value_consulted(self, tmp_path, monkeypatch):
+        from gol_tpu.tune import plans, select
+
+        monkeypatch.setenv(plans.ENV_CACHE_PATH,
+                           str(tmp_path / "plans.json"))
+        select.reset()
+        try:
+            store = plans.PlanStore()
+            store.put(select.sparse_fingerprint(), {"auto_area": 1 << 22})
+            select.reset()
+            assert select.sparse_auto_area(1 << 25) == 1 << 22
+        finally:
+            select.reset()
+
+    def test_invalid_cached_value_degrades_loudly(self, tmp_path,
+                                                  monkeypatch, caplog):
+        from gol_tpu.tune import plans, select
+
+        monkeypatch.setenv(plans.ENV_CACHE_PATH,
+                           str(tmp_path / "plans.json"))
+        select.reset()
+        try:
+            store = plans.PlanStore()
+            store.put(select.sparse_fingerprint(), {"auto_area": 64})
+            select.reset()
+            with caplog.at_level("WARNING", logger="gol_tpu.tune.select"):
+                assert select.sparse_auto_area(1 << 25) == 1 << 25
+            assert any("sparse crossover" in r.message
+                       for r in caplog.records)
+        finally:
+            select.reset()
+
+    def test_auto_engine_respects_threshold(self):
+        from gol_tpu.sparse.engine import auto_engine
+
+        assert auto_engine(2048, 2048, 256,
+                           area_threshold=1 << 22) == "sparse"
+        assert auto_engine(2048, 2048, 256,
+                           area_threshold=1 << 23) == "dense"
+        # Uneven tiling always stays dense, threshold notwithstanding.
+        assert auto_engine(2048 + 1, 2048, 256,
+                           area_threshold=1 << 20) == "dense"
+
+    def test_auto_engine_consults_plan_cache(self, tmp_path, monkeypatch):
+        from gol_tpu.sparse.engine import auto_engine
+        from gol_tpu.tune import plans, select
+
+        monkeypatch.setenv(plans.ENV_CACHE_PATH,
+                           str(tmp_path / "plans.json"))
+        select.reset()
+        try:
+            assert auto_engine(2048, 2048, 256) == "dense"  # 2^22 < default
+            store = plans.PlanStore()
+            store.put(select.sparse_fingerprint(), {"auto_area": 1 << 21})
+            select.reset()
+            assert auto_engine(2048, 2048, 256) == "sparse"
+        finally:
+            select.reset()
+
+
+class TestCrossoverFit:
+    def test_linear_fit_solves_the_crossover(self):
+        from gol_tpu.tune.measure import fit_crossover
+
+        # dense(area) = 1e-9 * area (no intercept), sparse flat at 4 ms:
+        # crossover at 4e6 cells.
+        dense = [(1 << 20, 1e-9 * (1 << 20)), (1 << 22, 1e-9 * (1 << 22))]
+        got = fit_crossover(dense, 4e-3)
+        assert got == pytest.approx(4_000_000, rel=0.01)
+
+    def test_intercept_respected(self):
+        from gol_tpu.tune.measure import fit_crossover
+
+        # dense = 2e-9 * area + 1ms, sparse 5ms -> area = 2e6
+        dense = [(10 ** 6, 3e-3), (2 * 10 ** 6, 5e-3), (3 * 10 ** 6, 7e-3)]
+        assert fit_crossover(dense, 5e-3) == pytest.approx(2e6, rel=0.01)
+
+    def test_clamped_to_band(self):
+        from gol_tpu.tune.measure import fit_crossover
+
+        dense = [(1 << 20, 1e-9 * (1 << 20)), (1 << 22, 1e-9 * (1 << 22))]
+        assert fit_crossover(dense, 1e-9) == 1 << 16  # floor
+        assert fit_crossover(dense, 1e9) == 1 << 36  # ceiling
+
+    def test_flat_dense_measurement_raises(self):
+        from gol_tpu.tune.measure import fit_crossover
+
+        with pytest.raises(ValueError):
+            fit_crossover([(1 << 20, 1e-3), (1 << 22, 1e-3)], 4e-3)
+        with pytest.raises(ValueError):
+            fit_crossover([(1 << 20, 1e-3)], 4e-3)
+        with pytest.raises(ValueError):
+            fit_crossover([(1 << 20, 1e-3), (1 << 22, 2e-3)], 0.0)

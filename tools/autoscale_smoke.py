@@ -1,0 +1,398 @@
+"""Elastic-fleet smoke: spike -> scale-up -> kill -> replay -> scale-down.
+
+The `make autoscale-smoke` harness, exercising the autoscaler acceptance
+end-to-end against real OS processes (the real `gol fleet` CLI with
+`--autoscale`, real `gol serve` workers, real SIGKILL):
+
+1. boot ``gol fleet --workers 1 --autoscale --max-workers 3`` with
+   aggressive bench knobs (fast health ticks, short cooldown, low
+   saturation threshold) on a fresh ``--fleet-dir``;
+2. apply a STEP LOAD: a feeder keeps ~160 jobs outstanding across eight
+   equal-work 160^2 buckets (every worker is pinned to its own 4-core
+   slice, so one worker is genuinely saturable) — queue saturation must
+   trip the autoscaler, and ``GET /fleet`` must show the fleet growing;
+3. SIGKILL one SCALED worker mid-load: the health loop must respawn it
+   on its partition and replay; the load keeps flowing meanwhile
+   (spillover), and the autoscaler must not fight the supervisor;
+4. stop the load and wait: every accepted job reports DONE through the
+   router, results spot-check byte-identical to the NumPy oracle;
+5. the idle fleet must retire back down to the ``--min-workers 1``
+   floor (drain -> retire, never losing a job);
+6. SIGTERM the fleet (cascaded drain, rc 0), then audit ACROSS ALL
+   journal partitions — including retired workers' partitions, which
+   stay on disk — that every accepted id has EXACTLY one done record
+   fleet-wide.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/autoscale_smoke.py [--jobs 600] [--gen-limit 3000]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu import oracle  # noqa: E402
+from gol_tpu.config import GameConfig  # noqa: E402
+from gol_tpu.io import text_grid  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# 8 equal-work buckets on one 160^2 canvas (distinct similarity
+# frequencies are baked program constants, so each is its own padding
+# bucket): enough buckets that rendezvous placement actually hands the
+# scaled-up workers load, the same trick as bench.py's fleet suites.
+SIDE = 160
+FREQS = (2, 3, 4, 5, 6, 7, 8, 9)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _start_fleet(port: int, fleet_dir: str):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "fleet",
+            "--port", str(port),
+            "--workers", "1",
+            "--fleet-dir", fleet_dir,
+            "--flush-age", "0.05",
+            "--health-interval", "0.4",
+            "--max-queue-depth", "256",
+            "--max-batch", "8",
+            # Pin every worker (incl. autoscaled spawns) to its own
+            # 4-core slice: the fixed per-worker budget that makes one
+            # worker saturable on a many-core host AND makes scale-up a
+            # real capacity increase.
+            "--cores-per-worker", "4",
+            "--autoscale",
+            "--min-workers", "1",
+            "--max-workers", "3",
+            "--scale-up-saturation", "0.2",
+            "--scale-up-sustain", "2",
+            "--scale-down-occupancy", "0.02",
+            "--scale-down-sustain", "8",
+            "--scale-cooldown", "2",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.perf_counter() + 300
+    base = f"http://127.0.0.1:{port}"
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(
+                f"fleet died on boot rc={proc.returncode}:\n{out[-4000:]}"
+            )
+        try:
+            status, payload = _http("GET", f"{base}/healthz", timeout=2)
+            if status == 200 and payload.get("fleet", {}).get("workers", 0) >= 1:
+                return proc
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("fleet did not become healthy within 300s")
+
+
+def _workers(base: str) -> list:
+    status, payload = _http("GET", f"{base}/fleet")
+    if status != 200:
+        raise RuntimeError(f"GET /fleet -> {status}: {payload}")
+    return payload["workers"]
+
+
+def _count_done(fleet_dir: str) -> dict:
+    done: dict = {}
+    for name in sorted(os.listdir(fleet_dir)):
+        path = os.path.join(fleet_dir, name, "journal.jsonl")
+        if not os.path.isfile(path):
+            continue
+        with open(path, "rb") as f:
+            for line in f.read().split(b"\n"):
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "done":
+                    done.setdefault(rec["id"], []).append((name, rec))
+    return done
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=600,
+                        help="total jobs the step load submits")
+    parser.add_argument("--gen-limit", type=int, default=3000)
+    parser.add_argument("--outstanding", type=int, default=160,
+                        help="jobs the feeder keeps in flight (the step)")
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-autoscale-smoke-")
+    fleet_dir = os.path.join(workdir, "fleet")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+
+    rc = 1
+    proc = None
+    accepted: dict = {}  # id -> (board, similarity frequency)
+    acc_lock = threading.Lock()
+    stop_feed = threading.Event()
+    feed_error = []
+
+    def feeder():
+        i = 0
+        try:
+            while not stop_feed.is_set() and i < args.jobs:
+                with acc_lock:
+                    n_acc = len(accepted)
+                status, snap = _http("GET", f"{base}/metrics?format=json",
+                                     timeout=10)
+                done = int((snap.get("counters") or {})
+                           .get("jobs_completed_total", 0)) \
+                    if status == 200 else 0
+                if n_acc - done >= args.outstanding:
+                    time.sleep(0.1)
+                    continue
+                freq = FREQS[i % len(FREQS)]
+                board = text_grid.generate(SIDE, SIDE, seed=7000 + i)
+                status, payload = _http("POST", f"{base}/jobs", {
+                    "width": SIDE, "height": SIDE,
+                    "cells": text_grid.encode(board).decode("ascii"),
+                    "gen_limit": args.gen_limit,
+                    "similarity_frequency": freq,
+                })
+                if status == 429:
+                    time.sleep(0.2)  # shed burst mid-scale: back off, retry
+                    continue
+                if status != 202:
+                    raise RuntimeError(
+                        f"submit {i} rejected HTTP {status}: {payload}")
+                with acc_lock:
+                    accepted[payload["id"]] = (board, freq)
+                i += 1
+        except Exception as err:  # noqa: BLE001 - surfaced by the main thread
+            feed_error.append(err)
+
+    try:
+        proc = _start_fleet(port, fleet_dir)
+        print(f"autoscale-smoke: 1-worker autoscaled fleet up on {base}")
+
+        feed = threading.Thread(target=feeder, daemon=True)
+        t_spike = time.perf_counter()
+        feed.start()
+
+        # 2. the step load must grow the fleet. Wait for a scaled worker
+        # that is READY (has a URL — /fleet lists workers from launch
+        # time, before their boot banner): killing one mid-boot hits the
+        # spawn-rollback lane (the autoscaler re-spawns a FRESH worker
+        # after cooldown) instead of the supervised-respawn lane this
+        # smoke exists to prove.
+        deadline = time.perf_counter() + 420
+        victim = None
+        while victim is None:
+            if feed_error:
+                raise feed_error[0]
+            workers = _workers(base)
+            victim = next(
+                (w for w in workers
+                 if w["id"] != "w0" and w.get("url") and w.get("pid")
+                 and w.get("healthy")),
+                None,
+            )
+            if victim is None:
+                if time.perf_counter() > deadline:
+                    raise RuntimeError(
+                        f"fleet never scaled up under the step load: "
+                        f"{workers}")
+                time.sleep(0.3)
+        print(f"autoscale-smoke: scale-up observed "
+              f"{time.perf_counter() - t_spike:.1f}s after the spike "
+              f"({len(workers)} workers)")
+
+        # 3. SIGKILL that SCALED worker (not the original w0) mid-load.
+        print(f"autoscale-smoke: SIGKILL scaled worker {victim['id']} "
+              f"(pid {victim['pid']}) mid-load")
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        # The supervisor must respawn it on the SAME partition.
+        deadline = time.perf_counter() + 300
+        while True:
+            if feed_error:
+                raise feed_error[0]
+            respawned = next((w for w in _workers(base)
+                              if w["id"] == victim["id"]
+                              and w.get("restarts", 0) >= 1
+                              and w.get("healthy")), None)
+            if respawned is not None:
+                break
+            if time.perf_counter() > deadline:
+                raise RuntimeError(f"worker {victim['id']} never respawned")
+            time.sleep(0.3)
+        print(f"autoscale-smoke: {victim['id']} respawned on its partition")
+
+        # 4. stop the load; every accepted job must reach DONE.
+        feed.join(timeout=600)
+        stop_feed.set()
+        if feed_error:
+            raise feed_error[0]
+        with acc_lock:
+            pending = set(accepted)
+        # Every 40th job is the oracle sample; its result is fetched the
+        # moment it completes — fetching after the load ends would race
+        # the scale-down, whose retired workers take their (already
+        # audited-by-journal) results with them.
+        sample = set(list(accepted)[::40])
+        fetched: dict = {}
+        print(f"autoscale-smoke: load stopped ({len(pending)} accepted); "
+              "waiting for DONE fleet-wide")
+        deadline = time.perf_counter() + 600
+        while pending and time.perf_counter() < deadline:
+            for job_id in list(pending):
+                try:
+                    status, payload = _http("GET", f"{base}/jobs/{job_id}",
+                                            timeout=10)
+                except (urllib.error.URLError, OSError):
+                    break
+                if status >= 500:
+                    continue  # respawn/retire window: keep polling
+                if status != 200:
+                    print(f"autoscale-smoke: job {job_id} LOST "
+                          f"(HTTP {status}: {payload})")
+                    return 1
+                state = payload["state"]
+                if state == "done":
+                    if job_id in sample:
+                        status, result = _http(
+                            "GET", f"{base}/result/{job_id}", timeout=10)
+                        if status >= 500:
+                            continue  # transient: re-fetch next sweep
+                        if status != 200:
+                            print(f"autoscale-smoke: result {job_id} "
+                                  f"HTTP {status}")
+                            return 1
+                        fetched[job_id] = result
+                    pending.discard(job_id)
+                elif state in ("failed", "cancelled"):
+                    print(f"autoscale-smoke: job {job_id} ended {state}")
+                    return 1
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            print(f"autoscale-smoke: {len(pending)} job(s) never completed")
+            return 1
+
+        # Oracle-gate the sampled results (offline; no HTTP to race).
+        for job_id, result in fetched.items():
+            board, freq = accepted[job_id]
+            want = oracle.run(board, GameConfig(
+                gen_limit=args.gen_limit, similarity_frequency=freq))
+            got = text_grid.decode(result["grid"].encode("ascii"),
+                                   result["width"], result["height"])
+            if (not np.array_equal(np.asarray(got), want.grid)
+                    or result["generations"] != want.generations):
+                print(f"autoscale-smoke: result {job_id} diverges from "
+                      "the oracle")
+                return 1
+        print(f"autoscale-smoke: all jobs DONE, {len(fetched)} results "
+              "oracle-identical through the kill and the scale events")
+
+        # 5. the idle fleet must retire to the floor.
+        deadline = time.perf_counter() + 420
+        while True:
+            workers = _workers(base)
+            if len(workers) == 1:
+                break
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"fleet never retired to the floor: {workers}")
+            time.sleep(0.5)
+        print("autoscale-smoke: scale-down retired the fleet to the "
+              "1-worker floor")
+
+        # 6. cascaded SIGTERM exit + fleet-wide exactly-once audit.
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            print("autoscale-smoke: fleet ignored SIGTERM")
+            proc.kill()
+            return 1
+        if proc.returncode != 0:
+            print(f"autoscale-smoke: fleet exited rc={proc.returncode}:\n"
+                  f"{out[-3000:]}")
+            return 1
+        proc = None
+
+        done = _count_done(fleet_dir)
+        lost = set(accepted) - set(done)
+        extra = set(done) - set(accepted)
+        dup = {k: [p for p, _ in v] for k, v in done.items() if len(v) != 1}
+        if lost or extra or dup:
+            print(f"autoscale-smoke: lost={lost} unknown={extra} "
+                  f"duplicated={dup}")
+            return 1
+        partitions = {p for v in done.values() for p, _ in v}
+        history = os.path.join(fleet_dir, "autoscaler-history")
+        decisions = os.path.isdir(history) and bool(os.listdir(history))
+        if not decisions:
+            print("autoscale-smoke: no autoscaler decision ring was written")
+            return 1
+        print(
+            f"autoscale-smoke: PASS — {len(accepted)} jobs exactly-once "
+            f"across {len(partitions)} partitions (incl. retired ones), "
+            "scale-up under load, SIGKILL replayed, scale-down to floor, "
+            "decision ring present"
+        )
+        rc = 0
+        return 0
+    finally:
+        stop_feed.set()
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        if rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"autoscale-smoke: artifacts kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
