@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke autoscale-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke autoscale-smoke chaos-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -46,6 +46,13 @@ bench:
 # baseline moved with it:
 #   make bench-diff OLD=BENCH_r15.json NEW=/tmp/BENCH_r15.json \
 #       METRIC=lanes.autoscaled.jobs_per_sec
+# The chaos suite's CI gate rides the defended lane's fault-free
+# throughput leaf (higher is better) — a defenses-cost regression fails
+# even when the off-column baseline moved with it; the degraded-goodput
+# floor (>= 0.70x defended under one 30%-faulty hop) is exit-code gated
+# inside the suite itself:
+#   make bench-diff OLD=BENCH_r16.json NEW=/tmp/BENCH_r16.json \
+#       METRIC=lanes.defended.jobs_per_sec
 bench-diff:
 	@test -n "$(OLD)" && test -n "$(NEW)" || \
 		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1] [METRIC=dot.path]"; exit 2; }
@@ -133,6 +140,15 @@ sparse-smoke:
 # partitions — including retired workers'.
 autoscale-smoke:
 	python3 tools/autoscale_smoke.py
+
+# Chaos smoke (tools/chaos_smoke.py): a real 2-worker `gol fleet --chaos`
+# under a seeded plan mixing resets, latency, and GOLP frame corruption,
+# plus a SIGKILL mid-load — every accepted job DONE exactly once, sampled
+# results oracle-identical through the faulty hop, and the victim's
+# circuit breaker observed opening AND re-closing in the durable
+# breaker-history ring.
+chaos-smoke:
+	python3 tools/chaos_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

@@ -2416,6 +2416,297 @@ def _bench_sparse(args) -> int:
     return 0 if headline >= 10.0 else 1
 
 
+def _bench_chaos(args) -> int:
+    """Chaos-hardened data path suite (--suite chaos) -> BENCH_r16.json.
+
+    ISSUE 14's two-sided acceptance for the defensive machinery:
+
+    - **overhead**: defenses ON (per-worker circuit breakers + the durable
+      breaker ring, worker dispatch retry budgets, and an
+      ``X-Gol-Deadline`` stamp on every submit) must cost <= 3% of the
+      identical fault-free load with every defense OFF — the ratio
+      defended/baseline jobs-per-sec is gated at >= 0.97;
+    - **degradation**: the defended fleet with ONE worker's router->worker
+      hop at 30% injected failure (``refuse=0.2,reset=0.1`` — hard
+      connection kills: RSTs with zero response bytes, a third of them
+      after half the response went out; NOTE both reach the router as a
+      reset AFTER its request bytes left, so this lane exercises the
+      ambiguous-504 contract — a true delivery-impossible spill
+      (ECONNREFUSED on a closed port) cannot be produced by a proxy that
+      has already accepted the connection, and is unit-pinned in
+      tests/test_fleet.py instead) must keep goodput >= 70% of its own
+      healthy number. This is the breaker's existence proof: open workers
+      are ranked LAST, so the browned-out worker's share of the traffic
+      spills to the healthy one instead of stalling the fleet, and
+      half-open probes pull it back as soon as its hop answers.
+
+    Both fleets are real subprocess workers behind in-process routers;
+    overhead rounds interleave baseline/defended so machine drift lands
+    on both columns (the fleettrace discipline). The headline is the
+    overhead ratio; CI gates the absolute leaf with
+    ``tools/bench_diff.py --metric lanes.defended.jobs_per_sec``.
+    rc 0 iff overhead >= 0.97 AND degraded goodput >= 0.70x.
+    """
+    import concurrent.futures
+    import shutil
+    import tempfile
+
+    import jax
+
+    from gol_tpu.chaos import ChaosPlan, ProxyPool
+    from gol_tpu.fleet import client as fleet_client
+    from gol_tpu.fleet.breaker import BreakerConfig
+    from gol_tpu.fleet.router import RouterServer
+    from gol_tpu.fleet.workers import Fleet
+    from gol_tpu.io import text_grid
+    from gol_tpu.obs import propagate as obs_propagate
+    from gol_tpu.obs.history import HistoryWriter
+
+    repeats = args.repeats
+    # The PR-8 fleet load shape (equal-work 160^2 buckets HRW-spread over
+    # 2 workers), trimmed to 4 buckets x 8 jobs so three lanes x
+    # (warm + repeats) rounds stay minutes, not tens of minutes. The
+    # gen_limit is deliberately high for the degraded gate's honesty:
+    # compute must dominate the round, so the injected faults' retry and
+    # cooldown costs amortize the way they would on a real long-running
+    # load rather than being measured against near-empty jobs.
+    gen_limit = args.gen_limit if args.gen_limit is not None else 10000
+    side = 160
+    freqs = (2, 3, 5, 9)
+    per_bucket = 8
+    max_batch = 8
+    njobs = len(freqs) * per_bucket
+    # 30% hard failure on the victim's hop: refuse (RST before the request
+    # is read) + reset (RST mid-response). Both are resets AFTER the
+    # router's bytes went out, i.e. the ambiguous-504 lane — the accepting
+    # proxy cannot fake a closed-port ECONNREFUSED, so the
+    # delivery-impossible spill path is covered by unit tests, not here.
+    degraded_plan = "seed=777,refuse=0.2,reset=0.1"
+    deadline_s = 600.0  # generous: the stamp/decrement/enforce path runs
+    # every hop, but nothing expires on the fault-free lane.
+    workroot = tempfile.mkdtemp(prefix="gol-bench-chaos-")
+    print(
+        f"bench chaos: {njobs} jobs across {len(freqs)} {side}^2 buckets, "
+        f"gen_limit {gen_limit}, repeats {repeats}, 2 workers/lane, "
+        f"platform={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    boards = {
+        freq: [text_grid.generate(side, side, seed=7000 + 100 * freq + i)
+               for i in range(per_bucket)]
+        for freq in freqs
+    }
+    work = [(freq, b) for freq, bs in boards.items() for b in bs]
+
+    class _OneWorkerChaos(ProxyPool):
+        """The degraded lane's mount: chaos fronts exactly ONE worker's
+        hop; every other upstream resolves direct."""
+
+        def __init__(self, plan: ChaosPlan, victim_url: str):
+            super().__init__(plan)
+            self._victim = victim_url.rstrip("/")
+
+        def url_for(self, upstream_url: str) -> str:
+            if upstream_url.rstrip("/") != self._victim:
+                return upstream_url
+            return super().url_for(upstream_url)
+
+    def submit_one(base: str, freq, board, defended: bool) -> str:
+        """One board -> one accepted job id, riding the documented fault
+        contracts (ambiguous 504: resubmit knowingly; transient
+        5xx/connection trouble: re-send)."""
+        headers = None
+        if defended:
+            headers = {obs_propagate.DEADLINE_HEADER:
+                       obs_propagate.encode_deadline(deadline_s)}
+        body = {
+            "width": side, "height": side,
+            "cells": text_grid.encode(board).decode("ascii"),
+            "gen_limit": gen_limit,
+            "similarity_frequency": freq,
+        }
+        for _ in range(60):
+            try:
+                status, payload = fleet_client.http_json(
+                    "POST", f"{base}/jobs", body, headers=headers,
+                    timeout=60)
+            except (OSError, ConnectionError):
+                time.sleep(0.05)
+                continue
+            if status == 202 and isinstance(payload, dict):
+                return payload["id"]
+            if status in (504, 503, 502, 429):
+                time.sleep(0.05)
+                continue
+            raise RuntimeError(f"submit rejected HTTP {status}: {payload}")
+        raise RuntimeError("a submit never landed after 60 tries")
+
+    def run_round(base: str, defended: bool) -> float:
+        """Submit the whole load, wait until every accepted id is DONE ->
+        seconds. Goodput counts the njobs the CALLER wanted; orphans an
+        ambiguous 504 left behind burn worker time and slow this clock,
+        which is exactly what goodput means."""
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+            ids = list(pool.map(
+                lambda fb: submit_one(base, fb[0], fb[1], defended), work))
+        pending = set(ids)
+        while pending:
+            for job_id in list(pending):
+                try:
+                    status, payload = fleet_client.http_json(
+                        "GET", f"{base}/jobs/{job_id}", timeout=30)
+                except (OSError, ConnectionError):
+                    continue  # the faulty hop: ask again
+                if status != 200 or not isinstance(payload, dict):
+                    continue
+                state = payload.get("state")
+                if state == "done":
+                    pending.discard(job_id)
+                elif state in ("failed", "cancelled"):
+                    raise RuntimeError(f"job {job_id} ended {state}")
+            if pending:
+                time.sleep(0.05)
+        return time.perf_counter() - t0
+
+    def boot(name: str, defended: bool, chaos_for=None) -> RouterServer:
+        """One lane's fleet + router. ``chaos_for`` is an optional
+        ``fleet -> ProxyPool`` factory, called after the workers spawn —
+        the degraded lane's victim URL only exists then."""
+        fleet_dir = os.path.join(workroot, f"fleet-{name}")
+        serve_args = [
+            "--flush-age", "0.2",
+            "--max-batch", str(max_batch),
+            "--pipeline-depth", "2",
+            "--max-queue-depth", "4096",
+        ]
+        if defended:
+            serve_args += ["--retry-budget", "50"]
+        fleet = Fleet(fleet_dir, serve_args=serve_args)
+        fleet.spawn_fleet(2)
+        kwargs = {}
+        if defended:
+            kwargs = {
+                "breakers": True,
+                "breaker_config": BreakerConfig(cooldown_s=1.0),
+                "breaker_history": HistoryWriter(
+                    os.path.join(fleet_dir, "breaker-history"),
+                    source="breaker"),
+            }
+        chaos = chaos_for(fleet) if chaos_for is not None else None
+        router = RouterServer(fleet, port=0, chaos=chaos, **kwargs)
+        router.start()
+        return router
+
+    results = {}
+    chaos_stats = {}
+    router_base = router_def = router_deg = None
+    try:
+        # -- overhead: baseline vs defended, rounds interleaved ----------
+        router_base = boot("baseline", defended=False)
+        router_def = boot("defended", defended=True)
+        run_round(router_base.url, defended=False)  # warm (HRW compiles)
+        run_round(router_def.url, defended=True)
+        base_runs, def_runs = [], []
+        for _ in range(repeats):
+            base_runs.append(run_round(router_base.url, defended=False))
+            def_runs.append(run_round(router_def.url, defended=True))
+        base_s, def_s = min(base_runs), min(def_runs)
+        router_base.shutdown(cascade=True)
+        router_base = None
+
+        # -- degradation: the defended config + 30% chaos on one hop -----
+        def degraded_chaos(fleet) -> _OneWorkerChaos:
+            victim = sorted(fleet.workers(), key=lambda w: w.id)[0]
+            return _OneWorkerChaos(ChaosPlan.parse(degraded_plan),
+                                   victim.url)
+
+        router_deg = boot("degraded", defended=True,
+                          chaos_for=degraded_chaos)
+        chaos_pool = router_deg.chaos
+        # Two warm rounds: the second covers the spill compiles (buckets
+        # the victim owns land on the healthy worker while the breaker
+        # holds the victim open).
+        run_round(router_deg.url, defended=True)
+        run_round(router_deg.url, defended=True)
+        deg_runs = [run_round(router_deg.url, defended=True)
+                    for _ in range(repeats)]
+        deg_s = min(deg_runs)
+        chaos_stats = chaos_pool.stats()
+        breaker_final = router_deg.breaker_states()
+
+        results = {
+            "baseline": {"seconds": round(base_s, 3),
+                         "jobs_per_sec": round(njobs / base_s, 2)},
+            "defended": {"seconds": round(def_s, 3),
+                         "jobs_per_sec": round(njobs / def_s, 2)},
+            "degraded": {"seconds": round(deg_s, 3),
+                         "jobs_per_sec": round(njobs / deg_s, 2)},
+        }
+    finally:
+        for router in (router_deg, router_def, router_base):
+            if router is not None:
+                router.shutdown(cascade=True)
+        shutil.rmtree(workroot, ignore_errors=True)
+
+    overhead = results["defended"]["jobs_per_sec"] / results["baseline"][
+        "jobs_per_sec"]
+    goodput = results["degraded"]["jobs_per_sec"] / results["defended"][
+        "jobs_per_sec"]
+    print(
+        f"  baseline {results['baseline']['jobs_per_sec']:.1f} jobs/s, "
+        f"defended {results['defended']['jobs_per_sec']:.1f} jobs/s "
+        f"(overhead ratio {overhead:.4f}, floor 0.97)",
+        file=sys.stderr,
+    )
+    print(
+        f"  degraded {results['degraded']['jobs_per_sec']:.1f} jobs/s = "
+        f"{goodput:.2f}x defended (floor 0.70) under {degraded_plan} on "
+        f"one hop; injected faults {chaos_stats}; final breakers "
+        f"{breaker_final}",
+        file=sys.stderr,
+    )
+    payload = {
+        "metric": "chaos_defended_over_baseline_jobs_per_sec",
+        "value": round(overhead, 4),
+        "unit": "x",
+        "vs_baseline": None,  # the baseline lane IS the off column
+        "degraded_over_defended": round(goodput, 4),
+        "gates": {"overhead_floor": 0.97, "degraded_goodput_floor": 0.70},
+        "load": {
+            "jobs": njobs,
+            "buckets": [f"{side}x{side}/sim{f}" for f in freqs],
+            "per_bucket": per_bucket,
+            "gen_limit": gen_limit,
+            "max_batch": max_batch,
+            "workers": 2,
+            "note": "real subprocess workers behind in-process routers; "
+            "overhead rounds interleave baseline/defended. CI gates the "
+            "absolute leaf with --metric lanes.defended.jobs_per_sec",
+        },
+        "defenses_on": [
+            "router per-worker circuit breakers + durable breaker ring",
+            "worker dispatch retry budget (--retry-budget 50)",
+            f"X-Gol-Deadline stamped per submit ({deadline_s:.0f}s budget)",
+        ],
+        "chaos": {
+            "plan": degraded_plan,
+            "scope": "one worker's router->worker hop (the other direct)",
+            "observed_faults": chaos_stats,
+        },
+        "lanes": results,
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r16.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    print(json.dumps(payload))
+    return 0 if overhead >= 0.97 and goodput >= 0.70 else 1
+
+
 SUITES = {
     "autoscale": (
         _bench_autoscale,
@@ -2430,6 +2721,15 @@ SUITES = {
         _bench_batch,
         "boards/sec and occupancy through the serve batcher at B in "
         "{1, 8, 64} on 256^2 boards (the amortized-dispatch serving win)",
+    ),
+    "chaos": (
+        _bench_chaos,
+        "chaos-hardened data path: defenses ON (breakers + retry budgets "
+        "+ deadline stamps, no faults) vs OFF on the 2-worker fleet load "
+        "(acceptance: >= 0.97x), plus a degraded lane with one worker's "
+        "hop at 30% injected failure (acceptance: goodput >= 0.70x "
+        "defended; CI gates --metric lanes.defended.jobs_per_sec); "
+        "writes BENCH_r16.json",
     ),
     "cache": (
         _bench_cache,

@@ -995,6 +995,23 @@ def _serve(args) -> int:
         raise ValueError(
             f"--history-bytes must be >= 4096, got {args.history_bytes}"
         )
+    if args.retry_budget < 0:
+        raise ValueError(
+            f"--retry-budget must be >= 0, got {args.retry_budget}"
+        )
+    scheduler_kwargs = {}
+    if args.retry_budget:
+        # The dispatch-retry token bucket (resilience/retry.RetryBudget):
+        # N tokens of capacity, refilled over a minute — under a brownout
+        # the scheduler degrades to first-attempt-only dispatch instead
+        # of amplifying the overload with retry traffic. 0 (default) =
+        # unlimited, the pre-budget behavior.
+        from gol_tpu.resilience.retry import RetryBudget
+
+        scheduler_kwargs["retry_budget"] = RetryBudget(
+            capacity=args.retry_budget,
+            refill_per_s=args.retry_budget / 60.0,
+        )
     server = GolServer(
         host=args.host,
         port=args.port,
@@ -1014,6 +1031,7 @@ def _serve(args) -> int:
         cache_payload=args.cache_payload,
         history_dir=history_dir,
         history_bytes=args.history_bytes,
+        **scheduler_kwargs,
     )
     stop = {"signaled": False}
 
@@ -1101,6 +1119,23 @@ def _fleet(args) -> int:
             f"--cores-per-worker {args.cores_per_worker} exceeds the "
             f"host's {os.cpu_count()} cores"
         )
+    if args.breaker_cooldown < 0:
+        raise ValueError(
+            f"--breaker-cooldown must be >= 0, got {args.breaker_cooldown}"
+        )
+    if args.retry_budget < 0:
+        # Validated BEFORE any worker spawns (the history-flags contract):
+        # forwarded verbatim, a negative budget boot-crashes every worker
+        # long after launch instead of erroring here.
+        raise ValueError(
+            f"--retry-budget must be >= 0, got {args.retry_budget}"
+        )
+    if args.chaos:
+        # Parsed up front so a typo'd plan is a `gol: <error>` before any
+        # worker spawns — and so the boot banner can echo the armed plan.
+        from gol_tpu.chaos import ChaosPlan
+
+        ChaosPlan.parse(args.chaos)
     # Autoscaler bounds resolve against --workers; AutoscaleConfig's own
     # validation (min >= 1, max >= min, threshold ordering) runs HERE,
     # before any worker spawns — same contract as the history flags.
@@ -1135,6 +1170,8 @@ def _fleet(args) -> int:
         "--slo-latency-p99", str(args.slo_latency_p99),
         "--sample-interval", str(args.sample_interval),
     ]
+    if args.retry_budget:
+        serve_args += ["--retry-budget", str(args.retry_budget)]
     if args.resident_ring:
         serve_args += ["--resident-ring", str(args.resident_ring)]
     if args.warm_plans:
@@ -1186,10 +1223,50 @@ def _fleet(args) -> int:
             "fleet has no workers: pass --workers N and/or --attach URL"
         )
     fleet.start_health(args.health_interval)
+    # The chaos-hardened data path (PR 14): breakers default ON for the
+    # CLI fleet (the library RouterServer default stays off/byte-identical
+    # for embedders and old tests); --chaos mounts the fault-injecting
+    # proxy pool on the router->worker data path. Breaker transitions land
+    # in a durable ring beside the autoscaler's decisions.
+    chaos_pool = None
+    if args.chaos:
+        from gol_tpu.chaos import ChaosPlan, ProxyPool
+
+        chaos_pool = ProxyPool(ChaosPlan.parse(args.chaos))
+        # Respawns move workers to fresh ports; every health tick drops
+        # the proxies (listener socket + accept thread each) still
+        # fronting the dead ones.
+        fleet.add_tick_hook(
+            lambda: chaos_pool.prune(w.url for w in fleet.workers())
+        )
+        print(f"chaos: fault injection ARMED on the router->worker data "
+              f"path ({args.chaos})", flush=True)
+    breaker_kwargs = {}
+    if not args.no_breakers:
+        from gol_tpu.fleet.breaker import BreakerConfig
+        from gol_tpu.obs.history import HistoryWriter as _BreakerRing
+
+        breaker_kwargs = {
+            "breakers": True,
+            "breaker_config": BreakerConfig(
+                cooldown_s=args.breaker_cooldown,
+                slow_s=args.breaker_slow if args.breaker_slow > 0 else None,
+            ),
+            "breaker_history": _BreakerRing(
+                os.path.join(args.fleet_dir, "breaker-history"),
+                source="breaker",
+            ),
+        }
     router = RouterServer(fleet, host=args.host, port=args.port,
                           big_edge=args.big_edge,
                           cache_route=args.cache_route,
-                          affinity_route=args.affinity)
+                          affinity_route=args.affinity,
+                          chaos=chaos_pool,
+                          **breaker_kwargs)
+    if not args.no_breakers:
+        # Same cadence as the chaos-proxy prune: a retired worker's
+        # breaker (and its state gauge) leaves with its membership row.
+        fleet.add_tick_hook(router.prune_breakers)
     if autoscale_cfg is not None:
         from gol_tpu.fleet.autoscale import Autoscaler
         from gol_tpu.obs.history import HistoryWriter
@@ -1428,16 +1505,19 @@ def _tune(args) -> int:
 
 
 def _http_json(method: str, url: str, body: dict | None = None, timeout=30,
-               raw: bytes | None = None, content_type: str | None = None):
+               raw: bytes | None = None, content_type: str | None = None,
+               headers: dict | None = None):
     """The ONE stdlib JSON client (``gol_tpu/fleet/client.py`` — jax-free,
     shared with the router/health loops): HTTP errors come back as
     (status, payload), connection trouble raises for the callers'
     retry/timeout logic. ``raw``/``content_type`` send a pre-encoded
-    body (the packed wire submit)."""
+    body (the packed wire submit); ``headers`` adds request headers (the
+    submit deadline stamp, obs/propagate.py)."""
     from gol_tpu.fleet import client as fleet_client
 
     return fleet_client.http_json(method, url, body, timeout=timeout,
-                                  raw=raw, content_type=content_type)
+                                  raw=raw, content_type=content_type,
+                                  headers=headers)
 
 
 def _http_exchange(method: str, url: str, timeout=30, accept=None):
@@ -1451,6 +1531,44 @@ def _http_exchange(method: str, url: str, timeout=30, accept=None):
                                       headers=headers)
 
 
+class _WireDowngrade(Exception):
+    """A packed submit answered 400/415: resend as text (retryable)."""
+
+
+class _WireCRCResend(Exception):
+    """A packed submit answered a CRC-mismatch 400: the frame was
+    corrupted in transit, not rejected — resend PACKED (bounded)."""
+
+
+def _connection_trouble(err: BaseException) -> bool:
+    """Connection-level trouble worth an in-call retry: refused, reset,
+    timed out, torn HTTP — anything the transport raised. HTTP statuses
+    never reach here (they return as values), so semantics stay with the
+    call sites."""
+    import urllib.error
+
+    return isinstance(err, (urllib.error.URLError, ConnectionError, OSError))
+
+
+def _submit_retry():
+    """The ONE retry stance for ``gol submit`` — a jittered exponential
+    policy over a shared token-bucket budget, replacing the three ad-hoc
+    loops that had grown here (the status poll, the result collect, and
+    the packed->text wire downgrade). The shared budget bounds the
+    client's total retry amplification: against a browned-out fleet the
+    bucket drains and every site degrades to one attempt per sweep,
+    surfacing the original errors instead of piling on. The per-target
+    no-contact cutoff in ``_collect_results`` is UNCHANGED — the policy
+    retries inside a sweep; the cutoff still decides when a target is
+    dead."""
+    from gol_tpu.resilience.retry import RetryBudget, RetryPolicy
+
+    policy = RetryPolicy(attempts=3, base_delay=0.1, multiplier=2.0,
+                         max_delay=1.0, jitter=0.25)
+    budget = RetryBudget(capacity=16.0, refill_per_s=1.0)
+    return policy, budget
+
+
 def _submit(args) -> int:
     """``gol submit``: client for a running ``gol serve`` instance.
 
@@ -1458,8 +1576,6 @@ def _submit(args) -> int:
     job is terminal and writes each result next to its input
     (``<input>.out`` or into --output-dir), printing the per-board
     ``Generations:`` accounting the solo CLI prints."""
-    import time as _time
-
     from gol_tpu.variants import get_variant
 
     variant = get_variant(args.variant)
@@ -1490,9 +1606,15 @@ def _submit(args) -> int:
     # --wire packed: boards travel as binary wire frames (io/wire.py, ~8x
     # fewer bytes). Degradation is PER TARGET: a server that answers 415
     # (or 400 — an old server's JSON parser rejecting the frame) gets ONE
-    # logged retry as text and every later submit to it goes text too.
+    # logged resend as text and every later submit to it goes text too —
+    # bounded per target by construction, so it bypasses the retry budget
+    # (format negotiation is free; brownout amplification is what the
+    # budget caps).
     wire_default = getattr(args, "wire", "text")
     wire_mode = {}  # per target; new targets default to the flag's mode
+    from gol_tpu.obs import propagate as obs_propagate
+
+    policy, budget = _submit_retry()
     ids = {}  # job id -> (input path, server base the job lives on)
     for path in args.input_files:
         target = targets.next()
@@ -1509,8 +1631,22 @@ def _submit(args) -> int:
             # Per-job result-cache opt-out (Job.no_cache); servers without
             # a cache ignore the field after type validation.
             meta["no_cache"] = True
+        job_t0 = time.perf_counter()
 
-        def submit_to(target):
+        def deadline_headers():
+            # --timeout: stamp the REMAINING X-Gol-Deadline budget at send
+            # time — a resend after backoff carries less than the first
+            # attempt did, exactly like a router hop. Old servers ignore
+            # the header; no --timeout sends no header (pinned).
+            if args.timeout is None:
+                return None
+            remaining = args.timeout - (time.perf_counter() - job_t0)
+            return {obs_propagate.DEADLINE_HEADER:
+                    obs_propagate.encode_deadline(remaining)}
+
+        crc_resends = {"n": 0}  # per board: transit-corrupted frames
+
+        def post_once(target):
             if wire_mode[target] == "packed":
                 from gol_tpu.io import wire
 
@@ -1518,50 +1654,142 @@ def _submit(args) -> int:
                     "POST", f"{target}/jobs",
                     raw=wire.encode_frame(meta, grid=grid),
                     content_type=wire.CONTENT_TYPE,
+                    headers=deadline_headers(),
                 )
-                if status in (400, 415):
-                    print(
-                        f"gol submit: {target} does not accept the packed "
-                        f"wire format (HTTP {status}); retrying as text",
-                        file=sys.stderr,
-                    )
-                    wire_mode[target] = "text"
-            if wire_mode[target] != "packed":
-                body = {"width": width, "height": height,
-                        "cells": text_grid.encode(grid).decode("ascii"),
-                        **meta}
-                status, payload = _http_json("POST", f"{target}/jobs", body)
-            return status, payload
+                if status not in (400, 415):
+                    return status, payload
+                if status == 400 and wire.is_crc_error(payload):
+                    # The server's CRC gate caught a frame corrupted in
+                    # transit (a 400 created no job: resending is
+                    # unconditionally safe) — that is the wire format
+                    # WORKING, not the server rejecting it. Downgrading
+                    # here would swap detected corruption for the text
+                    # lane's undetectable kind, on exactly the link that
+                    # corrupts. Resend packed, twice at most; a hop
+                    # corrupting every frame surfaces the 400 loudly.
+                    if crc_resends["n"] < 2:
+                        crc_resends["n"] += 1
+                        print(
+                            f"gol submit: {target} reports a frame CRC "
+                            "mismatch (corrupted in transit); resending "
+                            f"packed ({crc_resends['n']}/2)",
+                            file=sys.stderr,
+                        )
+                        raise _WireCRCResend(status)
+                    return status, payload
+                print(
+                    f"gol submit: {target} does not accept the packed "
+                    f"wire format (HTTP {status}); retrying as text",
+                    file=sys.stderr,
+                )
+                wire_mode[target] = "text"
+                raise _WireDowngrade(status)
+            body = {"width": width, "height": height,
+                    "cells": text_grid.encode(grid).decode("ascii"),
+                    **meta}
+            return _http_json("POST", f"{target}/jobs", body,
+                              headers=deadline_headers())
 
-        status, payload = submit_to(target)
-        if status == 429:
-            # A shed burst: the membership that 429'd may already be
-            # stale — an autoscaled fleet is likely scaling up RIGHT NOW
-            # because of this very load. Re-fetch and retry ONCE against
-            # the next (possibly brand-new) target before giving up.
-            targets.on_429()
-            retry = targets.next()
-            wire_mode.setdefault(retry, wire_default)
-            print(f"gol submit: {target} shed the job (HTTP 429); "
-                  f"refreshed membership, retrying on {retry}",
-                  file=sys.stderr)
-            target = retry
+        def submit_to(target):
+            # The job-creating POST is NOT idempotent: only failures that
+            # guarantee nothing reached the server (refused, DNS,
+            # unreachable — the router's spill-safety classification) are
+            # auto-retried. Anything ambiguous — a reset or timeout after
+            # the bytes went out — surfaces instead of re-POSTing, because
+            # the server may have accepted and journaled the job and a
+            # blind resend would run the board twice under two ids.
+            from gol_tpu.resilience.retry import delivery_impossible
+
+            while True:
+                try:
+                    return policy.call(
+                        lambda: post_once(target),
+                        retryable=delivery_impossible,
+                        budget=budget,
+                    )
+                except _WireDowngrade:
+                    # Format negotiation, not a transient: post_once
+                    # already flipped this target to text, so the resend
+                    # is deterministic and happens AT MOST ONCE per
+                    # target — it spends no retry-budget tokens (a fleet
+                    # of old servers must not eat the brownout budget,
+                    # and an empty bucket must not strand the downgrade).
+                    continue
+                except _WireCRCResend:
+                    # A transit-corrupted frame, bounded at 2 per board
+                    # inside post_once; same budget exemption (nothing
+                    # reached the queue — a 400 created no job).
+                    continue
+
+        try:
             status, payload = submit_to(target)
+            if status == 429:
+                # A shed burst: the membership that 429'd may already be
+                # stale — an autoscaled fleet is likely scaling up RIGHT
+                # NOW because of this very load. Re-fetch and retry ONCE
+                # against the next (possibly brand-new) target before
+                # giving up.
+                targets.on_429()
+                retry = targets.next()
+                wire_mode.setdefault(retry, wire_default)
+                print(f"gol submit: {target} shed the job (HTTP 429); "
+                      f"refreshed membership, retrying on {retry}",
+                      file=sys.stderr)
+                target = retry
+                status, payload = submit_to(target)
+        except OSError as err:
+            # Exchange trouble the policy refused to retry: either
+            # no-contact retries ran out, or — the case that matters —
+            # the failure was ambiguous and a resend could double-run
+            # the board. Name which, so the operator knows whether a
+            # resubmit is safe.
+            from gol_tpu.resilience.retry import delivery_impossible
+
+            fate = ("never delivered — safe to resubmit"
+                    if delivery_impossible(err)
+                    else "outcome unknown — the job may have been "
+                         "accepted there; audit before resubmitting")
+            print(f"gol submit: {path}: {target} exchange failed "
+                  f"({type(err).__name__}: {err}); {fate}",
+                  file=sys.stderr)
+            return 1
         if status != 202:
-            print(f"gol submit: {path}: HTTP {status}: "
-                  f"{payload.get('error', payload)}", file=sys.stderr)
+            # A router's ambiguous 504 names the worker whose outcome is
+            # unknown (and its breaker state): surface both, so the
+            # operator knows WHICH partition to audit before resubmitting.
+            note = ""
+            if isinstance(payload, dict) and payload.get("worker"):
+                breaker = payload.get("breaker")
+                note = (f" [outcome unknown at worker {payload['worker']}"
+                        + (f", breaker {breaker}" if breaker else "") + "]")
+            detail = (payload.get("error", payload)
+                      if isinstance(payload, dict) else payload)
+            print(f"gol submit: {path}: HTTP {status}: {detail}{note}",
+                  file=sys.stderr)
+            return 1
+        if not isinstance(payload, dict) or "id" not in payload:
+            # A 202 whose ack BODY was corrupted in transit (bit-flipped
+            # hop garbling the JSON): the job WAS accepted — the status
+            # line survived — but there is no id to poll, and a resend
+            # would run the board twice. Same loud-abandon contract as
+            # the ambiguous 504.
+            print(
+                f"gol submit: {path}: {target} accepted the job but the "
+                "ack body arrived corrupted; cannot track it — audit the "
+                "server's journal before resubmitting",
+                file=sys.stderr,
+            )
             return 1
         ids[payload["id"]] = (path, target)
         print(f"{path}\t{payload['id']}")
     if not args.wait:
         return 0
 
-    import urllib.error
-
     outdir = args.output_dir
     if outdir:
         os.makedirs(outdir, exist_ok=True)
-    return _collect_results(dict(ids), args, outdir)
+    return _collect_results(dict(ids), args, outdir,
+                            retry=(policy, budget))
 
 
 class _ShardTargets:
@@ -1625,7 +1853,7 @@ class _ShardTargets:
         self.refresh(force=True)
 
 
-def _collect_results(pending: dict, args, outdir) -> int:
+def _collect_results(pending: dict, args, outdir, retry=None) -> int:
     """Poll every submitted job to a terminal state and write its result.
 
     ``pending`` maps job id -> (input path, server base URL) — with
@@ -1635,13 +1863,20 @@ def _collect_results(pending: dict, args, outdir) -> int:
     jobs after ``--server-timeout`` of no contact; jobs on healthy
     targets keep completing. Connection errors and 5xx answers are both
     transient-with-timeout — the server-restart/worker-respawn windows
-    the journal-replay story is built for."""
+    the journal-replay story is built for.
+
+    ``retry`` is the submit loop's shared (RetryPolicy, RetryBudget) pair
+    (``_submit_retry``): transient connection trouble retries INSIDE a
+    sweep under the budget before it counts against the per-target
+    no-contact cutoff — whose semantics are deliberately unchanged."""
     import time as _time
     import urllib.error
 
+    policy, budget = retry if retry is not None else _submit_retry()
     rc = 0
     now = time.perf_counter()
     last_contact = {base: now for _, base in pending.values()}
+    bad_body: dict = {}  # job_id -> sweeps whose 200 body was unusable
     while pending:
         _time.sleep(args.poll_interval)
         stale_this_sweep = set()  # targets already found down this sweep
@@ -1670,9 +1905,30 @@ def _collect_results(pending: dict, args, outdir) -> int:
                     del pending[j]
                 return True
 
+            def bad_body_strike(detail):
+                """Bounded tolerance for answers whose BODY is unusable —
+                a bit-flipped hop garbling status JSON, a result grid, or
+                a packed frame's CRC. Transit corruption heals on the next
+                sweep's refetch; a hop corrupting EVERY exchange must not
+                poll forever (the answers keep coming, so the no-contact
+                cutoff above never fires for this job). True once the
+                3-strike bound is hit: the job is abandoned loudly."""
+                bad_body[job_id] = bad_body.get(job_id, 0) + 1
+                if bad_body[job_id] < 3:
+                    return False
+                print(
+                    f"gol submit: {path}: unusable response body across "
+                    f"{bad_body[job_id]} sweeps ({detail}); giving up on "
+                    f"job {job_id}", file=sys.stderr,
+                )
+                pending.pop(job_id, None)
+                return True
+
             try:
-                status, payload = _http_json("GET",
-                                             f"{job_base}/jobs/{job_id}")
+                status, payload = policy.call(
+                    lambda: _http_json("GET", f"{job_base}/jobs/{job_id}"),
+                    retryable=_connection_trouble, budget=budget,
+                )
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 if target_down(e):
                     rc = 1
@@ -1692,8 +1948,22 @@ def _collect_results(pending: dict, args, outdir) -> int:
                 del pending[job_id]
                 rc = 1
                 continue
-            state = payload["state"]
+            state = (payload.get("state")
+                     if isinstance(payload, dict) else None)
+            if state is None:
+                # Parsed, but not as a job answer (a flip that left valid
+                # JSON): same bounded-refetch treatment as a parse error.
+                if bad_body_strike("no job state in the answer"):
+                    rc = 1
+                continue
             if state in ("queued", "scheduled", "running"):
+                # A usable answer clears the strikes: the bound is on
+                # CONSECUTIVE corrupt sweeps, not lifetime total — a long
+                # job under intermittent, self-healing transit flips must
+                # never strike out. (A done job's result-fetch strikes
+                # stay consecutive by construction: any good fetch
+                # completes the job.)
+                bad_body.pop(job_id, None)
                 continue
             del pending[job_id]
             if state != "done":
@@ -1702,10 +1972,26 @@ def _collect_results(pending: dict, args, outdir) -> int:
                 rc = 1
                 continue
             try:
-                status, result, grid = _fetch_result(
-                    job_base, job_id, getattr(args, "wire", "text")
+                # Body corruption (ValueError: a packed frame's CRC gate
+                # — WireError subclasses it — or garbled JSON/grid text)
+                # is retryable HERE and nowhere else: the result on the
+                # worker is intact, so a refetch is the fix (the PR-11
+                # gate turning a flipped bit into a retry instead of a
+                # wrong board).
+                status, result, grid = policy.call(
+                    lambda: _fetch_result(
+                        job_base, job_id, getattr(args, "wire", "text")
+                    ),
+                    retryable=lambda e: (_connection_trouble(e)
+                                         or isinstance(e, ValueError)),
+                    budget=budget,
                 )
-            except (urllib.error.URLError, ConnectionError, OSError):
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    ValueError, KeyError) as e:
+                if isinstance(e, (ValueError, KeyError)):
+                    if bad_body_strike(repr(e)):
+                        rc = 1
+                        continue
                 pending[job_id] = (path, job_base)  # refetch next sweep
                 continue
             if status >= 500:
@@ -1715,6 +2001,18 @@ def _collect_results(pending: dict, args, outdir) -> int:
                 print(f"gol submit: {path}: result fetch HTTP {status}",
                       file=sys.stderr)
                 rc = 1
+                continue
+            if (not isinstance(result, dict) or "generations" not in result
+                    or "exit_reason" not in result):
+                # Valid JSON and a decodable grid, but a flip ate a meta
+                # key: don't trust the body enough to write it out — the
+                # same bounded refetch as any other unusable answer
+                # (previously an uncaught KeyError at the print below
+                # abandoned every pending job).
+                if bad_body_strike("result meta incomplete"):
+                    rc = 1
+                    continue
+                pending[job_id] = (path, job_base)
                 continue
             out_path = (
                 os.path.join(outdir, os.path.basename(path) + ".out")
@@ -2328,6 +2626,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="metrics-history ring cap in bytes (default 16 MiB); oldest "
         "segments compact away past it",
     )
+    srv.add_argument(
+        "--retry-budget", type=float, default=0.0, metavar="N",
+        help="token-bucket budget on batch dispatch RETRIES (N tokens, "
+        "refilled over a minute): under a brownout the scheduler degrades "
+        "to first-attempt-only dispatch — surfacing the original error — "
+        "instead of amplifying the overload with retry traffic. 0 "
+        "(default) = unlimited, the pre-budget behavior",
+    )
     srv.set_defaults(func=_serve)
 
     flt = sub.add_parser(
@@ -2482,6 +2788,39 @@ def build_parser() -> argparse.ArgumentParser:
         "hash rank alone. Off (the default) — and on with no weights "
         "configured — is byte-identical to plain HRW placement",
     )
+    # The chaos-hardened data path (gol_tpu/chaos + fleet/breaker.py).
+    flt.add_argument(
+        "--no-breakers", action="store_true",
+        help="disable the per-worker circuit breakers (on by default: "
+        "consecutive failures or a degraded fraction of recent calls "
+        "rank a worker LAST — never removed, so HRW bucket affinity "
+        "survives recovery — until a half-open probe succeeds)",
+    )
+    flt.add_argument(
+        "--breaker-cooldown", type=float, default=5.0, metavar="S",
+        help="seconds an OPEN breaker holds before its single half-open "
+        "probe (default 5)",
+    )
+    flt.add_argument(
+        "--breaker-slow", type=float, default=1.0, metavar="S",
+        help="forward latency above S seconds counts as degraded toward "
+        "the breaker's windowed trip (default 1.0; <= 0 disables the "
+        "latency signal)",
+    )
+    flt.add_argument(
+        "--retry-budget", type=float, default=0.0, metavar="N",
+        help="forwarded to every worker: token-bucket budget on batch "
+        "dispatch retries (see `gol serve --retry-budget`)",
+    )
+    flt.add_argument(
+        "--chaos", default=None, metavar="PLAN",
+        help="mount a seeded fault-injecting proxy (gol_tpu/chaos) on the "
+        "router->worker data path: PLAN is a k=v list, e.g. "
+        "'seed=7,reset=0.05,latency=0.2,latency_ms=50,bitflip=0.05' "
+        "(classes: refuse, reset, truncate, slowloris, bitflip, latency). "
+        "Health probes stay direct — chaos exercises the data plane's "
+        "defenses, not the supervisor. NEVER set this in production",
+    )
     flt.set_defaults(func=_fleet)
 
     tun = sub.add_parser(
@@ -2627,6 +2966,17 @@ def build_parser() -> argparse.ArgumentParser:
     sbm.add_argument("--priority", type=int, default=0)
     sbm.add_argument("--deadline", type=float, default=None, metavar="S",
                      help="dispatch-ordering deadline, seconds from acceptance")
+    sbm.add_argument(
+        "--timeout", type=float, default=None, metavar="S",
+        help="end-to-end latency BUDGET per job, propagated as the "
+        "X-Gol-Deadline header and decremented per hop: the router stops "
+        "forwarding, the worker refuses admission, and the scheduler "
+        "skips dispatch once the budget is spent — each answering 504 "
+        "(with the job's timeline attached at the dispatch gate) instead "
+        "of burning capacity on an answer nobody is waiting for. Old "
+        "servers ignore the header (behavior unchanged). Unlike "
+        "--deadline, which only ORDERS dispatch, --timeout abandons work",
+    )
     sbm.add_argument("--no-wait", dest="wait", action="store_false",
                      help="submit and print job ids without polling")
     sbm.add_argument(

@@ -14,6 +14,7 @@ for the caller's liveness logic to classify.
 
 from __future__ import annotations
 
+import http.client
 import json
 import urllib.error
 import urllib.request
@@ -53,7 +54,28 @@ def http_exchange(
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, resp.headers.get("Content-Type", ""), resp.read()
     except urllib.error.HTTPError as e:
-        return e.code, e.headers.get("Content-Type", ""), e.read()
+        try:
+            data = e.read()
+        except http.client.HTTPException as torn:
+            # An error response truncated mid-body: e.read() raises from
+            # INSIDE this handler, where the sibling HTTPException clause
+            # below cannot see it — normalize here too or the raw
+            # IncompleteRead escapes every caller's classification.
+            if isinstance(torn, OSError):
+                raise
+            raise ConnectionError(f"{type(torn).__name__}: {torn}") from torn
+        return e.code, e.headers.get("Content-Type", ""), data
+    except http.client.HTTPException as e:
+        # Torn/garbled HTTP that is NOT already an OSError — a response
+        # truncated mid-body raises IncompleteRead (an HTTPException
+        # only), which every caller's transient-failure classification
+        # would otherwise miss and crash on. A truncation IS connection
+        # trouble: normalize it so liveness logic treats it like a reset.
+        # RemoteDisconnected (HTTPException AND ConnectionResetError)
+        # re-raises untouched — it already speaks OSError.
+        if isinstance(e, OSError):
+            raise
+        raise ConnectionError(f"{type(e).__name__}: {e}") from e
 
 
 def http_json(

@@ -52,14 +52,17 @@ import urllib.error
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
 
-import errno
-import socket
+import time
 
 from gol_tpu.fleet import affinity, client, placement
+from gol_tpu.fleet.breaker import (
+    BreakerConfig, CircuitBreaker, STATE_VALUE,
+)
 from gol_tpu.fleet.workers import Fleet, Worker
 from gol_tpu.io import wire
 from gol_tpu.obs import propagate, registry as obs_registry, trace as obs_trace
 from gol_tpu.obs.registry import Registry, _fmt
+from gol_tpu.resilience import retry as _retry_mod
 
 logger = logging.getLogger(__name__)
 
@@ -72,22 +75,10 @@ logger = logging.getLogger(__name__)
 _SLO_RANK = {"ok": 0, "warning": 1, "critical": 2}
 
 
-def _delivery_impossible(err: BaseException) -> bool:
-    """Whether a submit-forward failure GUARANTEES the request never
-    reached the worker — the only failures safe to spill to another
-    worker (anything ambiguous, e.g. a timeout mid-exchange, may have
-    been accepted and journaled; re-sending would run the board twice).
-    Connection refused, DNS failure, and host/network-unreachable all
-    fail before a byte is delivered."""
-    reason = getattr(err, "reason", err)
-    if not isinstance(reason, BaseException):
-        reason = err
-    if isinstance(reason, (ConnectionRefusedError, socket.gaierror)):
-        return True
-    return isinstance(reason, OSError) and reason.errno in (
-        errno.EHOSTUNREACH, errno.ENETUNREACH,
-        getattr(errno, "EHOSTDOWN", errno.EHOSTUNREACH),
-    )
+# Spill safety: only failures that guarantee the worker never saw the
+# request may move a submit to another worker (shared with `gol submit`'s
+# POST auto-retry — both re-sends have the same double-run hazard).
+_delivery_impossible = _retry_mod.delivery_impossible
 
 
 # -- pure merge helpers (unit-tested without HTTP) --------------------------
@@ -315,6 +306,10 @@ class RouterServer:
         submit_timeout: float = 120.0,
         cache_route: bool = False,
         affinity_route: bool = False,
+        breakers: bool = False,
+        breaker_config: BreakerConfig | None = None,
+        breaker_history=None,
+        chaos=None,
     ):
         if big_edge < placement.PLACEMENT_QUANTUM:
             raise ValueError(
@@ -351,6 +346,27 @@ class RouterServer:
         # construction (it needs this router's merged scrape): surfaces
         # in /metrics, /fleet, and `gol top` when present.
         self.autoscaler = None
+        # Per-worker circuit breakers (fleet/breaker.py). Default OFF and
+        # byte-identical to the pre-breaker router (test-pinned: ranking,
+        # bodies, call shapes); `gol fleet` turns them on unless
+        # --no-breakers. Breakers re-RANK (open workers last), never
+        # remove: HRW affinity survives recovery untouched.
+        self.breakers_enabled = bool(breakers)
+        self._breaker_config = breaker_config or BreakerConfig()
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breakers_lock = threading.Lock()
+        # Durable breaker transition ring (obs/history.HistoryWriter or
+        # None): every open/half-open/close lands beside the autoscaler's
+        # decisions, so "when did we rank w1 out and back in" is
+        # answerable after the fact.
+        self._breaker_history = breaker_history
+        # The chaos mount (gol_tpu/chaos.ProxyPool or None): when present,
+        # every DATA-path forward (submits, per-job GET/DELETE, result
+        # relays) resolves its target through ``chaos.url_for`` — one
+        # faulty hop per worker. Health probes and metrics scrapes stay
+        # direct: chaos tests the data plane's defenses, not the
+        # supervisor's eyesight.
+        self.chaos = chaos
         self.registry = Registry(prefix="gol_fleet")
         self._counter_floors = MonotonicCounters()
         # Single-flight scrape state (all guarded by the condition).
@@ -484,6 +500,11 @@ class RouterServer:
         if self._history is not None:
             self._history.close()
             self._history = None
+        if self._breaker_history is not None:
+            self._breaker_history.close()
+            self._breaker_history = None
+        if self.chaos is not None:
+            self.chaos.close()
         if cascade:
             self.drain()
             self.fleet.stop_health()
@@ -495,6 +516,83 @@ class RouterServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+    # -- circuit breakers ---------------------------------------------------
+
+    def breaker(self, worker_id: str) -> CircuitBreaker | None:
+        """The worker's breaker (created lazily), or None when disabled."""
+        if not self.breakers_enabled:
+            return None
+        with self._breakers_lock:
+            br = self._breakers.get(worker_id)
+            if br is None:
+                br = CircuitBreaker(
+                    self._breaker_config,
+                    on_transition=self._on_breaker_transition,
+                    label=worker_id,
+                )
+                self._breakers[worker_id] = br
+            return br
+
+    def breaker_states(self) -> dict[str, str]:
+        """{worker id: state} for every breaker that exists ({} when the
+        feature is off) — what /fleet, metrics_json, and `gol top` show."""
+        if not self.breakers_enabled:
+            return {}
+        with self._breakers_lock:
+            return {wid: br.state for wid, br in self._breakers.items()}
+
+    def prune_breakers(self) -> None:
+        """Membership-driven breaker cleanup (the chaos-proxy prune's
+        sibling, same health-tick cadence): a RETIRED worker's breaker
+        must not haunt /fleet, the state gauges, and the ranking forever
+        — especially since scale-up reuses the lowest free partition id,
+        which would hand a brand-new worker the dead one's open breaker
+        and half-open trickle. Supervised respawns keep their id and so
+        their breaker history ON PURPOSE: the single half-open probe is
+        exactly the right first contact with a fresh process."""
+        if not self.breakers_enabled:
+            return
+        live = {w.id for w in self.fleet.workers()}
+        with self._breakers_lock:
+            dead = [wid for wid in self._breakers if wid not in live]
+            for wid in dead:
+                del self._breakers[wid]
+        for wid in dead:
+            self.registry.remove_gauge("breaker_state_" + wid)
+
+    def _on_breaker_transition(self, worker_id: str, old: str,
+                               new: str) -> None:
+        self.registry.set_gauge("breaker_state_" + worker_id,
+                                STATE_VALUE[new])
+        if new == "open":
+            self.registry.inc("breaker_opens_total")
+        elif new == "closed":
+            self.registry.inc("breaker_closes_total")
+        if self._breaker_history is not None:
+            try:
+                self._breaker_history.append({"breaker": {
+                    "worker": worker_id, "from": old, "to": new,
+                }})
+            except Exception:  # noqa: BLE001 - telemetry must not break routing
+                logger.exception("breaker history append failed")
+
+    def _breaker_order(self, pool: list[Worker]) -> list[Worker]:
+        """Stable-sort one already-ranked tier so open-breaker workers sink
+        to ITS tail: the breaker refines the order inside each
+        health/backpressure tier, it never promotes a worker past one."""
+        if not self.breakers_enabled:
+            return pool
+        return sorted(pool, key=lambda w: (
+            br.penalty() if (br := self.breaker(w.id)) is not None else 0
+        ))
+
+    def _data_url(self, worker: Worker) -> str:
+        """The worker's data-path URL — through the chaos hop when one is
+        mounted (`gol fleet --chaos`), direct otherwise."""
+        if self.chaos is not None:
+            return self.chaos.url_for(worker.url)
+        return worker.url
 
     # -- placement + forwarding --------------------------------------------
 
@@ -522,8 +620,12 @@ class RouterServer:
         if bigs and key.max_edge > self.big_edge:
             big_ranked = [workers[wid] for wid in self._rank(label, bigs)]
             ranked = big_ranked + [w for w in ranked if not w.big]
-        order = [w for w in ranked if w.healthy and not w.backpressure]
-        order += [w for w in ranked if w.healthy and w.backpressure]
+        order = self._breaker_order(
+            [w for w in ranked if w.healthy and not w.backpressure]
+        )
+        order += self._breaker_order(
+            [w for w in ranked if w.healthy and w.backpressure]
+        )
         order += [w for w in ranked if not w.healthy]
         # Small jobs normally never touch the big lane (its compile budget
         # and rings are reserved for mesh-sharded boards), but a healthy
@@ -543,7 +645,8 @@ class RouterServer:
             return placement.rank_weighted(label, affinity.weights_for(pool))
         return placement.rank(label, [w.id for w in pool])
 
-    def route_submit(self, raw: bytes, content_type: str | None = None):
+    def route_submit(self, raw: bytes, content_type: str | None = None,
+                     deadline_header: str | None = None):
         """(status, payload) for POST /jobs: place, forward, spill.
 
         A PACKED body (``Content-Type: application/x-gol-packed``) is
@@ -552,10 +655,28 @@ class RouterServer:
         unpack) and forwarded as the SAME raw buffer under the same
         content type: the router touches a few dozen bytes of a multi-MB
         submit instead of JSON-parsing all of it. The text path is
-        byte-identical to pre-wire routing (test-pinned)."""
+        byte-identical to pre-wire routing (test-pinned).
+
+        ``deadline_header`` is the client's ``X-Gol-Deadline`` remaining
+        budget: enforced here (a spent budget answers 504 without any
+        forward) and DECREMENTED by the router's own elapsed time before
+        every hop of the spillover walk — each worker sees only what is
+        genuinely left. Absent (every old client), nothing changes
+        (pinned); malformed values drop silently."""
         if self._draining:
             self.registry.inc("jobs_rejected_total")
             return 429, {"error": "fleet is draining; not accepting jobs"}
+        deadline = None
+        budget = propagate.decode_deadline(deadline_header)
+        if budget is not None:
+            if budget <= 0:
+                self.registry.inc("deadline_expired_total")
+                return 504, {
+                    "error": f"deadline budget spent before the router "
+                             f"could place the job ({budget:.3f}s "
+                             "remaining)",
+                }
+            deadline = (budget, time.perf_counter())
         ctype = wire.content_type_of(content_type)
         packed = ctype == wire.CONTENT_TYPE
         if not packed and ctype.startswith(wire.CONTENT_TYPE_FAMILY):
@@ -611,7 +732,8 @@ class RouterServer:
             # The disabled path builds NOTHING extra — no header, no span
             # attributes, no candidate-ranking string: byte-identical
             # requests and PR-8 work per submit (test-pinned).
-            return self._forward_submit(raw, key, order, None, wire_ct)
+            return self._forward_submit(raw, key, order, None, wire_ct,
+                                        deadline)
         trace_id = propagate.new_trace_id()
         headers = {propagate.TRACE_HEADER: propagate.encode(
             trace_id, propagate.sender_label()
@@ -622,17 +744,26 @@ class RouterServer:
             candidates=",".join(w.id for w in order),
             cache_route=bool(rank_label),
         ):
-            return self._forward_submit(raw, key, order, headers, wire_ct)
+            return self._forward_submit(raw, key, order, headers, wire_ct,
+                                        deadline)
 
     def _forward_submit(self, raw: bytes, key: placement.PlacementKey,
                         order: list[Worker], headers: dict | None,
-                        content_type: str | None = None):
+                        content_type: str | None = None,
+                        deadline: tuple[float, float] | None = None):
         """The spillover walk: try workers in ranked order; spans/events
         record each hop without ever changing a status code. ``raw`` is
         forwarded verbatim under ``content_type`` (the zero-copy contract:
         a packed frame leaves this process as the byte buffer it arrived
         in; the kwarg is omitted entirely for text, keeping the pre-wire
-        call shape byte-identical)."""
+        call shape byte-identical).
+
+        With breakers on, every hop's outcome feeds the worker's breaker
+        (an HTTP answer of any status is a live worker; connection-level
+        failures are not). With a ``deadline`` (budget, received_at), each
+        hop re-derives the remaining budget, stamps it on the forwarded
+        header, and caps the hop's timeout by it — a walk never spends
+        more wall clock than the client has left."""
         last = (503, {"error": "no worker accepted the job"})
         small = key.max_edge <= self.big_edge
         shed_seen = False  # any 429: keep it as the client's answer
@@ -640,7 +771,21 @@ class RouterServer:
         http_kwargs = {"headers": headers} if headers else {}
         if content_type is not None:
             http_kwargs["content_type"] = content_type
-        for worker in order:
+        # Two-pass walk: a worker whose breaker answers on_attempt()=False
+        # at forward time (another caller's half-open probe is in flight,
+        # or the ranking raced the breaker opening) is DEFERRED, not
+        # forwarded — the single-probe contract holds under concurrency —
+        # and retried only after every normally-ranked candidate failed:
+        # an open worker stays the last resort, never removed.
+        queue = list(order)
+        deferred: list[Worker] = []
+        while queue or deferred:
+            if queue:
+                worker = queue.pop(0)
+                last_resort = False
+            else:
+                worker = deferred.pop(0)
+                last_resort = True
             if worker.big and small and normal_shed:
                 # The big lane is the last resort for small jobs ONLY
                 # against unreachable normals. A normal worker's 429
@@ -651,40 +796,103 @@ class RouterServer:
                 # such signal — when bigs are the pool, or the tail is
                 # mid-walk, the next big still gets its try.)
                 continue
-            try:
-                with obs_trace.span("fleet.forward", worker=worker.id,
-                                    big=worker.big):
-                    status, payload = self.http(
-                        "POST", worker.url + "/jobs", raw=raw,
-                        timeout=self.submit_timeout,
-                        **http_kwargs,
-                    )
-            except (urllib.error.URLError, ConnectionError, OSError) as err:
-                self.registry.inc("route_errors_total")
-                if not _delivery_impossible(err):
-                    # A timeout/reset AFTER the bytes went out is ambiguous
-                    # — the worker may have accepted and journaled the job
-                    # (first-dispatch compiles can outlive submit_timeout).
-                    # Spilling here would run the board twice under two
-                    # ids; surface the ambiguity instead and let the
-                    # client decide (poll /fleet, resubmit knowingly).
-                    obs_trace.event("fleet.ambiguous", worker=worker.id,
-                                    error=type(err).__name__)
-                    return 504, {
-                        "error": f"worker {worker.id} did not answer the "
-                                 "submit in time; outcome unknown — the "
-                                 "job may have been accepted there",
-                    }
-                # Nothing was delivered: spilling is safe. A 429 already
-                # seen stays the answer — Retry-After is actionable,
-                # "unreachable" is not.
-                obs_trace.event("fleet.spill", worker=worker.id,
-                                reason="unreachable")
-                if not shed_seen:
-                    last = (503, {
-                        "error": f"worker {worker.id} unreachable: {err}",
-                    })
+            br = self.breaker(worker.id)
+            if br is not None and not br.on_attempt() and not last_resort:
+                deferred.append(worker)
                 continue
+            crc_retried = False
+            while True:
+                # Stamped PER ATTEMPT: the CRC re-forward below must
+                # re-derive the remaining budget (and re-check expiry) —
+                # reusing the first attempt's header would hand the
+                # worker the time a slow corrupted hop already spent.
+                hop_kwargs = dict(http_kwargs)
+                timeout = self.submit_timeout
+                if deadline is not None:
+                    budget, received = deadline
+                    remaining = budget - (time.perf_counter() - received)
+                    if remaining <= 0:
+                        # The walk itself spent the budget (slow earlier
+                        # hops): stop forwarding — the client is gone.
+                        self.registry.inc("deadline_expired_total")
+                        return 504, {
+                            "error": "deadline budget spent during the "
+                                     f"spillover walk ({budget:.3f}s "
+                                     "granted)",
+                        }
+                    hdrs = dict(hop_kwargs.get("headers") or {})
+                    hdrs[propagate.DEADLINE_HEADER] = (
+                        propagate.encode_deadline(remaining)
+                    )
+                    hop_kwargs["headers"] = hdrs
+                    timeout = min(self.submit_timeout, max(0.05, remaining))
+                hop_started = time.perf_counter()
+                try:
+                    with obs_trace.span("fleet.forward", worker=worker.id,
+                                        big=worker.big):
+                        status, payload = self.http(
+                            "POST", self._data_url(worker) + "/jobs",
+                            raw=raw, timeout=timeout,
+                            **hop_kwargs,
+                        )
+                except (urllib.error.URLError, ConnectionError,
+                        OSError) as err:
+                    self.registry.inc("route_errors_total")
+                    if br is not None:
+                        br.on_failure()
+                    if not _delivery_impossible(err):
+                        # A timeout/reset AFTER the bytes went out is
+                        # ambiguous — the worker may have accepted and
+                        # journaled the job (first-dispatch compiles can
+                        # outlive submit_timeout). Spilling here would run
+                        # the board twice under two ids; surface the
+                        # ambiguity — naming WHERE the outcome is unknown
+                        # and that worker's breaker state, so the client
+                        # (and the operator reading its stderr) knows which
+                        # partition to audit — and let the client decide
+                        # (poll /fleet, resubmit knowingly).
+                        obs_trace.event("fleet.ambiguous", worker=worker.id,
+                                        error=type(err).__name__)
+                        return 504, {
+                            "error": f"worker {worker.id} did not answer "
+                                     "the submit in time; outcome unknown "
+                                     "— the job may have been accepted "
+                                     "there",
+                            "worker": worker.id,
+                            **({"breaker": br.state} if br is not None
+                               else {}),
+                        }
+                    # Nothing was delivered: spilling is safe. A 429
+                    # already seen stays the answer — Retry-After is
+                    # actionable, "unreachable" is not.
+                    obs_trace.event("fleet.spill", worker=worker.id,
+                                    reason="unreachable")
+                    if not shed_seen:
+                        last = (503, {
+                            "error": f"worker {worker.id} unreachable: "
+                                     f"{err}",
+                        })
+                    status = None  # spill to the next-ranked worker
+                    break
+                if br is not None:
+                    br.on_success(time.perf_counter() - hop_started)
+                if (status == 400 and not crc_retried
+                        and wire.is_crc_error(payload)):
+                    # The worker's CRC gate caught a frame corrupted ON
+                    # THIS HOP (the router placed the frame from a
+                    # well-formed header, and a 400 created no job, so a
+                    # re-send is unconditionally safe): one retry of the
+                    # same buffer turns a transit bit-flip into a
+                    # transparent recovery instead of a client-visible
+                    # 400. A second CRC failure returns — the corruption
+                    # is then upstream of this router.
+                    self.registry.inc("wire_crc_retries_total")
+                    obs_trace.event("fleet.crc_retry", worker=worker.id)
+                    crc_retried = True
+                    continue
+                break
+            if status is None:
+                continue  # unreachable: next candidate
             if status == 429:
                 # The worker is shedding (SLO burn) or full: drain it of
                 # new work and spill to the next-ranked worker — the
@@ -745,7 +953,7 @@ class RouterServer:
             try:
                 if accept is not None:
                     status, ctype, body = self.http_exchange(
-                        method, worker.url + path, timeout=30,
+                        method, self._data_url(worker) + path, timeout=30,
                         headers={"Accept": accept},
                     )
                     if wire.is_packed(ctype):
@@ -753,8 +961,9 @@ class RouterServer:
                     else:
                         payload = client._parse(body)
                 else:
-                    status, payload = self.http(method, worker.url + path,
-                                                timeout=30)
+                    status, payload = self.http(
+                        method, self._data_url(worker) + path, timeout=30
+                    )
             except (urllib.error.URLError, ConnectionError, OSError):
                 unreachable += 1
                 continue
@@ -888,6 +1097,8 @@ class RouterServer:
             **self.fleet.stats(),
             "draining": self._draining,
             "router": self.registry.snapshot(),
+            **({"breakers": self.breaker_states()}
+               if self.breakers_enabled else {}),
             **({"autoscaler": self.autoscaler.public()}
                if self.autoscaler is not None else {}),
         }
@@ -907,6 +1118,20 @@ class RouterServer:
             "route_sheds_total": self.registry.counter("route_sheds_total"),
             "route_errors_total": self.registry.counter("route_errors_total"),
         }
+        # Deadline enforcement and the CRC-retry lane run whether or not
+        # breakers are mounted — their counters export unconditionally
+        # (a --no-breakers fleet 504ing on spent deadlines must not show
+        # zero expiries on the dashboard).
+        for name in ("deadline_expired_total", "wire_crc_retries_total"):
+            fleet_counters[name] = self.registry.counter(name)
+        if self.breakers_enabled:
+            # The breaker series (same flat-name convention as the
+            # per-worker jobs_routed_total_<wid> counters): per-worker
+            # state gauges plus the open/close transition counters.
+            for name in ("breaker_opens_total", "breaker_closes_total"):
+                fleet_counters[name] = self.registry.counter(name)
+            for wid, state in sorted(self.breaker_states().items()):
+                fleet_gauges["breaker_state_" + wid] = STATE_VALUE[state]
         if self.autoscaler is not None:
             snap = self.registry.snapshot()
             for name, value in (snap.get("gauges") or {}).items():
@@ -927,6 +1152,10 @@ class RouterServer:
             "big_edge": self.big_edge,
             "cache_route": self.cache_route,
             "affinity": self.affinity_route,
+            **({"breakers": self.breaker_states()}
+               if self.breakers_enabled else {}),
+            **({"chaos": self.chaos.stats()}
+               if self.chaos is not None else {}),
             **({"autoscaler": self.autoscaler.public()}
                if self.autoscaler is not None else {}),
             "workers": [w.public() for w in self.fleet.workers()],
@@ -974,6 +1203,9 @@ def _make_handler(router: RouterServer):
                     status, payload = router.route_submit(
                         self._read_raw(),
                         content_type=self.headers.get("Content-Type"),
+                        deadline_header=self.headers.get(
+                            propagate.DEADLINE_HEADER
+                        ),
                     )
                     headers = None
                     if status == 429 and "retry_after_s" in (payload or {}):

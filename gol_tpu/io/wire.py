@@ -112,6 +112,17 @@ def accepts_packed(accept_header: str | None) -> bool:
     return bool(accept_header) and CONTENT_TYPE in accept_header
 
 
+def is_crc_error(payload) -> bool:
+    """Whether a 400 error payload reports a frame CRC mismatch — i.e.
+    the frame was corrupted on THAT hop and a resend of the same bytes is
+    both safe (a 400 created no job) and likely to heal it. The ONE
+    definition both transparent-recovery lanes (the router's forward
+    retry and the client's packed resend) key off, so neither can drift
+    from the error text this module raises."""
+    return (isinstance(payload, dict)
+            and "crc" in str(payload.get("error", "")).lower())
+
+
 def max_body_bytes(content_type: str | None) -> int:
     """The request-body byte cap for a Content-Type header value: both
     formats accept the same universe of board AREAS (boundary-pinned by

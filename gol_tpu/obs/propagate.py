@@ -41,6 +41,44 @@ import uuid
 
 TRACE_HEADER = "X-Gol-Trace"
 
+# -- deadline propagation (PR 14) -------------------------------------------
+#
+# ``X-Gol-Deadline`` carries a job's REMAINING latency budget in seconds —
+# stamped by `gol submit --timeout`, decremented by the router for its own
+# elapsed time before each forward hop, enforced at router forward, worker
+# admission, and batch dispatch (serve/scheduler). It rides this module
+# because it is the same kind of contract as X-Gol-Trace: a hop-by-hop
+# header whose ABSENCE must be byte-identical to the pre-header tree
+# (old client -> new server: no header, no budget, today's behavior;
+# new client -> old server: the unknown header is ignored by stdlib HTTP
+# servers) and whose malformed values DROP silently — a deadline is an
+# optimization contract, and a corrupt header must never 400 a job.
+# The value is a plain decimal seconds-remaining (not an absolute time):
+# wall clocks across a fleet disagree, but "you have 1.25s left" survives
+# any hop unskewed modulo network transit, which only ever shortens it.
+
+DEADLINE_HEADER = "X-Gol-Deadline"
+
+
+def encode_deadline(seconds: float) -> str:
+    """The header value for a remaining budget of ``seconds``."""
+    return f"{float(seconds):.6f}"
+
+
+def decode_deadline(value) -> float | None:
+    """Header value -> remaining seconds, or None for anything absent or
+    malformed (the degrade-to-nothing rule; negative and zero values are
+    VALID — they mean "already expired")."""
+    if not value or not isinstance(value, str):
+        return None
+    try:
+        budget = float(value.strip())
+    except ValueError:
+        return None
+    if budget != budget or budget in (float("inf"), float("-inf")):
+        return None
+    return budget
+
 # Token grammar for each half of the header value. Deliberately tight:
 # these strings end up as Perfetto flow ids and span attributes, and a
 # hostile/corrupt value must degrade to "no context", not ride into
@@ -84,5 +122,5 @@ def sender_label() -> str:
     return f"router-{os.getpid()}"
 
 
-__all__ = ["TRACE_HEADER", "new_trace_id", "encode", "decode",
-           "sender_label"]
+__all__ = ["DEADLINE_HEADER", "TRACE_HEADER", "decode", "decode_deadline",
+           "encode", "encode_deadline", "new_trace_id", "sender_label"]

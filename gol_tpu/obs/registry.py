@@ -114,6 +114,13 @@ class Registry:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def remove_gauge(self, name: str) -> None:
+        """Drop a gauge whose subject is gone (a retired worker's
+        breaker-state series): a stale last value on a per-entity gauge
+        reads as a live report, unlike a counter, which merges."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             self._hists.setdefault(name, Histogram()).observe(value)

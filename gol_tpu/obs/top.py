@@ -224,9 +224,14 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
     workers = metrics.get("workers") or {}
     if workers:
         slo_workers = (slo or {}).get("workers") or {}
+        # Circuit-breaker column (PR 14): present only when the router
+        # runs breakers — the header stays byte-identical otherwise.
+        breakers = (fleet or {}).get("breakers")
+        brk_head = f" {'brk':>9}" if breakers is not None else ""
         lines.append("")
         lines.append(
-            f"  {'worker':<8} {'state':<13} {'queue':>6} {'inflight':>8} "
+            f"  {'worker':<8} {'state':<13}{brk_head} {'queue':>6} "
+            f"{'inflight':>8} "
             f"{'done':>9} {'failed':>7} {'boards/s':>10} {'slo':>12}"
         )
         for wid in sorted(workers):
@@ -240,12 +245,19 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
                 state, state_status = "backpressured", "warning"
             else:
                 state, state_status = "ok", "ok"
+            brk_cell = ""
+            if breakers is not None:
+                brk = breakers.get(wid, "closed")
+                brk_status = {"closed": "ok", "half-open": "warning",
+                              "open": "critical"}.get(brk, "warning")
+                brk_cell = " " + _color(brk_status, f"{brk:>9}", ansi)
             wg = snap.get("gauges") or {}
             wc = snap.get("counters") or {}
             wslo = (slo_workers.get(wid) or {}).get("status", "-")
             lines.append(
                 f"  {wid:<8} "
                 + _color(state_status, f"{state:<13}", ansi)
+                + brk_cell
                 + f" {int(wg.get('queue_depth', 0)):>6}"
                 f" {int(wg.get('inflight_batches', 0)):>8}"
                 f" {int(wc.get('jobs_completed_total', 0)):>9}"

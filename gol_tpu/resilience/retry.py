@@ -17,6 +17,10 @@ before it turns hard — is stdlib-only by the same rule.)
 from __future__ import annotations
 
 import dataclasses
+import errno
+import random
+import socket
+import threading
 import time
 from typing import Callable
 
@@ -51,6 +55,88 @@ def is_transient_io(err: BaseException) -> bool:
     return any(mark in text for mark in _TRANSIENT_IO_MARKS)
 
 
+def delivery_impossible(err: BaseException) -> bool:
+    """Whether an HTTP-exchange failure GUARANTEES the request never
+    reached the peer — the only failures safe to auto-retry (or re-route)
+    for a NON-idempotent request like a job-creating POST. Anything
+    ambiguous — a reset or timeout mid-exchange — may have been accepted
+    and journaled on the far side; re-sending would run the board twice.
+    Connection refused, DNS failure, and host/network-unreachable all fail
+    before a byte is delivered. ``urllib.error.URLError`` wraps its cause
+    in ``reason``; unwrap it so both raw-socket and urllib callers
+    classify identically."""
+    reason = getattr(err, "reason", err)
+    if not isinstance(reason, BaseException):
+        reason = err
+    if isinstance(reason, (ConnectionRefusedError, socket.gaierror)):
+        return True
+    return isinstance(reason, OSError) and reason.errno in (
+        errno.EHOSTUNREACH, errno.ENETUNREACH,
+        getattr(errno, "EHOSTDOWN", errno.EHOSTUNREACH),
+    )
+
+
+class RetryBudget:
+    """A token-bucket cap on RETRIES (not first attempts) across every
+    site that shares the bucket.
+
+    Unbudgeted exponential backoff is individually polite and collectively
+    catastrophic: under a brownout every caller retries, and the retry
+    traffic — each request amplified ``attempts``-fold — is exactly what
+    keeps the browned-out service pinned down (a retry storm is a liveness
+    bug wearing resilience's clothes). A budget bounds the amplification:
+    each taken retry spends one token; tokens refill at ``refill_per_s``
+    up to ``capacity``. When the bucket is empty, ``RetryPolicy.call``
+    surfaces the ORIGINAL error immediately instead of retrying — under
+    sustained failure the caller degrades to at-most-one-attempt, which is
+    the behavior that lets the service come back.
+
+    Thread-safe; clocked on ``time.monotonic`` like the policy deadline.
+    """
+
+    def __init__(self, capacity: float = 10.0, refill_per_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be > 0, got {capacity}")
+        if refill_per_s < 0:
+            raise ValueError(
+                f"refill_per_s must be >= 0, got {refill_per_s}"
+            )
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self.capacity
+        self._last = clock()
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        self._tokens = min(
+            self.capacity,
+            self._tokens + (now - self._last) * self.refill_per_s,
+        )
+        self._last = now
+
+    def try_take(self, tokens: float = 1.0) -> bool:
+        """Spend ``tokens`` if available; False means the budget is
+        exhausted and the caller must NOT retry."""
+        with self._lock:
+            self._refill_locked()
+            if self._tokens < tokens:
+                _obs_registry.default().inc("retry_budget_exhausted_total")
+                return False
+            self._tokens -= tokens
+            remaining = self._tokens
+        reg = _obs_registry.default()
+        reg.set_gauge("retry_budget_remaining", round(remaining, 3))
+        return True
+
+    def remaining(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Bounded attempts + exponential backoff + optional deadline.
@@ -60,6 +146,10 @@ class RetryPolicy:
     deadline is not taken and the last error propagates. ``base_delay=0``
     disables sleeping entirely (the engine's compile-ladder retry wants
     immediate re-dispatch: the tunnel helper either restarted or it didn't).
+    ``jitter`` spreads each backoff uniformly over ``[1-j, 1+j]`` times the
+    nominal delay: synchronized clients whose retries land in lockstep
+    re-create the very spike they are backing off from. 0 (the default)
+    keeps every pre-existing policy's sleeps byte-identical.
     """
 
     attempts: int = 3
@@ -67,12 +157,15 @@ class RetryPolicy:
     multiplier: float = 2.0
     max_delay: float = 2.0
     deadline: float | None = None
+    jitter: float = 0.0
 
     def __post_init__(self):
         if self.attempts < 1:
             raise ValueError(f"attempts must be >= 1, got {self.attempts}")
         if self.base_delay < 0 or self.max_delay < 0:
             raise ValueError("delays must be >= 0")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
 
     def next_delay(self, delay: float) -> float:
         """The backoff step: the single copy of the growth rule, shared by
@@ -89,10 +182,17 @@ class RetryPolicy:
         on_retry: Callable[[int, BaseException, float], None] | None = None,
         sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.monotonic,
+        budget: "RetryBudget | None" = None,
+        rng: Callable[[], float] = random.random,
     ):
         """Run ``fn`` under the policy; returns its value or raises its last
         error. ``on_retry(attempt, err, delay)`` fires before each backoff
-        (attempt is 1-based), so callers can log without wrapping ``fn``."""
+        (attempt is 1-based), so callers can log without wrapping ``fn``.
+
+        ``budget``: every retry (never the first attempt) must win a token
+        from the shared bucket; an exhausted budget raises the error the
+        attempt ACTUALLY produced — the original failure, not a synthetic
+        budget error that would bury the diagnosis a retry storm needs."""
         start = clock()
         delay = self.base_delay
         err: BaseException | None = None
@@ -103,16 +203,24 @@ class RetryPolicy:
                 err = e
                 if attempt >= self.attempts or not retryable(e):
                     raise
+                pause = delay
+                if pause > 0 and self.jitter:
+                    pause *= 1.0 + self.jitter * (2.0 * rng() - 1.0)
                 if (
                     self.deadline is not None
-                    and clock() - start + delay > self.deadline
+                    and clock() - start + pause > self.deadline
                 ):
+                    # Guarded on the ACTUAL jittered pause (drawn above),
+                    # not the nominal delay — an up-jittered sleep must
+                    # not overrun the deadline the docstring promises.
+                    raise
+                if budget is not None and not budget.try_take():
                     raise
                 _obs_registry.default().inc("retry_attempts_total")
                 if on_retry is not None:
                     on_retry(attempt, e, delay)
-                if delay > 0:
-                    sleep(delay)
+                if pause > 0:
+                    sleep(pause)
                 delay = self.next_delay(delay)
         raise err  # pragma: no cover - loop always returns or raises
 

@@ -161,6 +161,15 @@ class Job:
     # the perf_counter stamps; never journaled (replayed jobs have no
     # live trace to join).
     trace: str | None = None
+    # The propagated deadline budget's expiry (obs/propagate.py
+    # X-Gol-Deadline): an ABSOLUTE perf_counter instant set at admission
+    # when the submit carried a remaining-budget header. Enforced at batch
+    # dispatch (scheduler: an expired job fails with the 504 contract
+    # instead of burning a batch slot). Process-local like every other
+    # perf_counter stamp — never journaled; a replayed job has no live
+    # client waiting on the old budget, so it simply runs (the journal's
+    # every-accepted-job-terminates contract wins).
+    expires_at: float | None = None
     # perf_counter stamps, process-local (never journaled).
     accepted_at: float = 0.0
     started_at: float | None = None

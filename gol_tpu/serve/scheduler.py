@@ -91,6 +91,17 @@ class Draining(Exception):
     """Admission rejected: the server is draining."""
 
 
+class DeadlineExceeded(Exception):
+    """The job's propagated deadline budget (X-Gol-Deadline) is spent.
+
+    Raised at admission when the budget arrives already expired (the server
+    maps it to HTTP 504 without creating a job) and used as the failure
+    error at batch dispatch when a queued job's budget runs out before the
+    device sees it — the job terminates (journaled FAILED, so the
+    every-accepted-job-terminates contract holds) and ``GET /result``
+    answers 504 with the job's timeline attached instead of 410."""
+
+
 # Dispatch retry: a transient device/runtime hiccup retries the batch twice
 # more with short backoff; anything else fails the jobs immediately.
 DEFAULT_DISPATCH_RETRY = RetryPolicy(attempts=3, base_delay=0.05,
@@ -133,6 +144,7 @@ class Scheduler:
         run_batch=batcher.run_batch,
         split_batch=None,
         cache=None,
+        retry_budget=None,
         clock=time.perf_counter,
     ):
         if max_queue_depth < 1:
@@ -178,6 +190,15 @@ class Scheduler:
         self.pipeline_depth = pipeline_depth
         self.retry = retry
         self.retryable = retryable
+        # The token-bucket retry budget (resilience/retry.RetryBudget) or
+        # None (unlimited — the pre-budget behavior, test-pinned). Shared
+        # across every batch retry this scheduler takes: under a brownout
+        # the budget drains and dispatch degrades to first-attempt-only
+        # instead of amplifying the overload with retry traffic.
+        self.retry_budget = retry_budget
+        if retry_budget is not None:
+            self.metrics.set_gauge("retry_budget_remaining",
+                                   round(retry_budget.remaining(), 3))
         self._run_batch = run_batch
         # The staged dispatch path (stage -> async dispatch -> complete).
         # Auto-wired to the batcher's split only when run_batch is the
@@ -447,6 +468,11 @@ class Scheduler:
             logger.info("replayed %d unfinished job(s) from the journal", n)
         return n
 
+    def now(self) -> float:
+        """This scheduler's clock reading (the server stamps deadline
+        expiries with it so injected-clock tests stay coherent)."""
+        return self._clock()
+
     def job(self, job_id: str) -> Job | None:
         with self._cv:
             return self._jobs.get(job_id)
@@ -627,6 +653,13 @@ class Scheduler:
     def _on_retry(self, key: BucketKey, batch: list[Job]):
         def on_retry(attempt, err, delay):
             self.metrics.inc("batch_retries_total")
+            if self.retry_budget is not None:
+                # Exported on the SERVING registry so it fleet-merges and
+                # reaches `gol top` like every other serving series.
+                self.metrics.set_gauge(
+                    "retry_budget_remaining",
+                    round(self.retry_budget.remaining(), 3),
+                )
             logger.warning(
                 "batch %s (%d jobs) failed attempt %d, retrying in %.2fs "
                 "(%s: %s)",
@@ -665,9 +698,14 @@ class Scheduler:
             for job in batch:
                 if job.fingerprint is None:
                     continue
+                # Followers belong to whoever holds the in-flight
+                # registration. A deadline-expired leader hands its
+                # registration to a promoted follower BEFORE failing —
+                # the waiters behind the new leader are not this job's
+                # to take.
                 if self._inflight_fp.get(job.fingerprint) is job:
                     del self._inflight_fp[job.fingerprint]
-                taken.extend(self._followers.pop(job.fingerprint, []))
+                    taken.extend(self._followers.pop(job.fingerprint, []))
             if taken:
                 self._queued -= len(taken)
                 self.metrics.set_gauge("queue_depth", self._queued)
@@ -786,7 +824,41 @@ class Scheduler:
             f"(fingerprint {follower.fingerprint})"
         )
 
+    def _drop_expired(self, key: BucketKey, batch: list[Job]) -> list[Job]:
+        """Deadline enforcement at batch dispatch: jobs whose propagated
+        budget (X-Gol-Deadline -> Job.expires_at) is already spent fail
+        HERE — with the DeadlineExceeded 504 contract and their timeline
+        intact — instead of burning a slot in the compiled program for an
+        answer nobody is waiting for. Jobs without a budget (every old
+        client) pass untouched; a batch can lose any subset including all
+        of it (the caller skips the dispatch entirely then)."""
+        now = self._clock()
+        expired = [j for j in batch
+                   if j.expires_at is not None and j.expires_at <= now]
+        if not expired:
+            return batch
+        self.metrics.inc("deadline_expired_total", len(expired))
+        # An expired LEADER's followers are other clients' jobs with
+        # their own (possibly absent) budgets — only the leader's clock
+        # ran out. Promote the first follower into the bucket as the
+        # fingerprint's new leader (the cancel path's move) before
+        # failing, so _fail_batch's follower sweep — which only claims
+        # followers still registered to the failing job — takes nobody
+        # who can still make their deadline.
+        with self._cv:
+            bucket = self._buckets.setdefault(key, [])
+            for job in expired:
+                self._promote_follower_locked(job, bucket)
+            self._cv.notify_all()
+        self._fail_batch(key, expired, DeadlineExceeded(
+            "deadline budget spent before dispatch"
+        ))
+        return [j for j in batch if j not in expired]
+
     def _execute(self, key: BucketKey, batch: list[Job]) -> None:
+        batch = self._drop_expired(key, batch)
+        if not batch:
+            return
         started = self._clock()
         self._begin_batch(batch, started)
         staged = None
@@ -833,6 +905,7 @@ class Scheduler:
                     attempt,
                     retryable=self.retryable,
                     on_retry=self._on_retry(key, batch),
+                    budget=self.retry_budget,
                 )
                 # Flow FINISH inside the batch span, so Perfetto binds the
                 # arrow head to the enclosing serve.batch slice.
@@ -894,9 +967,12 @@ class Scheduler:
         window.close()
 
     def _launch(self, key: BucketKey, batch: list[Job]) -> _Flight:
+        batch = self._drop_expired(key, batch)
         started = self._clock()
-        self._begin_batch(batch, started)
         flight = _Flight(key=key, batch=batch, started=started)
+        if not batch:
+            return flight  # everything expired: an empty (no-op) flight
+        self._begin_batch(batch, started)
         if self._split is None:
             return flight  # completer runs self._run_batch whole
         stage_fn, dispatch_fn, _ = self._split
@@ -933,6 +1009,8 @@ class Scheduler:
 
     def _complete_flight(self, flight: _Flight) -> None:
         key, batch = flight.key, flight.batch
+        if not batch:
+            return  # every job expired at launch; nothing was dispatched
         complete_fn = self._split[2] if self._split is not None else None
 
         def attempt():
@@ -970,6 +1048,7 @@ class Scheduler:
                     attempt,
                     retryable=self.retryable,
                     on_retry=self._on_retry(key, batch),
+                    budget=self.retry_budget,
                 )
                 for job in batch:
                     obs_trace.flow("job", job.flow_id(), "f",
@@ -1070,6 +1149,7 @@ class Scheduler:
 # Re-exported for callers that only import the scheduler module.
 __all__ = [
     "DEFAULT_DISPATCH_RETRY",
+    "DeadlineExceeded",
     "Draining",
     "QueueFull",
     "Scheduler",

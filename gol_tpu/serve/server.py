@@ -88,7 +88,15 @@ from gol_tpu.obs import (
 )
 from gol_tpu.serve.jobs import DONE, FAILED, CANCELLED, JobJournal, new_job
 from gol_tpu.serve.metrics import Metrics
-from gol_tpu.serve.scheduler import Draining, QueueFull, Scheduler
+from gol_tpu.serve.scheduler import (
+    DeadlineExceeded, Draining, QueueFull, Scheduler,
+)
+
+# The journaled error-string prefix that marks a failure as a deadline
+# expiry (scheduler._fail_batch formats errors as "TypeName: message"):
+# result fetches answer 504 for these — including REPLAYED failures,
+# where the prefix is all that survives the restart.
+_DEADLINE_ERROR_PREFIX = DeadlineExceeded.__name__ + ":"
 
 logger = logging.getLogger(__name__)
 
@@ -290,9 +298,10 @@ class GolServer:
 
     # -- request-level operations (handler methods stay thin) -------------
 
-    def submit_json(self, body: dict, trace_header: str | None = None) -> dict:
+    def submit_json(self, body: dict, trace_header: str | None = None,
+                    deadline_header: str | None = None) -> dict:
         if "rle" in body:
-            return self._submit_sparse(body, trace_header)
+            return self._submit_sparse(body, trace_header, deadline_header)
         required = ("width", "height", "cells")
         missing = [k for k in required if k not in body]
         if missing:
@@ -302,10 +311,11 @@ class GolServer:
             raise ValueError(f"dimensions must be positive, got {height}x{width}")
         board = _decode_cells(body["cells"], width, height)
         return self._submit_board(board, None, width, height, body,
-                                  trace_header)
+                                  trace_header, deadline_header)
 
     def _submit_sparse(self, body: dict,
-                       trace_header: str | None = None) -> dict:
+                       trace_header: str | None = None,
+                       deadline_header: str | None = None) -> dict:
         """``POST /jobs`` with an ``rle`` field: a sparse job — a pattern
         placed at (``x``, ``y``) of an otherwise-empty ``width x height``
         universe, run on the sparse tiled engine. Same contract shape as a
@@ -337,10 +347,11 @@ class GolServer:
             **kwargs,
         )
         self.metrics.inc("sparse_submits_total")
-        return self._admit(job, trace_header)
+        return self._admit(job, trace_header, deadline_header)
 
     def submit_packed(self, raw: bytes,
-                      trace_header: str | None = None) -> dict:
+                      trace_header: str | None = None,
+                      deadline_header: str | None = None) -> dict:
         """``POST /jobs`` with the packed wire Content-Type: one frame in,
         the same 202 payload out. The frame's payload words are retained
         on the job (when the width packs), so a packed-kernel bucket
@@ -359,10 +370,11 @@ class GolServer:
         words = frame.words if width % 32 == 0 else None
         self.metrics.inc("wire_packed_submits_total")
         return self._submit_board(board, words, width, height, frame.meta,
-                                  trace_header)
+                                  trace_header, deadline_header)
 
     def _submit_board(self, board, words, width: int, height: int,
-                      body: dict, trace_header: str | None) -> dict:
+                      body: dict, trace_header: str | None,
+                      deadline_header: str | None = None) -> dict:
         """The format-independent half of a submit: field validation via
         Job, trace adoption, scheduler admission. ``body`` is the JSON
         object (text lane) or the frame meta (packed lane) — identical
@@ -377,11 +389,12 @@ class GolServer:
         if body.get("deadline_s") is not None:
             kwargs["deadline_s"] = float(body["deadline_s"])
         job = new_job(width, height, board, words=words, **kwargs)
-        return self._admit(job, trace_header)
+        return self._admit(job, trace_header, deadline_header)
 
-    def _admit(self, job, trace_header: str | None) -> dict:
-        """Trace adoption + scheduler admission (shared by the dense text,
-        packed wire, and sparse RLE submit lanes).
+    def _admit(self, job, trace_header: str | None,
+               deadline_header: str | None = None) -> dict:
+        """Trace adoption + deadline adoption + scheduler admission (shared
+        by the dense text, packed wire, and sparse RLE submit lanes).
 
         Trace-context adoption (obs/propagate.py): a router forwarding
         under `--trace` stamps X-Gol-Trace; when tracing is enabled HERE
@@ -389,11 +402,31 @@ class GolServer:
         the router's trace. Tracing disabled (the default) never looks at
         the header — an old client (no header) and a headered forward are
         byte-identical through this path, response included (test-pinned).
+
+        Deadline adoption (X-Gol-Deadline, same degradation standard): a
+        submit carrying a remaining-budget header is refused 504 HERE when
+        the budget arrived spent (scheduler-admission enforcement: no job,
+        no journal record, no queue slot), and otherwise stamps
+        ``job.expires_at`` for the dispatch-time gate. The budget also
+        tightens ``deadline_s`` so dispatch ORDERING sees the urgency. No
+        header — every old client and router — changes nothing (pinned);
+        malformed values drop silently, exactly like a malformed trace.
         """
         if trace_header is not None and obs_trace.enabled():
             ctx = obs_propagate.decode(trace_header)
             if ctx is not None:
                 job.trace = ctx[0]
+        budget = obs_propagate.decode_deadline(deadline_header)
+        if budget is not None:
+            if budget <= 0:
+                self.metrics.inc("deadline_expired_total")
+                raise DeadlineExceeded(
+                    f"deadline budget spent before admission "
+                    f"({budget:.3f}s remaining)"
+                )
+            job.expires_at = self.scheduler.now() + budget
+            if job.deadline_s is None or budget < job.deadline_s:
+                job.deadline_s = budget
         self.scheduler.submit(job)
         return {"id": job.id, "state": job.state}
 
@@ -485,11 +518,29 @@ class GolServer:
             }
         if job is None:
             if job_id in self._replay_failed:
-                return 410, {"id": job_id, "state": FAILED,
-                             "error": self._replay_failed[job_id]}
+                error = self._replay_failed[job_id]
+                if error.startswith(_DEADLINE_ERROR_PREFIX):
+                    # A deadline expiry that predates this process: the
+                    # 504 contract survives the restart (the prefix is
+                    # journaled); its perf_counter timeline did not.
+                    return 504, {"id": job_id, "state": FAILED,
+                                 "error": error, "restored": True}
+                return 410, {"id": job_id, "state": FAILED, "error": error}
             if job_id in self._replay_cancelled:
                 return 410, {"id": job_id, "state": CANCELLED, "error": None}
             return 404, {"error": f"unknown job {job_id}"}
+        if (job.state == FAILED and job.error
+                and job.error.startswith(_DEADLINE_ERROR_PREFIX)):
+            # The deadline-expiry contract: 504 (the budget ran out, the
+            # engine never saw the job) with the PR-7 timeline attached —
+            # where the budget actually went is the answer the client
+            # needs, and this job will never have a result to carry it.
+            return 504, {
+                "id": job_id,
+                "state": FAILED,
+                "error": job.error,
+                **obs_timeline.summary(dict(job.timeline)),
+            }
         if job.state in (FAILED, CANCELLED):
             return 410, {"id": job_id, "state": job.state, "error": job.error}
         return 409, {"id": job_id, "state": job.state,
@@ -609,10 +660,14 @@ def _make_handler(server: GolServer):
                     trace_header = self.headers.get(
                         obs_propagate.TRACE_HEADER
                     )
+                    deadline_header = self.headers.get(
+                        obs_propagate.DEADLINE_HEADER
+                    )
                     try:
                         if ctype == wire.CONTENT_TYPE:
                             out = server.submit_packed(
-                                self._read_raw(), trace_header=trace_header
+                                self._read_raw(), trace_header=trace_header,
+                                deadline_header=deadline_header,
                             )
                         elif ctype.startswith(wire.CONTENT_TYPE_FAMILY):
                             # A gol wire format this server does not speak
@@ -633,9 +688,16 @@ def _make_handler(server: GolServer):
                             out = server.submit_json(
                                 self._read_body(),
                                 trace_header=trace_header,
+                                deadline_header=deadline_header,
                             )
                     except wire.UnsupportedWire as e:
                         self._reply(415, {"error": str(e)})
+                        return
+                    except DeadlineExceeded as e:
+                        # Admission-time deadline enforcement: the budget
+                        # arrived spent — no job was created, no batch
+                        # slot will burn for it.
+                        self._reply(504, {"error": str(e)})
                         return
                     except (QueueFull, Draining) as e:
                         self._reply(429, {"error": str(e)})

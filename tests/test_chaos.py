@@ -1,0 +1,1538 @@
+"""Chaos-hardened data path (PR 14): seeded network fault injection
+(gol_tpu/chaos), per-worker circuit breakers (fleet/breaker.py), token-bucket
+retry budgets (resilience/retry.RetryBudget), and end-to-end deadline
+propagation (X-Gol-Deadline).
+
+The load-bearing block is TestChaosMatrix: every fault class the plan
+grammar can inject (latency, refusal, reset mid-exchange, slow-loris,
+truncation, bit-flip) runs against a REAL 2-worker fleet, and each must end
+in either transparent recovery or the documented error contract — never a
+hang, a double-run, or a silently wrong board. Corrupted ``GOLP`` frames
+are 100% caught by the PR-11 CRC (pinned bit-by-bit in TestFlipBit).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from gol_tpu import oracle
+from gol_tpu.chaos import ChaosPlan, ProxyPool
+from gol_tpu.chaos.plan import FAULT_KINDS
+from gol_tpu.chaos.proxy import ChaosProxy, _flip_bit
+from gol_tpu.config import GameConfig
+from gol_tpu.fleet import client as fleet_client
+from gol_tpu.fleet import placement
+from gol_tpu.fleet.breaker import (
+    CLOSED, HALF_OPEN, OPEN, BreakerConfig, CircuitBreaker,
+)
+from gol_tpu.fleet.router import RouterServer
+from gol_tpu.fleet.workers import Fleet
+from gol_tpu.io import text_grid, wire
+from gol_tpu.obs import propagate
+from gol_tpu.resilience.retry import RetryBudget, RetryPolicy
+from gol_tpu.serve.server import GolServer
+
+
+class _Clock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def _http(method, url, body=None, timeout=30, headers=None):
+    return fleet_client.http_json(method, url, body, timeout=timeout,
+                                  headers=headers)
+
+
+def _wait(predicate, timeout=60.0, interval=0.02):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# The plan grammar + seeded schedules
+
+
+class TestChaosPlan:
+    def test_parse_round_trip_and_defaults(self):
+        plan = ChaosPlan.parse(
+            "seed=7,reset=0.05,latency=0.2,latency_ms=50,bitflip=0.125"
+        )
+        assert plan.seed == 7
+        assert plan.reset == 0.05
+        assert plan.latency == 0.2
+        assert plan.latency_ms == 50
+        assert plan.bitflip == 0.125
+        assert plan.refuse == 0.0 and plan.truncate == 0.0
+        assert plan.slow_ms == 20 and plan.slow_chunk == 256
+        assert plan.any_faults()
+        assert not ChaosPlan.parse("seed=3").any_faults()
+        assert ChaosPlan.parse("") == ChaosPlan()
+
+    def test_unknown_key_is_a_loud_error(self):
+        # The FaultPlan.parse contract: a typo'd injection must never
+        # silently test nothing.
+        with pytest.raises(ValueError, match="unknown chaos plan key"):
+            ChaosPlan.parse("restet=0.5")
+        with pytest.raises(ValueError, match="not k=v"):
+            ChaosPlan.parse("reset")
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="probability"):
+            ChaosPlan.parse("reset=1.5")
+        with pytest.raises(ValueError, match="probability"):
+            ChaosPlan(bitflip=-0.1)
+        with pytest.raises(ValueError, match="delays"):
+            ChaosPlan(latency_ms=-1)
+        with pytest.raises(ValueError, match="slow_chunk"):
+            ChaosPlan(slow_chunk=0)
+
+    def test_seed_determinism(self):
+        """Same (seed, salt) -> identical decision stream, run to run;
+        different salts -> independent streams for pool-mounted proxies."""
+        plan = ChaosPlan(seed=11, reset=0.3, latency=0.3, bitflip=0.2)
+        s1, s2 = plan.schedule(salt=0), plan.schedule(salt=0)
+        run1 = [s1.next_fault() for _ in range(64)]
+        run2 = [s2.next_fault() for _ in range(64)]
+        assert run1 == run2
+        s3 = plan.schedule(salt=1)
+        salted = [s3.next_fault() for _ in range(64)]
+        assert salted != run1
+
+    def test_roll_alignment_across_fault_mixes(self):
+        """Every class is rolled every exchange, so the Nth exchange's
+        underlying draws depend only on (seed, salt, N) — never on which
+        classes happened to fire before. Pinned by comparing the bitflip
+        position draw between a latency-only and a truncate-only plan."""
+        sched_a = ChaosPlan(seed=5, latency=1.0).schedule()
+        sched_b = ChaosPlan(seed=5, truncate=1.0).schedule()
+        for _ in range(32):
+            fault_a, draw_a, flip_a = sched_a.next_fault()
+            fault_b, draw_b, flip_b = sched_b.next_fault()
+            assert fault_a == "latency" and fault_b == "truncate"
+            assert draw_a == draw_b and flip_a == flip_b
+
+    def test_fault_kinds_vocabulary(self):
+        assert FAULT_KINDS == ("refuse", "reset", "truncate", "slowloris",
+                               "bitflip", "latency")
+
+
+# ---------------------------------------------------------------------------
+# Bit flips vs the PR-11 CRC gate (pinned: 100% caught)
+
+
+class TestFlipBit:
+    def _frame(self):
+        grid = text_grid.generate(32, 32, seed=9)
+        return wire.encode_frame({"gen_limit": 4}, grid=grid)
+
+    def test_flips_exactly_one_bit(self):
+        frame = self._frame()
+        flipped = _flip_bit(frame, 0.37)
+        diff = [(a ^ b) for a, b in zip(frame, flipped)]
+        assert sum(bin(d).count("1") for d in diff) == 1
+
+    def test_every_flip_position_is_caught_by_the_crc(self):
+        """The pinned contract: a GOLP frame flip lands INSIDE the
+        CRC-covered words payload, so decode_frame must reject EVERY
+        draw — a transit bit-flip can never decode into a wrong board."""
+        frame = self._frame()
+        for i in range(256):
+            flipped = _flip_bit(frame, i / 256.0)
+            assert flipped != frame
+            with pytest.raises(wire.WireError, match="CRC"):
+                wire.decode_frame(flipped)
+
+    def test_non_golp_body_flips_in_the_trailing_half(self):
+        body = bytes(range(200)) + bytes(200)
+        flipped = _flip_bit(body, 0.5)
+        assert flipped != body
+        assert flipped[: len(body) // 2] == body[: len(body) // 2]
+
+    def test_tiny_body_passes_untouched(self):
+        assert _flip_bit(b"", 0.5) == b""
+        assert _flip_bit(b"x", 0.0) != b"x"  # 1 byte still flips
+
+
+# ---------------------------------------------------------------------------
+# The breaker state machine (injected clock; no sleeps)
+
+
+class TestCircuitBreaker:
+    def _breaker(self, clock, transitions=None, **cfg):
+        config = BreakerConfig(**{"fail_threshold": 3, "cooldown_s": 5.0,
+                                  **cfg})
+        on_transition = None
+        if transitions is not None:
+            on_transition = lambda label, old, new: transitions.append(  # noqa: E731
+                (old, new))
+        return CircuitBreaker(config, clock=clock,
+                              on_transition=on_transition, label="w0")
+
+    def test_consecutive_failures_trip_at_threshold(self):
+        clock = _Clock()
+        transitions = []
+        br = self._breaker(clock, transitions)
+        br.on_failure()
+        br.on_failure()
+        assert br.state == CLOSED
+        br.on_failure()
+        assert br.state == OPEN
+        assert br.opens == 1
+        assert transitions == [(CLOSED, OPEN)]
+
+    def test_success_resets_the_consecutive_count(self):
+        clock = _Clock()
+        # min_volume above the window keeps the (separately tested)
+        # degraded-rate trip quiet: this test pins ONLY the consecutive
+        # counter reset.
+        br = self._breaker(clock, min_volume=100)
+        for _ in range(4):
+            br.on_failure()
+            br.on_failure()
+            br.on_success(0.01)
+        assert br.state == CLOSED
+
+    def test_degraded_rate_trips_with_min_volume(self):
+        """A brownout — slow answers mixed into successes — trips the
+        windowed rate even with zero consecutive failures."""
+        clock = _Clock()
+        br = self._breaker(clock, window=10, min_volume=10,
+                           degraded_rate=0.5, slow_s=1.0,
+                           fail_threshold=100)
+        for i in range(9):
+            br.on_success(2.0 if i % 2 == 0 else 0.01)  # alternating slow
+        assert br.state == CLOSED  # below min_volume
+        br.on_success(2.0)  # 6 degraded / 10 >= 0.5
+        assert br.state == OPEN
+
+    def test_penalty_and_cooldown(self):
+        clock = _Clock()
+        br = self._breaker(clock, cooldown_s=5.0)
+        assert br.penalty() == 0
+        for _ in range(3):
+            br.on_failure()
+        assert br.penalty() == 1  # OPEN inside cooldown: rank last
+        clock.now += 5.1
+        # Past cooldown the would-be probe ranks NORMALLY (or recovery
+        # never gets traffic).
+        assert br.penalty() == 0
+
+    def test_half_open_admits_exactly_one_probe(self):
+        clock = _Clock()
+        br = self._breaker(clock)
+        for _ in range(3):
+            br.on_failure()
+        clock.now += 6.0
+        br.on_attempt()
+        assert br.state == HALF_OPEN
+        # While the probe is in flight, the worker ranks last again —
+        # a recovering worker sees a trickle, not a stampede.
+        assert br.penalty() == 1
+        br.on_attempt()  # a second attempt does not become a second probe
+        assert br.state == HALF_OPEN
+        br.on_success(0.01)
+        assert br.state == CLOSED
+        assert br.penalty() == 0
+
+    def test_failed_probe_reopens_and_rearms_cooldown(self):
+        clock = _Clock()
+        transitions = []
+        br = self._breaker(clock, transitions)
+        for _ in range(3):
+            br.on_failure()
+        clock.now += 6.0
+        br.on_attempt()
+        br.on_failure()
+        assert br.state == OPEN and br.opens == 2
+        assert br.penalty() == 1  # cooldown re-armed from the fresh failure
+        clock.now += 5.1
+        assert br.penalty() == 0
+        assert transitions == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                               (HALF_OPEN, OPEN)]
+
+    def test_slow_probe_success_is_not_recovery(self):
+        clock = _Clock()
+        br = self._breaker(clock, slow_s=1.0)
+        for _ in range(3):
+            br.on_failure()
+        clock.now += 6.0
+        br.on_attempt()
+        br.on_success(3.0)  # answered, but degraded
+        assert br.state == OPEN
+
+    def test_public_shape(self):
+        br = self._breaker(_Clock())
+        br.on_failure()
+        snap = br.public()
+        assert snap["state"] == CLOSED
+        assert snap["consecutive_failures"] == 1
+        assert snap["opens"] == 0
+        assert 0.0 <= snap["degraded"] <= 1.0
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            BreakerConfig(fail_threshold=0)
+        with pytest.raises(ValueError):
+            BreakerConfig(degraded_rate=0.0)
+        with pytest.raises(ValueError):
+            BreakerConfig(slow_s=0.0)
+        with pytest.raises(ValueError):
+            BreakerConfig(cooldown_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# Breakers inside the router: ranking, recovery, the richer 504 body
+
+
+class TestBreakerRouting:
+    def _fleet(self, tmp_path, ids=("wa", "wb")):
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        for wid in ids:
+            fleet.attach(f"http://{wid}.invalid", wid)
+        return fleet
+
+    def test_open_breaker_ranks_last_not_removed(self, tmp_path):
+        body = json.dumps({"width": 32, "height": 32}).encode()
+        key = placement.key_for(json.loads(body))
+        first, second = placement.rank(key.label(), ["wa", "wb"])
+
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            if first in url:
+                raise ConnectionRefusedError("down")
+            return 202, {"id": "j1", "state": "queued"}
+
+        fleet = self._fleet(tmp_path)
+        router = RouterServer(
+            fleet, port=0, http=stub_http, breakers=True,
+            breaker_config=BreakerConfig(fail_threshold=2, cooldown_s=100.0),
+        )
+        try:
+            for _ in range(2):
+                status, payload = router.route_submit(body)
+                assert status == 202 and payload["worker"] == second
+            states = router.breaker_states()
+            assert states[first] == OPEN and states[second] == CLOSED
+            # Re-RANKED, never removed: the open worker sinks to the tail
+            # of its tier but stays a candidate (HRW affinity survives).
+            order = [w.id for w in router.candidates(key)]
+            assert order == [second, first]
+            # The breaker surfaces on metrics_json for `gol top`.
+            assert router.metrics_json()["fleet"]["breakers"][first] == OPEN
+        finally:
+            router.httpd.server_close()
+
+    def test_recovery_reranks_through_half_open_probe(self, tmp_path):
+        body = json.dumps({"width": 32, "height": 32}).encode()
+        key = placement.key_for(json.loads(body))
+        first, second = placement.rank(key.label(), ["wa", "wb"])
+        down = {"down": True}
+
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            if first in url and down["down"]:
+                raise ConnectionRefusedError("down")
+            return 202, {"id": "j1", "state": "queued"}
+
+        fleet = self._fleet(tmp_path)
+        router = RouterServer(
+            fleet, port=0, http=stub_http, breakers=True,
+            # cooldown 0: the next ranked attempt IS the half-open probe.
+            breaker_config=BreakerConfig(fail_threshold=2, cooldown_s=0.0),
+        )
+        try:
+            for _ in range(2):
+                router.route_submit(body)
+            assert router.breaker_states()[first] == OPEN
+            down["down"] = False
+            # Past the cooldown the would-be probe ranks normally again,
+            # the probe succeeds, and the breaker closes.
+            status, payload = router.route_submit(body)
+            assert status == 202 and payload["worker"] == first
+            assert router.breaker_states()[first] == CLOSED
+            assert router.registry.counter("breaker_opens_total") == 1
+            assert router.registry.counter("breaker_closes_total") == 1
+        finally:
+            router.httpd.server_close()
+
+    def test_probe_in_flight_defers_to_next_candidate(self, tmp_path):
+        """The single-probe contract under concurrency: a submit that
+        ranked an open-past-cooldown worker normally but lost the probe
+        slot to a concurrent caller forwards to the NEXT candidate, not
+        onto the still-recovering worker."""
+        body = json.dumps({"width": 32, "height": 32}).encode()
+        key = placement.key_for(json.loads(body))
+        first, second = placement.rank(key.label(), ["wa", "wb"])
+        forwarded = []
+
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            forwarded.append(url)
+            return 202, {"id": f"j{len(forwarded)}", "state": "queued"}
+
+        fleet = self._fleet(tmp_path)
+        router = RouterServer(
+            fleet, port=0, http=stub_http, breakers=True,
+            breaker_config=BreakerConfig(fail_threshold=1, cooldown_s=0.0),
+        )
+        try:
+            br = router.breaker(first)
+            br.on_failure()  # OPEN; cooldown 0 = instantly probe-eligible
+            assert br.on_attempt()  # "concurrent" caller claims the probe
+            assert br.state == HALF_OPEN
+            status, payload = router.route_submit(body)
+            assert status == 202 and payload["worker"] == second
+            assert all(first not in url for url in forwarded)
+        finally:
+            router.httpd.server_close()
+
+    def test_probe_in_flight_worker_stays_last_resort(self, tmp_path):
+        """Deferred, never removed: when every other candidate is gone,
+        the probing worker still gets the forward (capacity over purity —
+        the alternative is a 503 with a live worker standing)."""
+        body = json.dumps({"width": 32, "height": 32}).encode()
+
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            return 202, {"id": "j1", "state": "queued"}
+
+        fleet = self._fleet(tmp_path, ids=("wa",))
+        router = RouterServer(
+            fleet, port=0, http=stub_http, breakers=True,
+            breaker_config=BreakerConfig(fail_threshold=1, cooldown_s=0.0),
+        )
+        try:
+            br = router.breaker("wa")
+            br.on_failure()
+            assert br.on_attempt()  # probe claimed elsewhere
+            status, payload = router.route_submit(body)
+            assert status == 202 and payload["worker"] == "wa"
+        finally:
+            router.httpd.server_close()
+
+    def test_prometheus_deadline_counters_survive_no_breakers(self, tmp_path):
+        """Deadline enforcement and CRC retries run breakers-or-not; a
+        --no-breakers fleet must still export their counters (a dashboard
+        showing zero expiries while clients get 504s is a lie)."""
+        fleet = self._fleet(tmp_path)
+        router = RouterServer(fleet, port=0, breakers=False)
+        try:
+            router.registry.inc("deadline_expired_total")
+            text = router.metrics_prometheus()
+            assert "gol_fleet_deadline_expired_total 1" in text
+            assert "gol_fleet_wire_crc_retries_total 0" in text
+            assert "breaker_state" not in text
+        finally:
+            router.httpd.server_close()
+
+    def test_ambiguous_504_names_worker_and_breaker_state(self, tmp_path):
+        """The PR-8 fix: an ambiguous submit outcome must say WHERE the
+        outcome is unknown (and that worker's breaker state) so the
+        client knows which partition to audit before resubmitting."""
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            raise TimeoutError("timed out mid-exchange")
+
+        fleet = self._fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=stub_http, breakers=True)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 504
+            assert "outcome unknown" in payload["error"]
+            assert payload["worker"] in ("wa", "wb")
+            assert payload["worker"] in payload["error"]
+            assert payload["breaker"] == CLOSED  # one timeout < threshold
+        finally:
+            router.httpd.server_close()
+
+    def test_ambiguous_504_without_breakers_keeps_worker_field(
+            self, tmp_path):
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            raise TimeoutError("timed out mid-exchange")
+
+        fleet = self._fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 504
+            assert payload["worker"] in ("wa", "wb")
+            assert "breaker" not in payload  # feature off: no new key
+        finally:
+            router.httpd.server_close()
+
+    def test_breakers_default_off_and_states_empty(self, tmp_path):
+        fleet = self._fleet(tmp_path)
+        router = RouterServer(fleet, port=0, http=lambda *a, **k: (202, {}))
+        try:
+            assert not router.breakers_enabled
+            assert router.breaker_states() == {}
+            assert router.breaker("wa") is None
+            assert "breakers" not in router.metrics_json()["fleet"]
+        finally:
+            router.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation: header codec, router enforcement, hop decrement
+
+
+class TestDeadlineHeader:
+    def test_codec(self):
+        assert propagate.decode_deadline(
+            propagate.encode_deadline(1.25)) == 1.25
+        assert propagate.decode_deadline("0.5") == 0.5
+        assert propagate.decode_deadline("-0.1") == -0.1  # expired is VALID
+        assert propagate.decode_deadline(None) is None
+        assert propagate.decode_deadline("") is None
+        assert propagate.decode_deadline("soon") is None
+        assert propagate.decode_deadline("nan") is None
+        assert propagate.decode_deadline("inf") is None
+        assert propagate.decode_deadline(7) is None  # non-str degrades
+
+    def test_router_rejects_spent_budget_without_forwarding(self, tmp_path):
+        calls = []
+
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            calls.append(url)
+            return 202, {"id": "j", "state": "queued"}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://wa.invalid", "wa")
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode(),
+                deadline_header="-0.5",
+            )
+            assert status == 504
+            assert "deadline budget spent" in payload["error"]
+            assert calls == []  # no forward: no batch slot burned anywhere
+            assert router.registry.counter("deadline_expired_total") == 1
+        finally:
+            router.httpd.server_close()
+
+    def test_router_decrements_and_caps_hop_timeout(self, tmp_path):
+        seen = []
+
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            seen.append((timeout, (kw.get("headers") or {}).get(
+                propagate.DEADLINE_HEADER)))
+            return 202, {"id": "j", "state": "queued"}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://wa.invalid", "wa")
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, _ = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode(),
+                deadline_header="5.0",
+            )
+            assert status == 202
+            timeout, header = seen[0]
+            forwarded = propagate.decode_deadline(header)
+            # Decremented by the router's own elapsed time, never grown.
+            assert forwarded is not None and 0 < forwarded <= 5.0
+            # The hop timeout is capped by what the client has left.
+            assert timeout <= 5.0
+        finally:
+            router.httpd.server_close()
+
+    def test_no_header_keeps_the_call_shape_byte_identical(self, tmp_path):
+        """The old-peer compat pin (the X-Gol-Trace standard): without a
+        deadline the forward carries no headers kwarg at all — the PR-8
+        call shape, byte-identical on the wire."""
+        kwargs_seen = []
+
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            kwargs_seen.append(dict(kw))
+            return 202, {"id": "j", "state": "queued"}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://wa.invalid", "wa")
+        router = RouterServer(fleet, port=0, http=stub_http, breakers=True)
+        try:
+            status, _ = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode()
+            )
+            assert status == 202
+            assert kwargs_seen == [{}]
+        finally:
+            router.httpd.server_close()
+
+    def test_malformed_header_degrades_to_no_deadline(self, tmp_path):
+        def stub_http(method, url, body=None, raw=None, timeout=0, **kw):
+            assert propagate.DEADLINE_HEADER not in (kw.get("headers") or {})
+            return 202, {"id": "j", "state": "queued"}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://wa.invalid", "wa")
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, _ = router.route_submit(
+                json.dumps({"width": 32, "height": 32}).encode(),
+                deadline_header="whenever",
+            )
+            assert status == 202  # malformed drops silently, never 400s/504s
+        finally:
+            router.httpd.server_close()
+
+
+class TestDeadlineAtWorker:
+    def test_admission_rejects_spent_budget_with_504(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=3)
+            status, payload = _http(
+                "POST", f"{srv.url}/jobs",
+                {"width": 32, "height": 32,
+                 "cells": text_grid.encode(board).decode("ascii"),
+                 "gen_limit": 4},
+                headers={propagate.DEADLINE_HEADER: "-1.0"},
+            )
+            assert status == 504
+            assert "deadline budget spent" in payload["error"]
+            # No job was created: no journal record, no queue slot.
+            assert srv.metrics.counter("jobs_accepted_total") == 0
+            assert srv.metrics.counter("deadline_expired_total") == 1
+        finally:
+            srv.shutdown()
+
+    def test_expired_in_queue_fails_504_with_timeline(self, tmp_path):
+        """The dispatch gate: a job whose budget runs out while queued
+        terminates with the 504 contract and its timeline attached —
+        instead of burning a batch slot on an answer nobody awaits."""
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.5)  # hold the batch open past expiry
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=4)
+            status, payload = _http(
+                "POST", f"{srv.url}/jobs",
+                {"width": 32, "height": 32,
+                 "cells": text_grid.encode(board).decode("ascii"),
+                 "gen_limit": 4},
+                headers={propagate.DEADLINE_HEADER: "0.05"},
+            )
+            assert status == 202, payload
+            job_id = payload["id"]
+            assert _wait(lambda: _http(
+                "GET", f"{srv.url}/jobs/{job_id}")[1].get("state")
+                == "failed")
+            status, result = _http("GET", f"{srv.url}/result/{job_id}")
+            assert status == 504
+            assert result["error"].startswith("DeadlineExceeded")
+            assert "segments" in result  # the PR-7 timeline rode along
+            assert srv.metrics.counter("deadline_expired_total") >= 1
+        finally:
+            srv.shutdown()
+
+    def test_generous_budget_runs_normally(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=5)
+            status, payload = _http(
+                "POST", f"{srv.url}/jobs",
+                {"width": 32, "height": 32,
+                 "cells": text_grid.encode(board).decode("ascii"),
+                 "gen_limit": 6},
+                headers={propagate.DEADLINE_HEADER: "120.0"},
+            )
+            assert status == 202
+            job_id = payload["id"]
+            assert _wait(lambda: _http(
+                "GET", f"{srv.url}/jobs/{job_id}")[1].get("state") == "done")
+            status, result = _http("GET", f"{srv.url}/result/{job_id}")
+            assert status == 200
+            want = oracle.run(board, GameConfig(gen_limit=6))
+            got = text_grid.decode(result["grid"].encode("ascii"),
+                                   result["width"], result["height"])
+            np.testing.assert_array_equal(np.asarray(got), want.grid)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Retry budgets + jitter (the storm governor)
+
+
+class TestRetryBudget:
+    def test_tokens_drain_and_refill(self):
+        clock = _Clock()
+        budget = RetryBudget(capacity=2.0, refill_per_s=1.0, clock=clock)
+        assert budget.try_take() and budget.try_take()
+        assert not budget.try_take()  # empty
+        clock.now += 1.5
+        assert budget.remaining() == pytest.approx(1.5)
+        assert budget.try_take()
+        assert not budget.try_take()
+
+    def test_refill_caps_at_capacity(self):
+        clock = _Clock()
+        budget = RetryBudget(capacity=3.0, refill_per_s=10.0, clock=clock)
+        clock.now += 100.0
+        assert budget.remaining() == 3.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryBudget(capacity=0)
+        with pytest.raises(ValueError):
+            RetryBudget(refill_per_s=-1)
+
+    def test_exhausted_budget_surfaces_the_original_error(self):
+        """The liveness pin: an empty bucket must raise the error the
+        attempt ACTUALLY produced — degrading to at-most-one-attempt —
+        not a synthetic budget error, and never keep retrying."""
+        clock = _Clock()
+        budget = RetryBudget(capacity=1.0, refill_per_s=0.0, clock=clock)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ConnectionResetError("connection reset by peer")
+
+        policy = RetryPolicy(attempts=5, base_delay=0.0)
+        with pytest.raises(ConnectionResetError):
+            policy.call(fn, budget=budget, sleep=lambda s: None)
+        # First attempt + the single budgeted retry; attempts 3..5 never
+        # ran because the bucket was empty.
+        assert len(calls) == 2
+
+    def test_first_attempts_never_spend_tokens(self):
+        clock = _Clock()
+        budget = RetryBudget(capacity=1.0, refill_per_s=0.0, clock=clock)
+        policy = RetryPolicy(attempts=3, base_delay=0.0)
+        for _ in range(5):
+            assert policy.call(lambda: "ok", budget=budget) == "ok"
+        assert budget.remaining() == 1.0
+
+    def test_jitter_spreads_backoff_and_zero_is_byte_identical(self):
+        sleeps = []
+        policy = RetryPolicy(attempts=3, base_delay=1.0, multiplier=2.0,
+                             max_delay=8.0, jitter=0.5)
+
+        def fail():
+            raise ConnectionResetError("connection reset")
+
+        with pytest.raises(ConnectionResetError):
+            policy.call(fail, sleep=sleeps.append, rng=lambda: 0.0)
+        assert sleeps == [0.5, 1.0]  # 1-j of the nominal 1.0, 2.0
+        sleeps.clear()
+        with pytest.raises(ConnectionResetError):
+            policy.call(fail, sleep=sleeps.append, rng=lambda: 1.0)
+        assert sleeps == [1.5, 3.0]  # 1+j
+        sleeps.clear()
+        nojitter = RetryPolicy(attempts=3, base_delay=1.0, multiplier=2.0,
+                               max_delay=8.0)
+        with pytest.raises(ConnectionResetError):
+            nojitter.call(fail, sleep=sleeps.append,
+                          rng=lambda: 1.0)  # rng unused at jitter=0
+        assert sleeps == [1.0, 2.0]  # the pre-jitter sleeps, untouched
+
+    def test_jitter_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=1.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=-0.1)
+
+
+# ---------------------------------------------------------------------------
+# The proxy itself, against a tiny stdlib upstream
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    payload = json.dumps({"ok": True, "filler": "x" * 2048}).encode()
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(self.payload)))
+        self.end_headers()
+        self.wfile.write(self.payload)
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def upstream():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _EchoHandler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    url = "http://127.0.0.1:%d" % httpd.server_address[1]
+    yield url
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestChaosProxy:
+    def _proxy(self, upstream, **plan_kwargs):
+        proxy = ChaosProxy(upstream, ChaosPlan(**plan_kwargs))
+        return proxy
+
+    def test_transparent_relay_and_keepalive(self, upstream):
+        proxy = self._proxy(upstream)
+        try:
+            status, ctype, body = fleet_client.http_exchange(
+                "GET", proxy.url + "/anything")
+            assert status == 200 and body == _EchoHandler.payload
+            status, _, echoed = fleet_client.http_exchange(
+                "POST", proxy.url + "/echo", raw=b"hello-bytes",
+                content_type="application/octet-stream")
+            assert status == 200 and echoed == b"hello-bytes"
+            stats = proxy.stats()
+            assert stats["exchanges"] == 2
+            assert all(stats[k] == 0 for k in FAULT_KINDS)
+        finally:
+            proxy.close()
+
+    def test_latency_fault_delays_the_response(self, upstream):
+        proxy = self._proxy(upstream, latency=1.0, latency_ms=80)
+        try:
+            t0 = time.perf_counter()
+            status, _, _ = fleet_client.http_exchange("GET", proxy.url + "/")
+            assert status == 200
+            assert time.perf_counter() - t0 >= 0.08
+            assert proxy.stats()["latency"] == 1
+        finally:
+            proxy.close()
+
+    def test_refuse_fault_resets_before_the_request_is_read(self, upstream):
+        proxy = self._proxy(upstream, refuse=1.0)
+        try:
+            with pytest.raises((urllib.error.URLError, ConnectionError,
+                                OSError)):
+                fleet_client.http_exchange("GET", proxy.url + "/")
+            assert proxy.stats()["refuse"] == 1
+        finally:
+            proxy.close()
+
+    def test_reset_mid_exchange_raises_connection_trouble(self, upstream):
+        proxy = self._proxy(upstream, reset=1.0)
+        try:
+            with pytest.raises((urllib.error.URLError, ConnectionError,
+                                OSError)):
+                fleet_client.http_exchange("GET", proxy.url + "/")
+            assert proxy.stats()["reset"] == 1
+        finally:
+            proxy.close()
+
+    def test_truncation_normalizes_to_connection_error(self, upstream):
+        """A cleanly-closed half response raises IncompleteRead — an
+        HTTPException only — which fleet/client.py must normalize to
+        ConnectionError so every liveness classifier treats the torn
+        payload as connection trouble (the PR-14 client hardening)."""
+        proxy = self._proxy(upstream, truncate=1.0)
+        try:
+            with pytest.raises((ConnectionError, OSError,
+                                urllib.error.URLError)):
+                fleet_client.http_exchange("GET", proxy.url + "/")
+            assert proxy.stats()["truncate"] == 1
+        finally:
+            proxy.close()
+
+    def test_slowloris_trickles_but_completes(self, upstream):
+        proxy = self._proxy(upstream, slowloris=1.0, slow_ms=5,
+                            slow_chunk=256)
+        try:
+            t0 = time.perf_counter()
+            status, _, body = fleet_client.http_exchange(
+                "GET", proxy.url + "/")
+            assert status == 200 and body == _EchoHandler.payload
+            assert time.perf_counter() - t0 >= 0.02
+            assert proxy.stats()["slowloris"] == 1
+        finally:
+            proxy.close()
+
+    def test_bitflip_corrupts_exactly_one_bit_of_a_body(self, upstream):
+        proxy = self._proxy(upstream, bitflip=1.0, seed=2)
+        try:
+            flipped = 0
+            for _ in range(8):
+                status, _, body = fleet_client.http_exchange(
+                    "POST", proxy.url + "/echo", raw=b"A" * 512,
+                    content_type="application/octet-stream")
+                assert status == 200 and len(body) == 512
+                diff = sum(bin(a ^ b).count("1")
+                           for a, b in zip(b"A" * 512, body))
+                assert diff in (0, 1, 2)  # request flip, response flip, both
+                flipped += 1 if diff else 0
+            assert flipped > 0
+            assert proxy.stats()["bitflip"] > 0
+        finally:
+            proxy.close()
+
+    def test_pool_mounts_one_proxy_per_upstream(self, upstream):
+        pool = ProxyPool(ChaosPlan(seed=1))
+        try:
+            url1 = pool.url_for(upstream)
+            assert url1 == pool.url_for(upstream + "/")  # normalized
+            assert url1 != upstream
+            status, _, _ = fleet_client.http_exchange("GET", url1 + "/")
+            assert status == 200
+            assert set(pool.proxies()) == {upstream}
+            assert pool.stats()["exchanges"] == 1
+        finally:
+            pool.close()
+        # Closed pools pass upstreams through untouched.
+        assert pool.url_for(upstream) == upstream
+
+    def test_pool_prunes_dead_upstreams(self, upstream):
+        """A respawned worker gets a fresh hop via url_for; prune() must
+        close the DEAD port's proxy (listener + accept thread) instead of
+        leaking one per respawn for the fleet's lifetime."""
+        pool = ProxyPool(ChaosPlan(seed=1))
+        try:
+            pool.url_for("http://127.0.0.1:9")  # the "old port" hop
+            live_url = pool.url_for(upstream)
+            dead_proxy = pool.proxies()["http://127.0.0.1:9"]
+            pool.prune([upstream, None])  # None = a mid-boot worker
+            assert set(pool.proxies()) == {upstream}
+            assert dead_proxy._closed
+            dead_proxy._thread.join(timeout=5)
+            assert not dead_proxy._thread.is_alive()
+            # The survivor still relays, and a remount after the prune
+            # takes a FRESH salt — never a pruned proxy's stream.
+            status, _, _ = fleet_client.http_exchange("GET", live_url + "/")
+            assert status == 200
+            pool.url_for("http://127.0.0.1:19")
+            assert pool._created == 3
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# The chaos matrix: every fault class against a REAL 2-worker fleet
+
+
+@pytest.fixture(scope="module")
+def matrix_workers(tmp_path_factory):
+    root = tmp_path_factory.mktemp("chaos-fleet")
+    workers = {}
+    for wid in ("w0", "w1"):
+        srv = GolServer(port=0, journal_dir=str(root / wid), flush_age=0.01)
+        srv.start()
+        workers[wid] = srv
+    yield root, workers
+    for srv in workers.values():
+        srv.shutdown()
+
+
+_MATRIX_PLANS = {
+    "latency": "seed=101,latency=0.3,latency_ms=30",
+    "refuse": "seed=102,refuse=0.2",
+    "reset": "seed=103,reset=0.2",
+    "slowloris": "seed=104,slowloris=0.3,slow_ms=2,slow_chunk=128",
+    "truncate": "seed=105,truncate=0.2",
+    "bitflip": "seed=106,bitflip=0.25",
+}
+
+
+class TestChaosMatrix:
+    """Each fault class runs real jobs through a real router+2 workers with
+    the chaos proxy on the data path and breakers armed. The contract per
+    class: every ACCEPTED job ends DONE exactly once (journal audit), every
+    collected result is oracle-byte-identical, ambiguous outcomes surface
+    as the documented 504 (with the worker named), and the injected fault
+    class actually fired (proxy stats) — never a hang, a double-run, or a
+    silently wrong board."""
+
+    GENS = 6
+    JOBS = 8
+
+    def _rig(self, tmp_path, workers, plan_spec):
+        fleet = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            fleet.attach(srv.url, wid)
+        pool = ProxyPool(ChaosPlan.parse(plan_spec))
+        router = RouterServer(
+            fleet, port=0, breakers=True,
+            breaker_config=BreakerConfig(fail_threshold=3, cooldown_s=0.2),
+            chaos=pool,
+        )
+        router.start()
+        return router, pool
+
+    def _boards(self, fault):
+        seed0 = 7000 + 100 * sorted(_MATRIX_PLANS).index(fault)
+        return [text_grid.generate(32, 32, seed=seed0 + i)
+                for i in range(self.JOBS)]
+
+    def _robust(self, fn, tries=200, pause=0.05, retryable=()):
+        last = None
+        for _ in range(tries):
+            try:
+                return fn()
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    wire.WireError, *retryable) as err:
+                last = err
+                time.sleep(pause)
+        raise AssertionError(f"never recovered: {last!r}")
+
+    def _reachable(self, base, job_id):
+        """True when the id answers a state at least once — the check that
+        catches a bit-flipped 202 body (garbled id): the job exists under
+        its TRUE id on the worker, but THIS id 404s forever."""
+        for _ in range(20):
+            try:
+                status, payload = _http("GET", f"{base}/jobs/{job_id}")
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.05)
+                continue
+            if status == 404:
+                return False
+            if isinstance(payload, dict) and payload.get("state"):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def _submit_one(self, base, board, packed, ambiguous):
+        """Submit with the documented client stance: spills/refusals retry,
+        ambiguous 504s are counted and knowingly resubmitted (a fresh id),
+        CRC 400s re-send (a corrupted frame created no job), and a
+        corrupted 202 body (garbled/torn id) is detected by the id never
+        answering — resubmit; the orphan still lands exactly one done
+        record under its true id."""
+        meta = {"gen_limit": self.GENS}
+
+        def post():
+            if packed:
+                frame = wire.encode_frame(meta, grid=board)
+                return fleet_client.http_json(
+                    "POST", f"{base}/jobs", raw=frame,
+                    content_type=wire.CONTENT_TYPE)
+            return _http("POST", f"{base}/jobs", {
+                "width": 32, "height": 32,
+                "cells": text_grid.encode(board).decode("ascii"), **meta,
+            })
+
+        for _ in range(60):
+            try:
+                status, payload = post()
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.05)
+                continue
+            if status == 202:
+                job_id = (payload.get("id")
+                          if isinstance(payload, dict) else None)
+                if job_id and self._reachable(base, job_id):
+                    return job_id
+                ambiguous.append(payload)  # corrupted 202 body: resubmit
+                time.sleep(0.05)
+                continue
+            if status == 504:
+                # The documented ambiguity contract: the body names the
+                # worker whose outcome is unknown; the client resubmits
+                # KNOWINGLY (fresh id — never a double-run of the old id).
+                assert "worker" in payload, payload
+                ambiguous.append(payload)
+                time.sleep(0.05)
+                continue
+            if status in (400, 503):
+                # 400 here is the CRC gate catching a flipped frame (no
+                # job was created: a re-send is unconditionally safe);
+                # 503 is both workers momentarily refused.
+                if status == 400:
+                    assert "crc" in str(payload.get("error", "")).lower(), \
+                        payload
+                time.sleep(0.05)
+                continue
+            raise AssertionError(f"unexpected submit answer {status}: "
+                                 f"{payload}")
+        raise AssertionError("submit never landed")
+
+    @pytest.mark.parametrize("fault", sorted(_MATRIX_PLANS))
+    def test_fault_class(self, fault, tmp_path, matrix_workers):
+        root, workers = matrix_workers
+        router, pool = self._rig(tmp_path, workers, _MATRIX_PLANS[fault])
+        packed = fault == "bitflip"  # the CRC-gated lane end to end
+        boards = self._boards(fault)
+        ambiguous: list = []
+        try:
+            base = router.url
+            accepted = {}
+            for board in boards:
+                job_id = self._submit_one(base, board, packed, ambiguous)
+                accepted[job_id] = board
+
+            def state_of(job_id):
+                status, payload = _http("GET", f"{base}/jobs/{job_id}")
+                if status >= 500:
+                    raise ConnectionError(f"transient {status}")
+                return payload.get("state") if isinstance(payload, dict) \
+                    else None
+
+            def terminal(job_id):
+                # A bit-flipped poll answer parses to garbage: treat any
+                # non-terminal/garbled state as "ask again" — the NEXT
+                # poll answers truthfully (faults never touch the job).
+                state = state_of(job_id)
+                if state not in ("done", "failed", "cancelled"):
+                    raise ConnectionError(f"not terminal yet: {state}")
+                return state
+
+            for job_id in accepted:
+                state = self._robust(lambda j=job_id: terminal(j),
+                                     tries=600)
+                assert state == "done", (fault, job_id, state)
+
+            for job_id, board in accepted.items():
+                if packed:
+                    def fetch(j=job_id):
+                        status, ctype, body = fleet_client.http_exchange(
+                            "GET", f"{base}/result/{j}",
+                            headers={"Accept": wire.CONTENT_TYPE})
+                        if status >= 500:
+                            raise ConnectionError(f"transient {status}")
+                        assert status == 200
+                        assert wire.is_packed(ctype)
+                        frame = wire.decode_frame(body)  # CRC gate HERE
+                        return dict(frame.meta), frame.grid()
+                    result, got = self._robust(fetch)
+                else:
+                    def fetch(j=job_id):
+                        status, payload = _http("GET", f"{base}/result/{j}")
+                        if status >= 500:
+                            raise ConnectionError(f"transient {status}")
+                        assert status == 200, payload
+                        grid = text_grid.decode(
+                            payload["grid"].encode("ascii"),
+                            payload["width"], payload["height"])
+                        return payload, grid
+                    result, got = self._robust(fetch)
+                want = oracle.run(board, GameConfig(gen_limit=self.GENS))
+                np.testing.assert_array_equal(np.asarray(got), want.grid)
+                assert result["generations"] == want.generations
+
+            # The schedule actually fired: an idle proxy proves nothing.
+            stats = pool.stats()
+            assert stats.get(fault, 0) > 0, stats
+        finally:
+            router.shutdown(cascade=False)
+
+        # Fleet-wide exactly-once: every accepted id holds EXACTLY one
+        # done record across both partitions' journals (flush is async;
+        # poll briefly).
+        def audit():
+            done: dict = {}
+            for wid in workers:
+                path = root / wid / "journal.jsonl"
+                if not path.exists():
+                    continue
+                for line in path.read_bytes().split(b"\n"):
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "done":
+                        done.setdefault(rec["id"], []).append(wid)
+            return done
+
+        assert _wait(lambda: set(accepted) <= set(audit()), timeout=20)
+        done = audit()
+        for job_id in accepted:
+            assert len(done[job_id]) == 1, (fault, job_id, done[job_id])
+
+
+# ---------------------------------------------------------------------------
+# The serve-side retry budget rides the scheduler
+
+
+class TestSchedulerRetryBudget:
+    def test_budget_exhaustion_degrades_to_first_attempt(self, tmp_path):
+        """With an empty bucket a failing batch surfaces its ORIGINAL
+        error after one attempt instead of the policy's full ladder."""
+        from gol_tpu.serve.jobs import new_job
+        from gol_tpu.serve.scheduler import Scheduler
+
+        calls = []
+
+        def run_batch(key, jobs):
+            calls.append(len(jobs))
+            raise RuntimeError("injected transient brownout")
+
+        clock = _Clock()
+        budget = RetryBudget(capacity=1.0, refill_per_s=0.0, clock=clock)
+        sched = Scheduler(run_batch=run_batch, flush_age=0.01,
+                          retry_budget=budget,
+                          retryable=lambda e: True)
+        sched.start()
+        try:
+            board = text_grid.generate(32, 32, seed=8)
+            job = new_job(32, 32, board, gen_limit=4)
+            sched.submit(job)
+            assert _wait(lambda: job.state == "failed", timeout=30)
+            # attempt 1 + the single budgeted retry = 2 dispatches, and
+            # the surfaced error is the batch's own.
+            assert len(calls) == 2
+            assert "injected transient" in job.error
+        finally:
+            sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# The submit client's CRC-failure bound: never-a-hang under a hop that
+# corrupts every result frame
+
+
+class TestSubmitWireFailureBound:
+    def test_persistent_crc_failure_gives_up_instead_of_polling_forever(
+            self, tmp_path, capsys, monkeypatch):
+        """Status polls answer 200 (refreshing last_contact), so the
+        no-contact cutoff can never fire for a job whose RESULT frame
+        deterministically fails CRC — a corruptor parked on the hop, or a
+        worker emitting bad frames. The sweep bound turns what was an
+        infinite --wait loop into rc 1 with the job named."""
+        import argparse
+
+        from gol_tpu import cli
+        from gol_tpu.io.wire import WireError
+
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=9)
+            status, payload = _http(
+                "POST", f"{srv.url}/jobs",
+                {"width": 32, "height": 32,
+                 "cells": text_grid.encode(board).decode("ascii"),
+                 "gen_limit": 4},
+            )
+            assert status == 202
+
+            fetches = []
+
+            def corrupt_fetch(base, job_id, wire_pref):
+                fetches.append(job_id)
+                raise WireError("payload CRC mismatch")
+
+            monkeypatch.setattr(cli, "_fetch_result", corrupt_fetch)
+            pending = {payload["id"]: (str(tmp_path / "in.txt"), srv.url)}
+            args = argparse.Namespace(poll_interval=0.02, server_timeout=30.0,
+                                      wire="packed")
+            rc = cli._collect_results(pending, args, str(tmp_path))
+            assert rc == 1
+            err = capsys.readouterr().err
+            assert "unusable response body" in err and payload["id"] in err
+            # 3 sweeps x the policy's in-sweep retries — bounded, not one
+            # sweep (a transit flip must still heal on refetch).
+            assert 3 <= len(fetches) <= 9
+        finally:
+            srv.shutdown()
+
+    def test_garbled_status_poll_bounded_not_a_crash(self, tmp_path,
+                                                     capsys, monkeypatch):
+        """The text lane's version of the same hazard: a bit-flipped hop
+        garbling a 200 status body used to escape the collection loop as
+        a KeyError traceback, abandoning EVERY pending job. The fleet
+        client's _parse turns an unparseable body into an {"error": ...}
+        dict (it never raises), so EVERY corrupted status poll arrives
+        here as 200-with-no-state — now a bounded strike-out."""
+        import argparse
+
+        from gol_tpu import cli
+
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(32, 32, seed=10)
+            status, payload = _http(
+                "POST", f"{srv.url}/jobs",
+                {"width": 32, "height": 32,
+                 "cells": text_grid.encode(board).decode("ascii"),
+                 "gen_limit": 4},
+            )
+            assert status == 202
+            calls = []
+
+            def garbled(method, url, body=None, timeout=30, **kw):
+                calls.append(url)
+                # What fleet_client.http_json ACTUALLY returns for a 200
+                # whose body no longer parses as JSON (_parse never
+                # raises): a dict that is not a job answer.
+                return 200, {"error": "\x7fgarbled\x01body"}
+
+            monkeypatch.setattr(cli, "_http_json", garbled)
+            pending = {payload["id"]: (str(tmp_path / "in.txt"), srv.url)}
+            args = argparse.Namespace(poll_interval=0.02,
+                                      server_timeout=30.0)
+            rc = cli._collect_results(pending, args, str(tmp_path))
+            assert rc == 1
+            err = capsys.readouterr().err
+            assert "unusable response body" in err and payload["id"] in err
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Submit-side corruption contracts: the 202 ack and the packed CRC 400
+
+
+class TestSubmitCorruptionContracts:
+    def _board_file(self, tmp_path):
+        board = text_grid.generate(32, 32, seed=11)
+        path = tmp_path / "in.txt"
+        path.write_bytes(text_grid.encode(board))
+        return str(path)
+
+    def test_corrupted_202_ack_abandons_loudly_not_a_crash(
+            self, tmp_path, capsys, monkeypatch):
+        """A 202 whose ack body was garbled in transit has no id to poll
+        — and the job WAS accepted, so a resend would double-run the
+        board. The client must abandon loudly (the ambiguous-504
+        contract), not die on a KeyError traceback."""
+        from gol_tpu import cli
+
+        def garbled_ack(method, url, body=None, timeout=30, **kw):
+            return 202, {"error": "\x7fgarbled ack"}
+
+        monkeypatch.setattr(cli, "_http_json", garbled_ack)
+        rc = cli.main([
+            "submit", "--server", "http://t.invalid", "--no-wait",
+            "32", "32", self._board_file(tmp_path),
+        ])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "ack body arrived corrupted" in err
+        assert "audit the server" in err
+
+    def test_crc_400_resends_packed_never_downgrades_to_text(
+            self, tmp_path, capsys, monkeypatch):
+        """A CRC-mismatch 400 is the packed wire WORKING on a lossy hop
+        (the 400 created no job; a resend is safe) — it must be resent
+        PACKED, not misread as format rejection: downgrading to text on
+        exactly the link that corrupts would swap detected corruption
+        for the text lane's undetectable kind."""
+        from gol_tpu import cli
+        from gol_tpu.io import wire
+
+        calls = []
+
+        def flaky_hop(method, url, body=None, timeout=30, **kw):
+            calls.append(kw.get("content_type"))
+            if len(calls) == 1:
+                return 400, {"error": "payload CRC mismatch "
+                                      "(got 0x1, want 0x2)"}
+            return 202, {"id": "j9", "state": "queued"}
+
+        monkeypatch.setattr(cli, "_http_json", flaky_hop)
+        rc = cli.main([
+            "submit", "--server", "http://t.invalid", "--no-wait",
+            "--wire", "packed", "32", "32", self._board_file(tmp_path),
+        ])
+        assert rc == 0
+        # BOTH attempts went out packed: no downgrade happened.
+        assert calls == [wire.CONTENT_TYPE, wire.CONTENT_TYPE]
+        err = capsys.readouterr().err
+        assert "resending packed (1/2)" in err
+        assert "does not accept the packed wire format" not in err
+
+    def test_persistent_crc_400_surfaces_the_400_still_packed(
+            self, tmp_path, capsys, monkeypatch):
+        """A hop corrupting EVERY frame: two bounded packed resends, then
+        the 400 surfaces loudly (rc 1) — never a silent text downgrade,
+        never an unbounded loop."""
+        from gol_tpu import cli
+        from gol_tpu.io import wire
+
+        calls = []
+
+        def dead_hop(method, url, body=None, timeout=30, **kw):
+            calls.append(kw.get("content_type"))
+            return 400, {"error": "payload CRC mismatch"}
+
+        monkeypatch.setattr(cli, "_http_json", dead_hop)
+        rc = cli.main([
+            "submit", "--server", "http://t.invalid", "--no-wait",
+            "--wire", "packed", "32", "32", self._board_file(tmp_path),
+        ])
+        assert rc == 1
+        assert calls == [wire.CONTENT_TYPE] * 3  # initial + 2 resends
+        err = capsys.readouterr().err
+        assert "HTTP 400" in err
+        assert "does not accept the packed wire format" not in err
+
+
+class TestBreakerPruning:
+    def test_prune_drops_retired_workers_breaker_and_gauge(self, tmp_path):
+        """The chaos-proxy prune's sibling: a retired worker's breaker
+        (and its state gauge) must leave with its membership row —
+        scale-up reuses the lowest free partition id, so a stale OPEN
+        breaker would be inherited by brand-new capacity."""
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://wa.invalid", "wa")
+        router = RouterServer(fleet, port=0, breakers=True)
+        try:
+            live = router.breaker("wa")
+            gone = router.breaker("retired")
+            for _ in range(3):
+                live.on_failure()  # live history survives the prune
+                gone.on_failure()
+            assert router.breaker_states() == {"wa": OPEN, "retired": OPEN}
+            router.prune_breakers()
+            assert router.breaker_states() == {"wa": OPEN}
+            gauges = router.registry.snapshot()["gauges"]
+            assert "breaker_state_retired" not in gauges
+            assert gauges["breaker_state_wa"] == 2  # open
+            # The same id re-learned later starts FRESH.
+            assert router.breaker("retired").state == CLOSED
+        finally:
+            router.httpd.server_close()
+
+
+class TestStrikesAreConsecutive:
+    def test_intermittent_garbled_polls_never_strike_out_a_long_job(
+            self, tmp_path, capsys, monkeypatch):
+        """The strike bound is on CONSECUTIVE corrupt sweeps: a long job
+        under a low-rate bitflip hop sees garbled status bodies
+        interleaved with good ones for its whole runtime, and the old
+        lifetime-cumulative counter abandoned it after 3 independent,
+        self-healed flips. A usable answer must clear the strikes."""
+        import argparse
+
+        from gol_tpu import cli
+
+        polls = {"n": 0}
+        # Garbled/usable alternating for 10 sweeps (5 garbled answers —
+        # past the old lifetime bound), then done.
+        def hop(method, url, body=None, timeout=30, **kw):
+            if "/timeline" in url:
+                return 200, {}
+            polls["n"] += 1
+            if polls["n"] > 10:
+                return 200, {"state": "done"}
+            if polls["n"] % 2:
+                return 200, {"error": "\x7fgarbled"}
+            return 200, {"state": "running"}
+
+        board = text_grid.generate(32, 32, seed=12)
+
+        def fetch(base, job_id, wire_pref):
+            return 200, {"generations": 1, "exit_reason": "gen_limit"}, board
+
+        monkeypatch.setattr(cli, "_http_json", hop)
+        monkeypatch.setattr(cli, "_fetch_result", fetch)
+        pending = {"j1": (str(tmp_path / "in.txt"), "http://t.invalid")}
+        args = argparse.Namespace(poll_interval=0.01, server_timeout=30.0)
+        rc = cli._collect_results(pending, args, str(tmp_path))
+        assert rc == 0
+        assert "unusable response body" not in capsys.readouterr().err
+        assert (tmp_path / "in.txt.out").exists()
+
+    def test_result_meta_missing_key_is_bounded_not_a_keyerror(
+            self, tmp_path, capsys, monkeypatch):
+        """A flip can eat a meta KEY and leave valid JSON + a decodable
+        grid ('generations' -> 'genersations'): the result print used to
+        die on an uncaught KeyError, abandoning every pending job. Now
+        the suspect body is refetched on the same bounded strike-out —
+        and never written to disk."""
+        import argparse
+
+        from gol_tpu import cli
+
+        def hop(method, url, body=None, timeout=30, **kw):
+            return 200, {"state": "done"}
+
+        board = text_grid.generate(32, 32, seed=13)
+
+        def fetch_missing_key(base, job_id, wire_pref):
+            return 200, {"exit_reason": "gen_limit",
+                         "genersations": 1}, board
+
+        monkeypatch.setattr(cli, "_http_json", hop)
+        monkeypatch.setattr(cli, "_fetch_result", fetch_missing_key)
+        pending = {"j1": (str(tmp_path / "in.txt"), "http://t.invalid")}
+        args = argparse.Namespace(poll_interval=0.01, server_timeout=30.0)
+        rc = cli._collect_results(pending, args, str(tmp_path))
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "unusable response body" in err
+        assert "result meta incomplete" in err
+        assert not (tmp_path / "in.txt.out").exists()
+
+
+class TestDeadlineRestampOnCrcRetry:
+    def test_crc_retry_restamps_the_remaining_budget(self, tmp_path):
+        """The router's CRC re-forward must re-derive X-Gol-Deadline: the
+        first (corrupted, slow) attempt already spent budget, and
+        resending the original header would hand the worker time the
+        client no longer has."""
+        body = json.dumps({"width": 32, "height": 32}).encode()
+        seen = []
+
+        def stub_http(method, url, body=None, raw=None, timeout=0,
+                      headers=None, **kw):
+            seen.append((dict(headers or {}), timeout))
+            if len(seen) == 1:
+                return 400, {"error": "payload CRC mismatch"}
+            return 202, {"id": "j1", "state": "queued"}
+
+        fleet = Fleet(str(tmp_path / "fleet"), probe=lambda *a, **k: None)
+        fleet.attach("http://wa.invalid", "wa")
+        router = RouterServer(fleet, port=0, http=stub_http)
+        try:
+            status, payload = router.route_submit(
+                body, deadline_header="60.0"
+            )
+            assert status == 202
+            assert len(seen) == 2
+            first = float(seen[0][0][propagate.DEADLINE_HEADER])
+            second = float(seen[1][0][propagate.DEADLINE_HEADER])
+            # Both stamped, and the retry's stamp is derived FRESH (the
+            # walk's elapsed time only ever shrinks the budget).
+            assert 0 < second <= first <= 60.0
+        finally:
+            router.httpd.server_close()
+
+
+class TestJitteredDeadlineGuard:
+    def test_up_jittered_pause_never_overruns_the_deadline(self):
+        """The deadline guard tests the ACTUAL jittered pause: with rng
+        pinned high, a nominal delay that fits but jitters past the
+        deadline must refuse the retry instead of sleeping through it."""
+        clock = _Clock()
+        sleeps = []
+
+        def fail():
+            raise ConnectionResetError("connection reset by peer")
+
+        policy = RetryPolicy(attempts=5, base_delay=0.9, multiplier=1.0,
+                             jitter=0.25, deadline=1.0)
+        with pytest.raises(ConnectionResetError):
+            # 0 + 0.9*1.25 = 1.125 > 1.0: no retry taken, no sleep.
+            policy.call(fail, sleep=sleeps.append, clock=clock,
+                        rng=lambda: 1.0)
+        assert sleeps == []
+        # Down-jittered, the same nominal delay fits: 0.9*0.75 = 0.675.
+        with pytest.raises(ConnectionResetError):
+            policy.call(fail, sleep=sleeps.append, clock=clock,
+                        rng=lambda: 0.0)
+        assert len(sleeps) >= 1 and sleeps[0] == pytest.approx(0.675)

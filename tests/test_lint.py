@@ -149,6 +149,21 @@ def test_no_wall_clock_in_cache():
         )
 
 
+def test_no_wall_clock_in_chaos():
+    """Same rule for gol_tpu/chaos/: a ChaosPlan's injected delays and the
+    proxy's per-exchange timing sit INSIDE the latency measurements every
+    defense (breaker slow-call windows, deadline budgets) is judged by —
+    a stepped wall clock there would skew the very fault the test meant
+    to inject. ``time.perf_counter``/``time.sleep`` only."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "chaos", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/chaos/ (use "
+            f"time.perf_counter()/time.sleep() for every injected "
+            f"delay): {offenders}"
+        )
+
+
 def test_bit_packing_only_in_bitpack():
     """``np.packbits``/``np.unpackbits`` are banned everywhere in gol_tpu/
     except ``io/bitpack.py`` — the ONE copy of the bit-order rule ("bit j

@@ -1,0 +1,427 @@
+"""Chaos smoke: a real fleet under seeded network faults + a SIGKILL.
+
+The `make chaos-smoke` harness — the PR-14 acceptance run against real OS
+processes, with the chaos proxy armed on the router->worker data path:
+
+1. boot ``gol fleet --workers 2 --chaos PLAN`` (seeded plan mixing
+   connection resets, added latency, and GOLP frame corruption) with
+   breakers on (the CLI default), a 1s breaker cooldown, and a retry
+   budget on the workers' dispatch path;
+2. submit N jobs as PACKED wire frames through the router (the CRC-gated
+   lane: a frame the chaos hop corrupts is caught, never run wrong),
+   tolerating the documented fault contracts — ambiguous 504s (resubmit
+   knowingly), CRC 400s (re-send; no job was created), and corrupted 202
+   bodies (an id that never answers is a torn response, not a lost job);
+3. SIGKILL one worker that accepted work MID-LOAD, then keep submitting:
+   the router's forwards to the dead worker must trip its breaker OPEN
+   (observed via /fleet), the health loop respawns the worker on the same
+   partition, and a half-open probe must re-CLOSE the breaker;
+4. wait until every accepted job reports DONE through the router (the
+   victim's partition replays; chaos keeps injecting the whole time);
+5. fetch a sample of results as packed frames (CRC re-verified client
+   side) and compare byte-identically against the NumPy oracle;
+6. SIGTERM the fleet (graceful cascade, rc 0), then audit:
+   - every accepted id holds EXACTLY one done record across both
+     partition journals (none lost, none double-run);
+   - the durable breaker ring (``<fleet-dir>/breaker-history``) recorded
+     the victim's open AND the re-close — the decision trail an operator
+     replays after the fact.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/chaos_smoke.py [--jobs 60] [--gen-limit 200]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu import oracle  # noqa: E402
+from gol_tpu.config import GameConfig  # noqa: E402
+from gol_tpu.fleet import client as fleet_client  # noqa: E402
+from gol_tpu.io import text_grid, wire  # noqa: E402
+from gol_tpu.obs import history as obs_history  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Seeded mix: resets (the ambiguous class), latency (the breaker's
+# slow-call signal), and frame corruption (the CRC gate's class) — every
+# leg of the defense exercised at once, deterministically.
+CHAOS_PLAN = "seed=42,reset=0.02,latency=0.15,latency_ms=25,bitflip=0.02"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    try:
+        return fleet_client.http_json(method, url, body, timeout=timeout)
+    except ConnectionError as e:
+        # Normalized torn-HTTP (fleet/client.py): callers here treat it
+        # like any other connection trouble.
+        raise urllib.error.URLError(str(e)) from e
+
+
+def _start_fleet(port: int, fleet_dir: str):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "fleet",
+            "--port", str(port),
+            "--workers", "2",
+            "--fleet-dir", fleet_dir,
+            "--flush-age", "0.05",
+            # A wide-ish tick: the supervisor SEES direct probes only, so
+            # the window between a kill and its detection is where the
+            # BREAKER (which sees the data path) must carry the defense —
+            # exactly the brownout shape health checks miss.
+            "--health-interval", "2.0",
+            "--chaos", CHAOS_PLAN,
+            "--breaker-cooldown", "1.0",
+            "--retry-budget", "50",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.perf_counter() + 300
+    base = f"http://127.0.0.1:{port}"
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(
+                f"fleet died on boot rc={proc.returncode}:\n{out[-4000:]}"
+            )
+        try:
+            status, payload = _http("GET", f"{base}/healthz", timeout=2)
+            if status == 200 and payload.get("fleet", {}).get("workers") == 2:
+                return proc
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("fleet did not become healthy within 300s")
+
+
+def _fleet_json(base: str) -> dict:
+    status, payload = _http("GET", f"{base}/fleet")
+    if status != 200 or not isinstance(payload, dict):
+        raise RuntimeError(f"GET /fleet -> {status}: {payload}")
+    return payload
+
+
+def _job_state(base: str, job_id: str):
+    """The job's state, or None for 'ask again' (transient 5xx, a
+    bit-flipped poll body, a respawn window)."""
+    try:
+        status, payload = _http("GET", f"{base}/jobs/{job_id}", timeout=10)
+    except (urllib.error.URLError, OSError):
+        return None
+    if status == 404:
+        return "unknown"
+    if status != 200 or not isinstance(payload, dict):
+        return None
+    return payload.get("state")
+
+
+def _id_answers(base: str, job_id: str, tries: int = 20) -> bool:
+    """A 202 body the chaos hop corrupted carries a garbled id: the job
+    exists under its TRUE id on the worker, but THIS id 404s forever —
+    detect it so the submit loop can resubmit knowingly."""
+    for _ in range(tries):
+        state = _job_state(base, job_id)
+        if state == "unknown":
+            return False
+        if state:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _submit_packed(base: str, board, gen_limit: int, anomalies: dict):
+    """One board -> one ACCEPTED, answering job id, riding out every
+    documented fault contract on the way."""
+    frame = wire.encode_frame({"gen_limit": gen_limit}, grid=board)
+    for _ in range(80):
+        try:
+            status, payload = fleet_client.http_json(
+                "POST", f"{base}/jobs", raw=frame,
+                content_type=wire.CONTENT_TYPE, timeout=30,
+            )
+        except (urllib.error.URLError, ConnectionError, OSError):
+            anomalies["transport"] = anomalies.get("transport", 0) + 1
+            time.sleep(0.1)
+            continue
+        if status == 202 and isinstance(payload, dict):
+            job_id = payload.get("id")
+            if job_id and _id_answers(base, job_id):
+                return job_id
+            anomalies["garbled_202"] = anomalies.get("garbled_202", 0) + 1
+            time.sleep(0.1)
+            continue
+        if status == 504:
+            # Ambiguous outcome: the body names the worker whose outcome
+            # is unknown; resubmit knowingly (fresh id).
+            who = payload.get("worker") if isinstance(payload, dict) else None
+            anomalies.setdefault("ambiguous_504", []).append(who)
+            time.sleep(0.1)
+            continue
+        if status in (400, 503, 429):
+            # 400: the CRC gate caught a flipped frame (no job created);
+            # 503/429: momentary spill/shed exhaustion. All re-send safe.
+            anomalies[f"http_{status}"] = anomalies.get(f"http_{status}",
+                                                        0) + 1
+            time.sleep(0.1)
+            continue
+        raise RuntimeError(f"unexpected submit answer {status}: {payload}")
+    raise RuntimeError("a submit never landed after 80 tries")
+
+
+def _fetch_result_packed(base: str, job_id: str, tries: int = 80):
+    """(meta, grid) through the chaos hop: WireError = corrupted in
+    transit -> refetch (the frame on the worker is intact)."""
+    for _ in range(tries):
+        try:
+            status, ctype, body = fleet_client.http_exchange(
+                "GET", f"{base}/result/{job_id}",
+                headers={"Accept": wire.CONTENT_TYPE}, timeout=30,
+            )
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.1)
+            continue
+        if status >= 500:
+            time.sleep(0.1)
+            continue
+        if status != 200:
+            raise RuntimeError(f"result {job_id} HTTP {status}")
+        if not wire.is_packed(ctype):
+            raise RuntimeError(f"result {job_id} not packed ({ctype})")
+        try:
+            frame = wire.decode_frame(body)
+        except wire.WireError:
+            time.sleep(0.05)
+            continue
+        return dict(frame.meta), frame.grid()
+    raise RuntimeError(f"result {job_id} never fetched clean")
+
+
+def _count_done(fleet_dir: str) -> dict:
+    done: dict = {}
+    for name in sorted(os.listdir(fleet_dir)):
+        path = os.path.join(fleet_dir, name, "journal.jsonl")
+        if not os.path.isfile(path):
+            continue
+        with open(path, "rb") as f:
+            for line in f.read().split(b"\n"):
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "done":
+                    done.setdefault(rec["id"], []).append(name)
+    return done
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=60)
+    parser.add_argument("--gen-limit", type=int, default=200)
+    parser.add_argument("--sample", type=int, default=20,
+                        help="results to oracle-verify (packed, CRC-gated)")
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-chaos-smoke-")
+    fleet_dir = os.path.join(workdir, "fleet")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    cfg = GameConfig(gen_limit=args.gen_limit)
+    sides = (32, 64)
+
+    rc = 1
+    proc = None
+    try:
+        proc = _start_fleet(port, fleet_dir)
+        print(f"chaos-smoke: 2-worker fleet up on {base} with chaos ARMED "
+              f"({CHAOS_PLAN})")
+
+        anomalies: dict = {}
+        accepted = {}  # id -> board
+        boards = [text_grid.generate(sides[i % 2], sides[i % 2],
+                                     seed=3000 + i)
+                  for i in range(args.jobs)]
+        half = args.jobs // 2
+        for i in range(half):
+            accepted[_submit_packed(base, boards[i], args.gen_limit,
+                                    anomalies)] = boards[i]
+        print(f"chaos-smoke: {half} jobs in through the faulty hop "
+              f"(anomalies so far: {anomalies or 'none'})")
+
+        # SIGKILL a worker that is holding work, mid-load.
+        workers = _fleet_json(base)["workers"]
+        victim = workers[0]
+        print(f"chaos-smoke: SIGKILL worker {victim['id']} "
+              f"(pid {victim['pid']}) mid-load")
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        # A fast fire-and-forget burst at BOTH buckets: forwards that land
+        # on the dead worker (its bucket still ranks it first — the health
+        # tick has not flagged it yet) must trip its breaker OPEN. This is
+        # the breaker's whole reason to exist: the DATA path notices the
+        # failure attempts-faster than the supervisor's direct probe tick.
+        burst = [text_grid.generate(s, s, seed=5000 + j)
+                 for j, s in enumerate((32, 64, 32, 64))]
+        saw_open = False
+        deadline = time.perf_counter() + 120
+        while time.perf_counter() < deadline and not saw_open:
+            for b in burst:
+                frame = wire.encode_frame({"gen_limit": 4}, grid=b)
+                try:
+                    fleet_client.http_json(
+                        "POST", f"{base}/jobs", raw=frame,
+                        content_type=wire.CONTENT_TYPE, timeout=10)
+                except (urllib.error.URLError, ConnectionError, OSError):
+                    pass  # the dead hop answering with an RST: expected
+            try:
+                states = _fleet_json(base).get("breakers") or {}
+            except (RuntimeError, urllib.error.URLError, OSError):
+                states = {}
+            if states.get(victim["id"]) in ("open", "half-open"):
+                saw_open = True
+        if not saw_open:
+            print("chaos-smoke: breaker never opened for the killed worker")
+            return 1
+        print(f"chaos-smoke: breaker OPEN observed for {victim['id']}")
+
+        # Finish the load while the respawn + half-open probe re-close it.
+        i = half
+        while i < args.jobs:
+            accepted[_submit_packed(base, boards[i], args.gen_limit,
+                                    anomalies)] = boards[i]
+            i += 1
+        deadline = time.perf_counter() + 300
+        while time.perf_counter() < deadline:
+            try:
+                states = _fleet_json(base).get("breakers") or {}
+            except (RuntimeError, urllib.error.URLError, OSError):
+                states = {}
+            if states.get(victim["id"]) == "closed":
+                break
+            # A trickle of probes across BOTH buckets (the victim owns
+            # only one of them): ranked attempts are what half-open turns
+            # into recovery.
+            for b in burst[:2]:
+                _submit_packed(base, b, 4, anomalies)
+            time.sleep(0.25)
+        else:
+            print("chaos-smoke: breaker never re-closed after the respawn")
+            return 1
+        print(f"chaos-smoke: breaker re-CLOSED for {victim['id']} "
+              f"after respawn")
+
+        # Every accepted job -> DONE, through replay + injected faults.
+        deadline = time.perf_counter() + 600
+        pending = set(accepted)
+        while pending and time.perf_counter() < deadline:
+            for job_id in list(pending):
+                state = _job_state(base, job_id)
+                if state == "done":
+                    pending.discard(job_id)
+                elif state in ("failed", "cancelled", "unknown"):
+                    print(f"chaos-smoke: job {job_id} ended {state}")
+                    return 1
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            print(f"chaos-smoke: {len(pending)} job(s) never completed")
+            return 1
+        print(f"chaos-smoke: all {len(accepted)} accepted jobs DONE "
+              f"(anomalies ridden out: {anomalies or 'none'})")
+
+        # Sampled results: packed fetch, client-side CRC, oracle-identical.
+        sample = list(accepted.items())[:: max(
+            1, len(accepted) // max(1, args.sample))][:args.sample]
+        for job_id, board in sample:
+            meta, got = _fetch_result_packed(base, job_id)
+            want = oracle.run(board, cfg)
+            if (not np.array_equal(np.asarray(got), want.grid)
+                    or meta.get("generations") != want.generations):
+                print(f"chaos-smoke: result {job_id} diverges from oracle")
+                return 1
+        print(f"chaos-smoke: {len(sample)} sampled results "
+              "oracle-identical through the faulty hop")
+
+        # Graceful cascade out.
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            print("chaos-smoke: fleet ignored SIGTERM")
+            proc.kill()
+            return 1
+        if proc.returncode != 0:
+            print(f"chaos-smoke: fleet exited rc={proc.returncode}:\n"
+                  f"{out[-3000:]}")
+            return 1
+        proc = None
+
+        # The durable breaker ring recorded the open AND the re-close.
+        ring_dir = os.path.join(fleet_dir, "breaker-history")
+        transitions = [r["breaker"] for r
+                       in obs_history.read_records(ring_dir)
+                       if "breaker" in r and "record_kind" not in r]
+        opens = [t for t in transitions if t.get("to") == "open"]
+        closes = [t for t in transitions if t.get("to") == "closed"]
+        if not opens or not closes:
+            print(f"chaos-smoke: breaker ring incomplete: {transitions}")
+            return 1
+        print(f"chaos-smoke: breaker ring recorded {len(opens)} open / "
+              f"{len(closes)} close transition(s)")
+
+        # Fleet-wide exactly-once for every accepted id.
+        done = _count_done(fleet_dir)
+        lost = set(accepted) - set(done)
+        dup = {k: v for k, v in done.items()
+               if k in accepted and len(v) != 1}
+        if lost or dup:
+            print(f"chaos-smoke: lost={lost} duplicated={dup}")
+            return 1
+        print(
+            f"chaos-smoke: PASS — {len(accepted)} jobs exactly-once under "
+            f"{CHAOS_PLAN} + SIGKILL; breakers opened and re-closed in the "
+            "decision ring; sampled results oracle-identical"
+        )
+        rc = 0
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        if rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"chaos-smoke: artifacts kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
