@@ -1599,22 +1599,21 @@ def _bench_autoscale(args) -> int:
         router.shutdown(cascade=True)
 
         # Fleet-wide exactly-once audit across ALL partitions (incl.
-        # retired ones — their journals stay, fully drained).
+        # retired ones — their journals stay, fully drained). Enumerated
+        # via compaction.iter_records (snapshot + sealed segments + live
+        # file): this load writes tens of MB per partition, well past the
+        # rotation threshold, so reading journal.jsonl alone would miss
+        # most of the done records.
+        from gol_tpu.serve import compaction as _compaction
+
         done_records: dict = {}
         for name in sorted(os.listdir(fleet_dir)):
-            path = os.path.join(fleet_dir, name, "journal.jsonl")
-            if not os.path.isfile(path):
+            part = os.path.join(fleet_dir, name)
+            if not os.path.isfile(os.path.join(part, "journal.jsonl")):
                 continue
-            with open(path, "rb") as f:
-                for line in f.read().split(b"\n"):
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    if rec.get("event") == "done":
-                        done_records.setdefault(rec["id"], []).append(name)
+            for rec in _compaction.iter_records(part):
+                if rec.get("event") == "done":
+                    done_records.setdefault(rec["id"], []).append(name)
         lost = accepted - set(done_records)
         dup = {k: v for k, v in done_records.items()
                if k in accepted and len(v) != 1}
@@ -2707,7 +2706,160 @@ def _bench_chaos(args) -> int:
     return 0 if overhead >= 0.97 and goodput >= 0.70 else 1
 
 
+def _bench_storage(args) -> int:
+    """Storage-lifecycle suite (--suite storage) -> BENCH_r17.json.
+
+    Measures what bounding the journal costs the hot path: the same
+    churn load (240 jobs, 64^2 boards, short requests — the serving
+    shape that writes the most journal bytes per unit compute) through a
+    journaled scheduler with (a) the classic unbounded single-file
+    journal and (b) segment rotation + a concurrent compaction ticker
+    (the gol-serve-sampler's idle-time pass, run at bench cadence).
+
+    Acceptance (exit-code gated): compaction-on steady-state throughput
+    >= 0.97x compaction-off, AND the on-lane's durable footprint ends
+    bounded (snapshot + live file; at most one uncompacted segment)
+    while replaying state-identical to the unbounded log. CI gates the
+    throughput leaf via ``--metric lanes.compaction_on.jobs_per_sec``.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from gol_tpu.serve import compaction
+    from gol_tpu.serve.jobs import DONE, FAILED, JobJournal, new_job
+    from gol_tpu.serve.metrics import Metrics
+    from gol_tpu.serve.scheduler import Scheduler
+
+    size, njobs = 64, 240
+    gen_limit = args.gen_limit if args.gen_limit is not None else 4
+    rng = np.random.default_rng(17)
+    boards = [rng.integers(0, 2, size=(size, size), dtype=np.uint8)
+              for _ in range(njobs)]
+    print(
+        f"bench storage: {njobs} jobs of {size}x{size}, "
+        f"gen_limit={gen_limit}, platform={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+
+    def submit_all(scheduler):
+        jobs = [scheduler.submit(
+            new_job(size, size, b, gen_limit=gen_limit)) for b in boards]
+        while any(j.state not in (DONE, FAILED) for j in jobs):
+            time.sleep(0.002)
+        assert all(j.state == DONE for j in jobs)
+        return jobs
+
+    def run_lane(segment_bytes, compact_interval=None):
+        workdir = tempfile.mkdtemp(prefix="gol-bench-storage-")
+        journal = JobJournal(workdir, segment_bytes=segment_bytes)
+        scheduler = Scheduler(journal=journal, metrics=Metrics(),
+                              flush_age=0.01)
+        scheduler.start()
+        stop = threading.Event()
+        compactions = [0]
+
+        def ticker():
+            while not stop.wait(compact_interval):
+                if journal.compact().compacted:
+                    compactions[0] += 1
+
+        t = None
+        if compact_interval is not None:
+            t = threading.Thread(target=ticker, daemon=True)
+            t.start()
+        t0 = time.perf_counter()
+        submit_all(scheduler)
+        elapsed = time.perf_counter() - t0
+        scheduler.stop()
+        if t is not None:
+            stop.set()
+            t.join(timeout=10)
+            if journal.compact().compacted:  # the final idle pass
+                compactions[0] += 1
+        journal.close()
+        state = JobJournal(workdir, segment_bytes=0).replay()
+        result = {
+            "jobs_per_sec": njobs / elapsed,
+            "elapsed_s": elapsed,
+            "journal_bytes_end": journal.bytes_on_disk(),
+            "sealed_segments_end": len(
+                compaction.sealed_segments(workdir)),
+            "compactions": compactions[0],
+            "replayed_results": len(state.results),
+            "replay_torn_lines": state.torn_lines,
+        }
+        shutil.rmtree(workdir, ignore_errors=True)
+        return result
+
+    # Warm the compiled bucket program outside every timer.
+    warm = Scheduler(metrics=Metrics(), flush_age=0.01)
+    warm.start()
+    submit_all(warm)
+    warm.stop()
+
+    repeats = min(args.repeats, 3)
+    lanes = {}
+    for name, seg, interval in (
+        ("compaction_off", 0, None),
+        ("compaction_on", 128 << 10, 0.1),
+    ):
+        best = None
+        for _ in range(repeats):
+            result = run_lane(seg, interval)
+            assert result["replayed_results"] == njobs, result
+            assert result["replay_torn_lines"] == 0, result
+            if best is None or result["jobs_per_sec"] > best["jobs_per_sec"]:
+                best = result
+        lanes[name] = best
+        print(
+            f"  {name:>15}: {best['elapsed_s'] * 1000:8.1f} ms -> "
+            f"{best['jobs_per_sec']:7.1f} jobs/s, journal ends at "
+            f"{best['journal_bytes_end']} bytes "
+            f"({best['sealed_segments_end']} sealed segment(s), "
+            f"{best['compactions']} compaction(s))",
+            file=sys.stderr,
+        )
+
+    ratio = (lanes["compaction_on"]["jobs_per_sec"]
+             / lanes["compaction_off"]["jobs_per_sec"])
+    bounded = (lanes["compaction_on"]["sealed_segments_end"] <= 1
+               and lanes["compaction_on"]["compactions"] >= 1)
+    print(f"  compaction-on/off throughput ratio {ratio:.3f} "
+          f"(acceptance >= 0.97), footprint bounded: {bounded}",
+          file=sys.stderr)
+    payload = {
+        "metric": "storage_compaction_on_over_off",
+        "value": ratio,
+        "unit": "ratio",
+        "vs_baseline": ratio,  # gated at >= 0.97
+        "lanes": lanes,
+        "bounded": bounded,
+        "load": {"jobs": njobs, "grid": f"{size}x{size}",
+                 "gen_limit": gen_limit,
+                 "segment_bytes": 128 << 10},
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r17.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    return 0 if (ratio >= 0.97 and bounded) else 1
+
+
 SUITES = {
+    "storage": (
+        _bench_storage,
+        "storage lifecycle: churn-load throughput with journal "
+        "segmentation + concurrent compaction vs the unbounded journal "
+        "(acceptance: on >= 0.97x off AND the footprint stays bounded; "
+        "CI gates --metric lanes.compaction_on.jobs_per_sec); writes "
+        "BENCH_r17.json",
+    ),
     "autoscale": (
         _bench_autoscale,
         "elastic fleet: a min=1/max=4 autoscaled fleet under a step-load "

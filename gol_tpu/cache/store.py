@@ -47,12 +47,13 @@ import os
 import sys
 import tempfile
 import threading
+import time
 import zlib
 
 import numpy as np
 
 from gol_tpu.io import text_grid
-from gol_tpu.resilience import STAGING_SUFFIX
+from gol_tpu.resilience import STAGING_SUFFIX, fsio
 
 logger = logging.getLogger(__name__)
 
@@ -161,14 +162,30 @@ class DiskCAS:
     torn/corrupt/mismatched entry (the caller's loud-evict counter).
     """
 
-    def __init__(self, directory: str, payload: str = "packed", on_evict=None):
+    def __init__(self, directory: str, payload: str = "packed", on_evict=None,
+                 max_bytes: int | None = None, on_gc_evict=None,
+                 clock=time.perf_counter):
         if payload not in ("packed", "text", "ts"):
             raise ValueError(
                 f"payload must be 'packed', 'text' or 'ts', got {payload!r}"
             )
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
         self.directory = directory
         self.payload = payload
         self.on_evict = on_evict
+        # The byte budget (gol serve --cache-disk-bytes) + the atime-LRU
+        # ledger behind it: perf_counter stamps per fingerprint, taken on
+        # every get/put (the clock is injectable; the wall clock is banned
+        # from this package). None = the PR-9 unbounded tier.
+        self.max_bytes = max_bytes
+        self.on_gc_evict = on_gc_evict  # (fp, bytes) per budget eviction
+        self._clock = clock
+        self._access: dict[str, float] = {}
+        # Reentrant: a put-triggered GC pass holds it end to end (one pass
+        # at a time) while its per-entry removals re-enter for the ledger.
+        self._gc_lock = threading.RLock()
+        self._usage: int | None = None  # lazy: first enforcement scans once
         os.makedirs(directory, exist_ok=True)
 
     # -- paths --------------------------------------------------------------
@@ -230,8 +247,10 @@ class DiskCAS:
         )
         try:
             with os.fdopen(fd, "w", encoding="utf-8") as f:
-                json.dump(meta, f, separators=(",", ":"))
-                f.write("\n")
+                fsio.write_stream(
+                    f, json.dumps(meta, separators=(",", ":")) + "\n",
+                    "cache CAS meta",
+                )
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.meta_path(fp))
@@ -241,6 +260,7 @@ class DiskCAS:
             except OSError:
                 pass
             raise
+        self._note_put(fp)
 
     def _write_packed(self, fp: str, entry: CacheEntry) -> None:
         """The packed sidecar: one wire frame (io/wire.py), staged +
@@ -263,7 +283,7 @@ class DiskCAS:
         )
         try:
             with os.fdopen(fd, "wb") as f:
-                f.write(frame)
+                fsio.write_stream(f, frame, "cache CAS payload")
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.packed_path(fp))
@@ -342,7 +362,88 @@ class DiskCAS:
         except Exception as err:  # noqa: BLE001 - every defect = evict+rerun
             self._evict(fp, f"{type(err).__name__}: {err}")
             return None
+        with self._gc_lock:
+            self._access[fp] = self._clock()  # the atime-LRU ledger
         return entry
+
+    # -- the byte budget (cache/gc.py) --------------------------------------
+
+    def access_ledger(self) -> dict[str, float]:
+        """Fingerprint -> perf_counter last-access stamps (a copy)."""
+        with self._gc_lock:
+            return dict(self._access)
+
+    def usage_bytes(self) -> int:
+        """The store's on-disk footprint (entries + garbage), scanned once
+        and tracked incrementally across puts — the ``cas_bytes`` gauge."""
+        from gol_tpu.cache import gc as cas_gc
+
+        with self._gc_lock:
+            if self._usage is None:
+                entries, _mtimes, orphans = cas_gc.scan(self.directory)
+                self._usage = (sum(entries.values())
+                               + sum(b for _p, b in orphans))
+            return self._usage
+
+    def _entry_bytes(self, fp: str) -> int:
+        total = 0
+        for path in (self.meta_path(fp), self.packed_path(fp)):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+        store = self.store_path(fp)
+        if os.path.isdir(store):
+            for root, _dirs, names in os.walk(store):
+                for name in names:
+                    try:
+                        total += os.path.getsize(os.path.join(root, name))
+                    except OSError:
+                        pass
+        return total
+
+    def _note_put(self, fp: str) -> None:
+        """Post-commit accounting: stamp the ledger, bump the running
+        usage (a re-put of an existing entry overcounts here — harmless,
+        the next GC scan recomputes exactly), enforce the budget."""
+        with self._gc_lock:
+            self._access[fp] = self._clock()
+            if self._usage is not None:
+                self._usage += self._entry_bytes(fp)
+        if self.max_bytes is not None:
+            over = self.usage_bytes() > self.max_bytes
+            if over:
+                self.gc(apply=True)
+
+    def gc(self, budget: int | None = -1, apply: bool = False):
+        """One GC pass over this store (cache/gc.collect): sweep orphans,
+        evict LRU entries to ``budget`` bytes (-1: the store's own
+        ``max_bytes``). Returns the GCReport; ``apply=False`` is dry-run."""
+        from gol_tpu.cache import gc as cas_gc
+
+        if budget == -1:
+            budget = self.max_bytes
+        with self._gc_lock:
+            report = cas_gc.collect(
+                self.directory, budget, access=self.access_ledger(),
+                apply=apply, remove_entry=self.remove,
+                on_evict=self.on_gc_evict,
+            )
+            if apply:
+                self._usage = report.bytes_after
+                for fp in report.evicted:
+                    self._access.pop(fp, None)
+        return report
+
+    def remove(self, fp: str) -> None:
+        """Delete one entry (eviction, not corruption): meta first — the
+        single unlink that makes it invisible — then payloads; leftovers
+        of a crash in between are orphans the next sweep collects."""
+        from gol_tpu.cache import gc as cas_gc
+
+        cas_gc._remove_entry(self.directory, fp)
+        with self._gc_lock:
+            self._access.pop(fp, None)
 
     def _read_ts(self, fp: str, width: int, height: int) -> np.ndarray:
         from gol_tpu.io import bitpack, ts_store
@@ -366,5 +467,8 @@ class DiskCAS:
             import shutil
 
             shutil.rmtree(store, ignore_errors=True)
+        with self._gc_lock:
+            self._access.pop(fp, None)
+            self._usage = None  # rare: let the next enforcement rescan
         if self.on_evict is not None:
             self.on_evict(fp, reason)

@@ -34,11 +34,19 @@ class ResultCache:
         cas_dir: str | None = None,
         metrics=None,
         payload: str = "packed",
+        disk_bytes: int | None = None,
+        guard=None,
     ):
         self.memory = MemoryLRU(memory_entries)
         self.metrics = metrics
+        # The disk-pressure watchdog (resilience/diskguard.DiskGuard) or
+        # None: under pressure the disk tier stops taking WRITES — the
+        # memory tier and every read keep working, and recovery is
+        # automatic when the guard's level clears.
+        self.guard = guard
         self.cas = (
-            DiskCAS(cas_dir, payload=payload, on_evict=self._on_evict)
+            DiskCAS(cas_dir, payload=payload, on_evict=self._on_evict,
+                    max_bytes=disk_bytes, on_gc_evict=self._on_gc_evict)
             if cas_dir else None
         )
 
@@ -48,6 +56,10 @@ class ResultCache:
 
     def _on_evict(self, fp: str, reason: str) -> None:
         self._inc("cache_corrupt_evictions_total")
+
+    def _on_gc_evict(self, fp: str, nbytes: int) -> None:
+        self._inc("cache_gc_evictions_total")
+        self._inc("cache_gc_evicted_bytes_total", nbytes)
 
     def get(self, fp: str) -> tuple[CacheEntry, str] | None:
         """(entry, tier) on a hit — tier is ``memory`` or ``disk`` — else
@@ -78,9 +90,16 @@ class ResultCache:
 
     def put(self, fp: str, entry: CacheEntry) -> None:
         """Feed both tiers; CAS failure is loud but non-fatal (ENOSPC on
-        the cache volume must not fail jobs whose results are in hand)."""
+        the cache volume must not fail jobs whose results are in hand).
+        Under disk pressure (the watchdog's first degradation tier) the
+        CAS write is SHED preemptively — the cache is the most
+        re-creatable durable state on the partition, so it yields its
+        bytes to the journal first."""
         self.memory.put(fp, entry)
         if self.cas is not None:
+            if self.guard is not None and not self.guard.allow_cas_writes():
+                self._inc("cas_writes_shed_total")
+                return
             try:
                 self.cas.put(fp, entry)
             except OSError as err:

@@ -545,12 +545,22 @@ def _prepare_checkpointed(args, variant, config, mesh, state, height, width, *,
 
     from gol_tpu.resilience.checkpoint import CheckpointManager, run_fingerprint
 
+    guard = None
+    if getattr(args, "disk_reserve", 0):
+        # The shed-checkpoints tier of the disk-pressure watchdog: ticked
+        # at every save boundary, so a filling disk thins checkpoints
+        # (loudly, counted) instead of killing the run with ENOSPC.
+        from gol_tpu.resilience.diskguard import DiskGuard
+
+        guard = DiskGuard(args.checkpoint_dir,
+                          admission_bytes=args.disk_reserve)
     mgr = CheckpointManager(
         args.checkpoint_dir,
         height=height,
         width=width,
         codec=_checkpoint_codec(args, variant, mesh, width, height),
         keep=args.checkpoint_keep,
+        guard=guard,
         # Fingerprinted on the INITIAL state (before any restore): a reused
         # checkpoint dir holding a different input's checkpoints must never
         # hand that run's state to this one.
@@ -946,8 +956,15 @@ def _serve(args) -> int:
     import signal
 
     from gol_tpu.platform_env import enable_compile_cache
+    from gol_tpu.resilience import faults
 
     enable_compile_cache(args.compile_cache)
+
+    # The subprocess fault harness (GOL_FAULTS crosses the exec boundary,
+    # flags don't): the storage chaos matrix drives a REAL serve process
+    # into ENOSPC/SIGKILL-mid-compaction this way. Unset, this clears any
+    # plan a previous in-process run armed — same contract as `gol run`.
+    faults.install(faults.FaultPlan.from_env())
 
     from gol_tpu.serve.server import GolServer
 
@@ -962,6 +979,30 @@ def _serve(args) -> int:
     if args.cache_entries < 1:
         raise ValueError(
             f"--cache-entries must be >= 1, got {args.cache_entries}"
+        )
+    if args.cache_disk_bytes is not None and args.cache_disk_bytes < 1:
+        raise ValueError(
+            f"--cache-disk-bytes must be >= 1, got {args.cache_disk_bytes}"
+        )
+    if args.journal_segment_bytes is not None \
+            and args.journal_segment_bytes < 0:
+        raise ValueError(
+            f"--journal-segment-bytes must be >= 0, got "
+            f"{args.journal_segment_bytes}"
+        )
+    if args.journal_retain is not None and args.journal_retain < 1:
+        raise ValueError(
+            f"--journal-retain must be >= 1, got {args.journal_retain}"
+        )
+    if args.disk_reserve < 0:
+        raise ValueError(
+            f"--disk-reserve must be >= 0, got {args.disk_reserve}"
+        )
+    if args.disk_reserve and not args.journal_dir:
+        raise ValueError(
+            "--disk-reserve watches the journal partition; pass "
+            "--journal-dir (a journal-less server has no durable state "
+            "to protect)"
         )
     # --result-cache with a journal but no explicit --cache-dir puts the
     # CAS tier beside the journal: restarts (and fleet worker partitions,
@@ -1029,6 +1070,10 @@ def _serve(args) -> int:
         cache_dir=cache_dir,
         cache_entries=args.cache_entries,
         cache_payload=args.cache_payload,
+        cache_disk_bytes=args.cache_disk_bytes,
+        journal_segment_bytes=args.journal_segment_bytes,
+        journal_retain=args.journal_retain,
+        disk_reserve=args.disk_reserve,
         history_dir=history_dir,
         history_bytes=args.history_bytes,
         **scheduler_kwargs,
@@ -1059,6 +1104,88 @@ def _serve(args) -> int:
     # A second signal raises SystemExit(1) in the main thread (the hard-exit
     # path) — it must PROPAGATE so supervisors see a non-zero status for an
     # aborted drain, not a clean 0.
+    return 0
+
+
+def _journal_partitions(directory: str) -> list[str]:
+    """Journal directories under ``directory``: itself when it IS one, else
+    every immediate subdirectory holding journal state — the fleet-dir
+    shape, where each worker partition compacts independently."""
+    from gol_tpu.serve import compaction
+
+    def is_partition(d):
+        return (
+            os.path.exists(os.path.join(d, compaction.ACTIVE_FILENAME))
+            or os.path.exists(compaction.snapshot_path(d))
+            or bool(compaction.sealed_segments(d))
+        )
+
+    if is_partition(directory):
+        return [directory]
+    try:
+        subdirs = sorted(
+            os.path.join(directory, name) for name in os.listdir(directory)
+        )
+    except OSError as err:
+        raise ValueError(f"cannot read {directory}: {err}") from None
+    return [d for d in subdirs if os.path.isdir(d) and is_partition(d)]
+
+
+def _compact_cmd(args) -> int:
+    """``gol compact``: offline journal compaction — fold sealed segments
+    into the CRC-stamped snapshot and retire them (the same pass a serving
+    worker runs on idle sampler ticks). Accepts a journal directory OR a
+    fleet directory, whose partitions compact independently."""
+    from gol_tpu.serve import compaction
+
+    partitions = _journal_partitions(args.dir)
+    if not partitions:
+        raise ValueError(f"no journal state under {args.dir}")
+    for directory in partitions:
+        report = compaction.compact(directory, retain_results=args.retain)
+        print(
+            f"{directory}: "
+            + (f"compacted {report.segments_retired} segment(s) -> "
+               f"snapshot ({report.records_kept} records"
+               + (f", {report.terminal_dropped} old result(s) dropped"
+                  if report.terminal_dropped else "")
+               + f"), {report.bytes_before} -> {report.bytes_after} bytes"
+               if report.compacted else
+               f"nothing to compact ({report.bytes_after} bytes"
+               + (f"; swept {report.segments_retired} stale segment(s)"
+                  if report.segments_retired else "") + ")")
+        )
+    return 0
+
+
+def _gc_cmd(args) -> int:
+    """``gol gc``: CAS garbage collection — sweep orphans and evict
+    least-recently-used entries to a byte budget. DRY-RUN by default
+    (prints what would happen); --apply deletes. Eviction is always safe:
+    the CAS is a cache, the journal stays the source of truth."""
+    from gol_tpu.cache import gc as cas_gc
+
+    if not os.path.isdir(args.dir):
+        raise ValueError(f"no such cache directory: {args.dir}")
+    if args.budget is not None and args.budget < 0:
+        raise ValueError(f"--budget must be >= 0, got {args.budget}")
+    report = cas_gc.collect(args.dir, args.budget, apply=args.apply)
+    verb = "removed" if args.apply else "would remove"
+    print(f"{args.dir}: {report.entries} entr(ies), "
+          f"{report.bytes_total} bytes"
+          + (f" (budget {report.budget})" if report.budget is not None
+             else ""))
+    print(f"  {verb} {len(report.orphans)} orphan(s) "
+          f"({report.orphan_bytes} bytes)")
+    for path in report.orphans:
+        print(f"    {path}")
+    verb = "evicted" if args.apply else "would evict"
+    print(f"  {verb} {len(report.evicted)} entr(ies) "
+          f"({report.evicted_bytes} bytes, LRU first)")
+    for fp in report.evicted:
+        print(f"    {fp}")
+    print(f"  after: {report.bytes_after} bytes"
+          + ("" if args.apply else " (dry run; pass --apply to delete)"))
     return 0
 
 
@@ -1130,6 +1257,25 @@ def _fleet(args) -> int:
         raise ValueError(
             f"--retry-budget must be >= 0, got {args.retry_budget}"
         )
+    # Storage-lifecycle flags: same validated-before-spawn contract.
+    if args.cache_disk_bytes is not None and args.cache_disk_bytes < 1:
+        raise ValueError(
+            f"--cache-disk-bytes must be >= 1, got {args.cache_disk_bytes}"
+        )
+    if args.journal_segment_bytes is not None \
+            and args.journal_segment_bytes < 0:
+        raise ValueError(
+            f"--journal-segment-bytes must be >= 0, got "
+            f"{args.journal_segment_bytes}"
+        )
+    if args.journal_retain is not None and args.journal_retain < 1:
+        raise ValueError(
+            f"--journal-retain must be >= 1, got {args.journal_retain}"
+        )
+    if args.disk_reserve < 0:
+        raise ValueError(
+            f"--disk-reserve must be >= 0, got {args.disk_reserve}"
+        )
     if args.chaos:
         # Parsed up front so a typo'd plan is a `gol: <error>` before any
         # worker spawns — and so the boot banner can echo the armed plan.
@@ -1198,6 +1344,19 @@ def _fleet(args) -> int:
         serve_args += ["--metrics-history"]
         if args.history_bytes is not None:
             serve_args += ["--history-bytes", str(args.history_bytes)]
+    # Storage-lifecycle flags, forwarded verbatim: every partition rotates,
+    # compacts, budgets its CAS, and watches its own free bytes
+    # INDEPENDENTLY — one full-disk partition 507s alone while the rest of
+    # the fleet serves.
+    if args.cache_disk_bytes is not None:
+        serve_args += ["--cache-disk-bytes", str(args.cache_disk_bytes)]
+    if args.journal_segment_bytes is not None:
+        serve_args += ["--journal-segment-bytes",
+                       str(args.journal_segment_bytes)]
+    if args.journal_retain is not None:
+        serve_args += ["--journal-retain", str(args.journal_retain)]
+    if args.disk_reserve:
+        serve_args += ["--disk-reserve", str(args.disk_reserve)]
 
     # --cores-per-worker: pin worker k to its own equal `taskset` slice
     # (the fixed per-worker budget of a one-worker-per-device deployment,
@@ -2463,6 +2622,17 @@ def build_parser() -> argparse.ArgumentParser:
         "bit-exact with uninterrupted ones",
     )
     run.add_argument(
+        "--disk-reserve",
+        type=int,
+        default=0,
+        metavar="N",
+        help="disk-pressure watchdog on the checkpoint directory "
+        "(resilience/diskguard.py): below 2N free bytes checkpoint saves "
+        "shed loudly (the run continues; auto-resume falls back to the "
+        "previous committed checkpoint) and recover automatically. "
+        "0 (default) disables the guard",
+    )
+    run.add_argument(
         "--sync-checkpoints",
         action="store_true",
         help="write checkpoints synchronously (device idle during payload "
@@ -2575,6 +2745,38 @@ def build_parser() -> argparse.ArgumentParser:
         "(self-contained meta JSON) or 'ts' (TensorStore zarr via "
         "io/ts_store.py). Entries of every encoding read back on every "
         "setting; unavailable lanes fall back to text loudly",
+    )
+    srv.add_argument(
+        "--cache-disk-bytes", type=int, default=None, metavar="N",
+        help="byte budget for the on-disk CAS tier: past it the cache "
+        "garbage-collects itself, least-recently-used entries first "
+        "(gol_tpu/cache/gc.py — eviction is always safe, the journal "
+        "stays the source of truth). Default: unbounded; `gol gc` runs "
+        "the same pass offline",
+    )
+    srv.add_argument(
+        "--journal-segment-bytes", type=int, default=None, metavar="N",
+        help="rotate the job journal into sealed segments past N bytes "
+        "(default 8 MiB); sealed segments compact into a CRC-stamped "
+        "snapshot on idle sampler ticks, bounding the durable footprint "
+        "(gol_tpu/serve/compaction.py; `gol compact` runs it offline). "
+        "0 disables rotation (the unbounded single-file journal)",
+    )
+    srv.add_argument(
+        "--journal-retain", type=int, default=None, metavar="N",
+        help="result-retention window: compaction keeps only the newest N "
+        "terminal records in the snapshot — results older than the window "
+        "answer 404 after a restart. Default: retain every result "
+        "(replayed state identical to the unbounded log)",
+    )
+    srv.add_argument(
+        "--disk-reserve", type=int, default=0, metavar="N",
+        help="disk-pressure watchdog (resilience/diskguard.py): when free "
+        "bytes on the journal partition fall below 4N the CAS stops "
+        "taking writes, below 2N checkpoints shed, below N POST /jobs "
+        "answers 507 (naming the partition and free bytes) while "
+        "in-flight jobs still complete and journal; recovery is "
+        "automatic with 25%% hysteresis. 0 (default) disables the guard",
     )
     srv.add_argument(
         "--warm-plans", action="store_true",
@@ -2707,6 +2909,28 @@ def build_parser() -> argparse.ArgumentParser:
         "may compile on several workers (one-time, bought back by every "
         "repeat). Pair with --result-cache",
     )
+    flt.add_argument(
+        "--cache-disk-bytes", type=int, default=None, metavar="N",
+        help="forwarded to every worker: per-partition CAS byte budget "
+        "with LRU garbage collection (see `gol serve --cache-disk-bytes`)",
+    )
+    flt.add_argument(
+        "--journal-segment-bytes", type=int, default=None, metavar="N",
+        help="forwarded to every worker: journal segment rotation "
+        "threshold (see `gol serve --journal-segment-bytes`)",
+    )
+    flt.add_argument(
+        "--journal-retain", type=int, default=None, metavar="N",
+        help="forwarded to every worker: result-retention window at "
+        "compaction (see `gol serve --journal-retain`)",
+    )
+    flt.add_argument(
+        "--disk-reserve", type=int, default=0, metavar="N",
+        help="forwarded to every worker: per-partition disk-pressure "
+        "watchdog — a full-disk partition sheds CAS writes, then "
+        "checkpoints, then 507s new admission, alone, while the rest of "
+        "the fleet serves (see `gol serve --disk-reserve`)",
+    )
     flt.add_argument("--slo-shed", action="store_true")
     flt.add_argument("--slo-latency-p99", type=float, default=60.0,
                      metavar="S")
@@ -2822,6 +3046,34 @@ def build_parser() -> argparse.ArgumentParser:
         "defenses, not the supervisor. NEVER set this in production",
     )
     flt.set_defaults(func=_fleet)
+
+    cpt = sub.add_parser(
+        "compact",
+        help="offline journal compaction: fold sealed segments into the "
+        "CRC-stamped snapshot and retire them (a journal dir, or a fleet "
+        "dir whose partitions compact independently)",
+    )
+    cpt.add_argument("dir", help="journal directory or fleet directory")
+    cpt.add_argument(
+        "--retain", type=int, default=None, metavar="N",
+        help="keep only the newest N terminal records in the snapshot "
+        "(the result-retention window; default: all)",
+    )
+    cpt.set_defaults(func=_compact_cmd)
+
+    gcp = sub.add_parser(
+        "gc",
+        help="CAS garbage collection: sweep orphans + evict LRU entries "
+        "to a byte budget (dry-run by default; --apply deletes)",
+    )
+    gcp.add_argument("dir", help="cache (CAS) directory")
+    gcp.add_argument(
+        "--budget", type=int, default=None, metavar="BYTES",
+        help="target byte budget (default: sweep garbage only)",
+    )
+    gcp.add_argument("--apply", action="store_true",
+                     help="actually delete (default is a dry-run report)")
+    gcp.set_defaults(func=_gc_cmd)
 
     tun = sub.add_parser(
         "tune",
@@ -3046,7 +3298,7 @@ def main(argv: list[str] | None = None) -> int:
     if not argv or argv[0] not in (
         "run", "generate", "show", "serve", "fleet", "submit", "batch",
         "tune", "trace-report", "fleet-trace", "history-report", "top",
-        "slo-report", "-h", "--help"
+        "slo-report", "compact", "gc", "-h", "--help"
     ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)

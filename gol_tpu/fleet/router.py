@@ -196,8 +196,12 @@ def merge_metrics(snapshots: dict[str, dict]) -> dict:
     gauges — ratios and occupancies, which live in [0, 1] per worker — take
     the MAX (summing four workers' 0.9 dispatch-gap ratios into 3.6 would
     be nonsense; the worst worker is the figure an operator acts on).
-    Histogram ``count``/``sum`` sum; quantiles take the MAX across workers
-    — the honest aggregate without raw samples is "no worker is worse than
+    Disk-pressure gauges are intensive too, with their own directions:
+    ``disk_free_bytes`` merges by MIN (the binding constraint — the fleet
+    is as full as its fullest partition) and ``disk_pressure_level`` by
+    MAX (the deepest degradation any partition is in). Histogram
+    ``count``/``sum`` sum; quantiles take the MAX across workers — the
+    honest aggregate without raw samples is "no worker is worse than
     this", which is the bound an operator alerts on anyway."""
     counters: dict[str, float] = {}
     gauges: dict[str, float] = {}
@@ -206,11 +210,15 @@ def merge_metrics(snapshots: dict[str, dict]) -> dict:
         for name, value in (snap.get("counters") or {}).items():
             counters[name] = counters.get(name, 0) + value
         for name, value in (snap.get("gauges") or {}).items():
-            if any(hint in name for hint in ("ratio", "occupancy")):
-                prev = gauges.get(name)
+            prev = gauges.get(name)
+            if name == "disk_free_bytes":
+                gauges[name] = value if prev is None else min(prev, value)
+            elif name == "disk_pressure_level" or any(
+                hint in name for hint in ("ratio", "occupancy")
+            ):
                 gauges[name] = value if prev is None else max(prev, value)
             else:
-                gauges[name] = gauges.get(name, 0) + value
+                gauges[name] = (prev or 0) + value
         for name, summary in (snap.get("histograms") or {}).items():
             out = hists.setdefault(name, {"count": 0, "sum": 0.0})
             out["count"] += summary.get("count") or 0

@@ -72,10 +72,20 @@ class ServeSampler:
         # Durable metrics history (obs/history.py HistoryWriter) or None
         # (the default — no history object means zero per-tick cost).
         self.history = history
+        # Per-tick hooks (the storage-lifecycle tick rides here: disk-guard
+        # watermarks, journal-bytes gauges, idle-time compaction). Run
+        # after the gap sample and BEFORE the history append, so gauges a
+        # hook sets land in the same durable record; a raising hook is
+        # logged and skipped, never kills the sampler thread.
+        self._hooks: list = []
         self._clock = clock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._last: dict[str, tuple[float, float]] = {}  # counter -> (t, v)
+
+    def add_hook(self, hook) -> None:
+        """Register a zero-arg callable to run every tick."""
+        self._hooks.append(hook)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -110,9 +120,15 @@ class ServeSampler:
         if self.slo is not None:
             self.slo.evaluate()
         self._sample_gap()
+        for hook in self._hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - a bad hook must not kill it
+                logger.exception("serve sampler hook failed")
         if self.history is not None:
             # One snapshot per tick into the durable ring: taken AFTER the
-            # gap sample so the freshly-set gauges ride the same record.
+            # gap sample (and the hooks) so the freshly-set gauges ride
+            # the same record.
             self.history.append(self.registry.snapshot())
 
     def _sample_gap(self) -> None:

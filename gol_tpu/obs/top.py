@@ -35,6 +35,19 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def _bytes_h(v) -> str:
+    """Human byte figure for the storage row (None renders as '-')."""
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return (f"{v:.0f}{unit}" if unit == "B"
+                    else f"{v:.1f}{unit}")
+        v /= 1024
+    return f"{v:.1f}TiB"
+
+
 def _bar(frac: float, width: int = 20) -> str:
     frac = max(0.0, min(1.0, frac))
     filled = round(frac * width)
@@ -137,6 +150,31 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
             f"/disk {int(counters.get('cache_hits_total_disk', 0))})"
             f"  coalesced {int(coalesced)}  misses {int(misses)}"
         )
+    # Storage lifecycle (only when a journal/guard exports the gauges):
+    # durable footprint, compaction count, and the watchdog's pressure
+    # level — the answer to "is any partition about to fill".
+    jbytes = gauges.get("journal_bytes")
+    free = gauges.get("disk_free_bytes")
+    if jbytes is not None or free is not None:
+        level = int(gauges.get("disk_pressure_level", 0))
+        level_names = ("ok", "shed-cas", "shed-ckpt", "REFUSING")
+        level_name = (level_names[level] if 0 <= level < len(level_names)
+                      else str(level))
+        status = "ok" if level == 0 else ("critical" if level >= 3
+                                          else "warning")
+        line = (
+            f"  storage: journal {_bytes_h(jbytes)}"
+            f" (segs {int(gauges.get('journal_segments', 0))},"
+            f" compactions {int(counters.get('compactions_total', 0))})"
+            f"   cas {_bytes_h(gauges.get('cas_bytes'))}"
+            f"   free {_bytes_h(free)}   guard {level_name}"
+        )
+        shed = counters.get("cas_writes_shed_total", 0)
+        refused = counters.get("jobs_refused_disk_total", 0)
+        if shed or refused:
+            line += (f"   (shed {int(shed)} cas write(s),"
+                     f" refused {int(refused)} job(s))")
+        lines.append(_color(status, line, ansi) if level else line)
     # Sparse lane (only when sparse jobs have run — the counters exist
     # then): tile-steps executed and the last universe's live-tile
     # occupancy, the numbers that say how much dead area was elided.

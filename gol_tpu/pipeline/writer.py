@@ -119,6 +119,10 @@ class AsyncCheckpointWriter:
             self._mgr.save(state, generation, counter)
             return
         self.drain()  # the Wait-at-next-boundary: commit the previous write
+        if self._mgr.sheds_save():
+            # Disk pressure (resilience/diskguard): the same shed decision
+            # the sync lane takes, after the previous write committed.
+            return
         try:
             faults.on_checkpoint_boundary(generation)
             if self._mgr._already_committed(generation):
@@ -184,6 +188,11 @@ class AsyncCheckpointWriter:
                     task.shape, task.generation, task.counter,
                     task.checksums, None,
                 )
+                # --checkpoint-keep pruning, strictly BEHIND the deferred
+                # commit (and under the manager's _io_lock, which the
+                # background payload write also holds): pruning can never
+                # overlap a write staging files into the same directory.
+                self._mgr.prune()
             except BaseException:
                 self._reg.inc("checkpoint_save_failures_total")
                 raise

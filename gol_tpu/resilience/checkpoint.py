@@ -37,6 +37,7 @@ import dataclasses
 import json
 import logging
 import os
+import threading
 import time
 import zlib
 from typing import Any, Callable
@@ -343,6 +344,7 @@ class CheckpointManager:
         keep: int = 2,
         retry: RetryPolicy = DEFAULT_IO_RETRY,
         run_fingerprint: str | None = None,
+        guard=None,
     ):
         if keep < 1:
             raise ValueError(f"checkpoint keep must be >= 1, got {keep}")
@@ -353,6 +355,19 @@ class CheckpointManager:
         self.keep = keep
         self.retry = retry
         self.run_fingerprint = run_fingerprint
+        # The disk-pressure watchdog (resilience/diskguard.DiskGuard) or
+        # None: under its shed-checkpoints tier, saves are skipped loudly
+        # — a checkpoint only buys restart time; the run still completes,
+        # and auto-resume falls back to the previous committed one.
+        self.guard = guard
+        # Serializes ``--checkpoint-keep`` pruning against payload writes:
+        # the async writer (gol_tpu/pipeline) runs ``_write_payload`` on a
+        # background thread, and a prune sweeping the directory while a
+        # codec stages payload files there could collect the in-flight
+        # write's staging as "stale". The deferred-commit protocol already
+        # orders the two on the happy path; this lock makes the ordering
+        # STRUCTURAL — any caller overlap serializes instead of corrupting.
+        self._io_lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
 
     # -- naming --------------------------------------------------------------
@@ -394,6 +409,8 @@ class CheckpointManager:
         checkpoint and which generation it was committing.
         """
         reg = obs_registry.default()
+        if self.sheds_save():
+            return self._manifest_path(generation)
         with obs_trace.span("checkpoint.save", generation=int(generation)):
             try:
                 path = self._save(state, generation, counter)
@@ -404,6 +421,25 @@ class CheckpointManager:
                 raise
         reg.inc("checkpoint_saves_total")
         return path
+
+    def sheds_save(self) -> bool:
+        """Disk-pressure shed decision for one boundary (consumed by BOTH
+        the sync path above and the async writer's): tick the guard, and
+        under its shed-checkpoints tier skip the save loudly — counted, so
+        an operator sees checkpoints thinning before the disk is gone."""
+        if self.guard is None:
+            return False
+        self.guard.tick()
+        if self.guard.allow_checkpoints():
+            return False
+        obs_registry.default().inc("checkpoint_sheds_total")
+        logger.warning(
+            "checkpoint shed: %s is under disk pressure (%s, %s bytes "
+            "free); the previous committed checkpoint remains the restore "
+            "point", self.directory, self.guard.level_name,
+            self.guard.free_bytes,
+        )
+        return True
 
     def _save(self, state, generation: int, counter: int) -> str:
         """The synchronous save: the four staged phases back to back.
@@ -421,9 +457,11 @@ class CheckpointManager:
             return self._manifest_path(generation)
         self._sweep_stale(generation)
         local_sums, write_err = self._write_payload(state, generation)
-        return self._commit_manifest(
+        path = self._commit_manifest(
             tuple(state.shape), generation, counter, local_sums, write_err
         )
+        self.prune()
+        return path
 
     def _already_committed(self, generation: int) -> bool:
         """Whether a valid checkpoint for ``generation`` already exists."""
@@ -484,16 +522,23 @@ class CheckpointManager:
         write_err: Exception | None = None
         local_sums: dict[str, int] = {}
         try:
-            if multihost or self.codec.self_retrying:
-                # No outer retry. Multihost: the zarr codec's write contains
-                # collective barriers, and ONE process re-entering them while
-                # peers have moved on joins the wrong barrier. Self-retrying
-                # codecs: stacking this policy on the codec's own would cube
-                # the time-to-failure of a persistent outage.
-                self.codec.write(payload_path, state)
-            else:
-                self.retry.call(lambda: self.codec.write(payload_path, state))
-            faults.on_payload_write(payload_path)
+            # Serialized against prune(): the async writer runs this on a
+            # background thread, and the codecs stage files in the
+            # checkpoint directory mid-write — a concurrent prune must
+            # never sweep them as stale leftovers.
+            with self._io_lock:
+                if multihost or self.codec.self_retrying:
+                    # No outer retry. Multihost: the zarr codec's write
+                    # contains collective barriers, and ONE process
+                    # re-entering them while peers have moved on joins the
+                    # wrong barrier. Self-retrying codecs: stacking this
+                    # policy on the codec's own would cube the
+                    # time-to-failure of a persistent outage.
+                    self.codec.write(payload_path, state)
+                else:
+                    self.retry.call(
+                        lambda: self.codec.write(payload_path, state))
+                faults.on_payload_write(payload_path)
             local_sums = _shard_checksums(state)
         except Exception as e:
             if not multihost:
@@ -564,8 +609,19 @@ class CheckpointManager:
                 f"gol_tpu.ckpt.committed:{self.directory}:{generation}")
         else:
             _commit_file(manifest_path, data)
-        self._gc()
         return manifest_path
+
+    def prune(self) -> None:
+        """``--checkpoint-keep`` pruning, as its own phase BEHIND the
+        commit: the sync save runs it right after ``_commit_manifest``;
+        the async writer runs it after the DEFERRED commit lands (its
+        drain), never concurrently with the background payload write —
+        and the ``_io_lock`` shared with ``_write_payload`` makes that
+        ordering structural rather than conventional. The ``prune`` fault
+        boundary (kill_during_prune) fires inside, between a doomed
+        checkpoint's manifest delete and its payload delete."""
+        with self._io_lock:
+            self._gc()
 
     def _manifest_is_foreign(self, generation: int) -> bool:
         """True when the manifest readably belongs to a DIFFERENT run (its
@@ -609,6 +665,11 @@ class CheckpointManager:
             except (OSError, ValueError):
                 pass  # unreadable manifest: fall back to this lane's name
             _rmtree_or_file(manifest_path)
+            # Manifest-first ordering: a crash HERE (the kill_during_prune
+            # fault boundary) orphans a payload, never dangles a manifest —
+            # the orphan is invisible to restore and swept by the next
+            # prune's manifest-less-payload pass.
+            faults.on_checkpoint_prune(manifest_path)
             _rmtree_or_file(os.path.join(self.directory, payload_name))
         newest = gens[0] if gens else None
         live = {self._payload_name(g) for g in gens[: self.keep]}

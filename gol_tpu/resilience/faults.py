@@ -28,11 +28,42 @@ Knobs (``--fault-plan`` spec / ``GOL_FAULTS`` env var, ``k=v`` comma list):
 - ``kill_mode=exception|sigkill``  simulated crash (``InjectedCrash``, a
                            BaseException no library layer catches) or a real
                            ``SIGKILL`` (subprocess harness only)
+
+Filesystem exhaustion knobs (the storage-lifecycle harness: every durable
+writer — journal, CAS, checkpoint, compaction snapshot — routes its bytes
+through the ``resilience/fsio`` shim, whose probes these drive):
+
+- ``enospc_after_bytes=N`` shim writes succeed until N cumulative bytes
+                           have passed, then every write raises
+                           ``OSError(ENOSPC)`` — a partition filling up
+                           mid-run, deterministically
+- ``eio_every=N``          every Nth shim write raises ``OSError(EIO)``
+                           (flaky media, not exhaustion — retries may heal)
+- ``full_disk=1``          every shim write raises ``ENOSPC`` immediately
+                           and ``fsio.free_bytes`` reports 0 — the disk is
+                           full from the first byte (drives the watchdog)
+- ``disk_free_bytes=N``    pin ``fsio.free_bytes`` to N without failing
+                           writes: the watchdog sees pressure before the
+                           filesystem actually refuses anything
+- ``kill_during_compaction=snapshot|retire``  crash a journal compaction at
+                           its two durability boundaries — ``snapshot``
+                           fires with the new snapshot fully staged but not
+                           yet committed; ``retire`` fires after the commit
+                           with the folded segments not yet deleted
+- ``kill_during_cas_gc=N`` crash the CAS garbage collector mid-evict on its
+                           Nth entry, between the meta unlink (the entry is
+                           now invisible) and the payload unlink (an orphan
+                           sidecar the next sweep must collect)
+- ``kill_during_prune=N``  crash checkpoint pruning on its Nth doomed
+                           checkpoint, after the manifest delete and before
+                           the payload delete (the orphaned payload must be
+                           invisible garbage to the next restore/GC)
 """
 
 from __future__ import annotations
 
 import dataclasses
+import errno
 import os
 
 
@@ -69,11 +100,23 @@ class FaultPlan:
     kill_at_gen: int | None = None
     kill_during_ckpt_write: int | None = None
     kill_mode: str = "exception"  # "exception" | "sigkill"
+    # Filesystem exhaustion (probed by the resilience/fsio shim).
+    enospc_after_bytes: int | None = None
+    eio_every: int | None = None
+    full_disk: int = 0
+    disk_free_bytes: int | None = None
+    kill_during_compaction: str | None = None  # "snapshot" | "retire"
+    kill_during_cas_gc: int | None = None
+    kill_during_prune: int | None = None
 
     _ts_writes: int = dataclasses.field(default=0, repr=False)
     _ts_opens: int = dataclasses.field(default=0, repr=False)
     _payload_writes: int = dataclasses.field(default=0, repr=False)
     _killed: bool = dataclasses.field(default=False, repr=False)
+    _fs_bytes: int = dataclasses.field(default=0, repr=False)
+    _fs_writes: int = dataclasses.field(default=0, repr=False)
+    _cas_evicts: int = dataclasses.field(default=0, repr=False)
+    _prunes: int = dataclasses.field(default=0, repr=False)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -81,9 +124,13 @@ class FaultPlan:
         injection never silently tests nothing."""
         plan = cls()
         ints = {"ts_write_fail", "ts_open_transient", "payload_write_fail",
-                "kill_at_gen", "kill_during_ckpt_write"}
+                "kill_at_gen", "kill_during_ckpt_write",
+                "enospc_after_bytes", "eio_every", "full_disk",
+                "disk_free_bytes", "kill_during_cas_gc",
+                "kill_during_prune"}
         strs = {"ts_write_error": ("hard", "transient"),
-                "kill_mode": ("exception", "sigkill")}
+                "kill_mode": ("exception", "sigkill"),
+                "kill_during_compaction": ("snapshot", "retire")}
         for part in filter(None, (p.strip() for p in spec.split(","))):
             key, sep, value = part.partition("=")
             if not sep:
@@ -227,6 +274,95 @@ def on_payload_write(path: str) -> None:
     ):
         _tear(path)
         raise InjectedWriteError(f"checkpoint payload write {path}")
+
+
+def _crash(site: str) -> None:
+    """The shared kill tail: dump the flight recorder, then SIGKILL or raise
+    ``InjectedCrash`` per the plan's ``kill_mode`` (exactly the
+    ``on_checkpoint_boundary`` discipline — sigkill gets no unwinding, so
+    the dump must happen here)."""
+    plan = _active
+    from gol_tpu.obs import recorder
+
+    recorder.trigger(f"fault-injection: kill at {site} ({plan.kill_mode})")
+    if plan.kill_mode == "sigkill":
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise InjectedCrash(f"injected crash at {site}")
+
+
+def on_fs_write(nbytes: int, site: str) -> None:
+    """Probed by ``resilience/fsio`` before every shim write: the
+    exhaustion knobs fire here, with real errno values so the callers'
+    ENOSPC/EIO handling is exercised verbatim."""
+    plan = _active
+    if plan is None:
+        return
+    plan._fs_writes += 1
+    if plan.full_disk:
+        raise OSError(errno.ENOSPC,
+                      f"injected full disk at {site}")
+    if plan.eio_every and plan._fs_writes % plan.eio_every == 0:
+        raise OSError(errno.EIO,
+                      f"injected EIO at {site} (write #{plan._fs_writes})")
+    plan._fs_bytes += nbytes
+    if (plan.enospc_after_bytes is not None
+            and plan._fs_bytes > plan.enospc_after_bytes):
+        raise OSError(
+            errno.ENOSPC,
+            f"injected ENOSPC at {site} ({plan._fs_bytes} bytes past the "
+            f"{plan.enospc_after_bytes}-byte budget)")
+
+
+def fs_free_bytes() -> int | None:
+    """The watchdog's injected free-byte reading, or None (read the real
+    filesystem). ``full_disk`` reports 0 so the pressure plane and the
+    write failures agree on the world."""
+    plan = _active
+    if plan is None:
+        return None
+    if plan.full_disk:
+        return 0
+    return plan.disk_free_bytes
+
+
+def on_compaction(stage: str) -> None:
+    """Probed at a journal compaction's two durability boundaries:
+    ``snapshot`` right before the atomic commit (staged, uncommitted) and
+    ``retire`` right after it (committed, folded segments still on disk)."""
+    plan = _active
+    if plan is None or plan._killed:
+        return
+    if plan.kill_during_compaction == stage:
+        plan._killed = True
+        _crash(f"journal compaction ({stage} boundary)")
+
+
+def on_cas_evict(fp: str) -> None:
+    """Probed by the CAS garbage collector between an evicted entry's meta
+    unlink and its payload unlink — the orphan-sidecar window."""
+    plan = _active
+    if plan is None or plan._killed or plan.kill_during_cas_gc is None:
+        return
+    plan._cas_evicts += 1
+    if plan._cas_evicts == plan.kill_during_cas_gc:
+        plan._killed = True
+        _crash(f"CAS GC evict #{plan._cas_evicts} ({fp})")
+
+
+def on_checkpoint_prune(path: str) -> None:
+    """Probed by checkpoint pruning between a doomed checkpoint's manifest
+    delete and its payload delete: a kill here leaves an orphaned payload
+    that must be invisible garbage to the next restore (and swept by the
+    next prune)."""
+    plan = _active
+    if plan is None or plan._killed or plan.kill_during_prune is None:
+        return
+    plan._prunes += 1
+    if plan._prunes == plan.kill_during_prune:
+        plan._killed = True
+        _crash(f"checkpoint prune ({path})")
 
 
 def on_checkpoint_boundary(generation: int) -> None:

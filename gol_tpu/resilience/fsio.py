@@ -1,0 +1,58 @@
+"""The injectable filesystem shim under the tree's durable-state writers.
+
+Every byte the journal (serve/jobs.py), the compaction snapshot
+(serve/compaction.py), the CAS (cache/store.py), and the checkpoint
+manifests (resilience/checkpoint.py) put on disk routes through one of the
+helpers here, and every helper probes ``faults.on_fs_write`` first — so the
+fault plan's exhaustion knobs (``enospc_after_bytes``, ``eio_every``,
+``full_disk``) can drive each writer into ENOSPC/EIO deterministically,
+from outside the process, without monkeypatching anything. Disarmed (no
+plan installed — every production run), each probe is one ``None`` check.
+
+``free_bytes`` is the disk-pressure watchdog's (resilience/diskguard.py)
+one reading of the world: the real ``os.statvfs`` free bytes, unless the
+plan pins a value (``disk_free_bytes=N`` / ``full_disk=1`` -> 0).
+
+No clocks in this module, by design: exhaustion is about bytes, not time
+(tests/test_lint.py pins the wall-clock ban on it anyway).
+"""
+
+from __future__ import annotations
+
+import os
+
+from gol_tpu.resilience import faults
+
+
+def write_all(fd: int, data, site: str) -> None:
+    """Write ``data`` to ``fd`` completely (``os.write`` may return short —
+    large records, ENOSPC mid-way). The fault probe fires ONCE per logical
+    record, before the first byte: a journal record either wholly precedes
+    the injected exhaustion or wholly fails, matching how a real ENOSPC
+    surfaces to an fsynced appender."""
+    faults.on_fs_write(len(data), site)
+    view = memoryview(data)
+    while view:
+        view = view[os.write(fd, view):]
+
+
+def write_stream(f, data, site: str) -> None:
+    """``f.write(data)`` behind the probe — the buffered-file counterpart
+    of ``write_all`` for the staged-commit writers (CAS meta/sidecar,
+    compaction snapshot, checkpoint manifest)."""
+    faults.on_fs_write(len(data), site)
+    f.write(data)
+
+
+def free_bytes(path: str) -> int:
+    """Free bytes available on ``path``'s filesystem (or the fault plan's
+    pinned value). ``f_bavail`` — the unprivileged view — because the
+    reserved-root blocks are exactly the ones this process cannot use."""
+    pinned = faults.fs_free_bytes()
+    if pinned is not None:
+        return pinned
+    st = os.statvfs(path)
+    return st.f_bavail * st.f_frsize
+
+
+__all__ = ["free_bytes", "write_all", "write_stream"]

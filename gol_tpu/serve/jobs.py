@@ -42,6 +42,8 @@ import numpy as np
 
 from gol_tpu.config import Convention, GameConfig
 from gol_tpu.io import text_grid
+from gol_tpu.resilience import fsio
+from gol_tpu.serve import compaction
 
 logger = logging.getLogger(__name__)
 
@@ -411,15 +413,34 @@ class ReplayState:
 
 
 class JobJournal:
-    """Append-only JSONL journal; every append is one write + fsync."""
+    """Append-only JSONL journal; every append is one write + fsync.
 
-    FILENAME = "journal.jsonl"
+    **Segmented** (gol_tpu/serve/compaction.py): the live file rotates into
+    sealed ``journal-<seq>.jsonl`` segments past ``segment_bytes``, and
+    ``compact()`` folds sealed segments into a CRC-stamped snapshot so the
+    durable footprint stays bounded. Replay = snapshot + segments newer
+    than it + the live file — the append path (and its crash contract) is
+    byte-identical to the unsegmented journal; rotation is one atomic
+    rename under the same lock. ``segment_bytes`` None/0 disables rotation
+    (the PR-2 single-file layout, which replay still reads forever)."""
 
-    def __init__(self, directory: str):
+    FILENAME = compaction.ACTIVE_FILENAME
+
+    def __init__(self, directory: str,
+                 segment_bytes: int | None = compaction.DEFAULT_SEGMENT_BYTES):
         self.directory = directory
+        self.segment_bytes = segment_bytes or 0
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, self.FILENAME)
         self._fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._active_bytes = os.fstat(self._fd).st_size
+        # The next segment seq, computed ONCE (one snapshot-header read)
+        # and counted up in-process: seqs are minted only here, and our
+        # own compactions can only fold seqs we already minted, so the
+        # cached counter can never fall at or below `covers` — and the
+        # append lock never waits on an O(history) snapshot re-read.
+        self._next_seq = (compaction.next_index(directory)
+                          if self.segment_bytes else 0)
         # Appends come from both the accept path and worker threads. A
         # process-level lock (not just O_APPEND) keeps records whole even
         # when os.write returns short (large done records, ENOSPC mid-way):
@@ -441,10 +462,74 @@ class JobJournal:
 
     def _append_encoded(self, data: bytes) -> None:
         with self._lock:
-            view = memoryview(data)
-            while view:
-                view = view[os.write(self._fd, view):]
+            fsio.write_all(self._fd, data, "journal append")
             os.fsync(self._fd)
+            self._active_bytes += len(data)
+            if self.segment_bytes and self._active_bytes >= self.segment_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Seal the live file as the next segment and open a fresh one.
+
+        Rename first, close-and-reopen second: the O_APPEND fd stays valid
+        across the rename, so if anything here fails the journal keeps
+        appending with zero lost records. A failure BETWEEN the two steps
+        is rolled back (rename the file back under the live name): the
+        appender must never keep writing a file that carries a SEALED
+        name, because compaction folds-and-deletes sealed segments — a
+        concurrent compaction would silently drop every record appended
+        after the half-rotation."""
+        sealed = os.path.join(self.directory,
+                              compaction.segment_name(self._next_seq))
+        try:
+            os.replace(self.path, sealed)
+        except OSError as err:
+            logger.warning(
+                "journal rotation in %s failed (%s); continuing to append "
+                "to the current file", self.directory, err)
+            return
+        try:
+            new_fd = os.open(
+                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+        except OSError as err:
+            try:
+                os.replace(sealed, self.path)
+                logger.warning(
+                    "journal rotation in %s could not open a fresh live "
+                    "file (%s); rolled the rename back", self.directory, err)
+            except OSError as undo_err:
+                # Same-directory rename-back almost cannot fail; if it
+                # does, appends continue on the held fd but the file now
+                # wears a sealed name — scream, because only an operator
+                # can restore the invariant.
+                logger.critical(
+                    "journal rotation in %s stranded the live journal "
+                    "under sealed name %s (open: %s; rollback: %s) — "
+                    "records keep appending there but COMPACTION MAY "
+                    "RETIRE IT; free descriptors/space and restart",
+                    self.directory, sealed, err, undo_err)
+            return
+        os.close(self._fd)
+        self._fd = new_fd
+        self._active_bytes = 0
+        self._next_seq += 1
+
+    # -- storage lifecycle --------------------------------------------------
+
+    def bytes_on_disk(self) -> int:
+        """Durable footprint: snapshot + sealed segments + the live file."""
+        return compaction.journal_bytes(self.directory)
+
+    def sealed_count(self) -> int:
+        return len(compaction.sealed_segments(self.directory))
+
+    def compact(self, retain_results: int | None = None):
+        """Fold sealed segments into the snapshot (compaction.compact):
+        safe while this journal is live — compaction never touches the
+        file the appender holds."""
+        return compaction.compact(self.directory,
+                                  retain_results=retain_results)
 
     def record_submit(self, job: Job) -> None:
         self._append({"event": "submit", "job": job.to_record()})
@@ -513,65 +598,102 @@ class JobJournal:
     def record_cancelled(self, job: Job) -> None:
         self._append({"event": "cancelled", "id": job.id})
 
+    @staticmethod
+    def _apply_record(rec: dict, pending: dict, results: dict,
+                      failed: dict, cancelled: set) -> None:
+        """Apply ONE parsed journal record to the replay state (shared by
+        snapshot records and journal lines — the snapshot speaks the
+        journal's exact vocabulary, so one parser serves both)."""
+        event = rec["event"]
+        if event == "submit":
+            job = Job.from_record(rec["job"])
+            pending[job.id] = job
+        elif event == "done":
+            if "rle" in rec:
+                results[rec["id"]] = JobResult(
+                    grid=None,
+                    generations=rec["generations"],
+                    exit_reason=rec["exit_reason"],
+                    rle=rec["rle"],
+                    population=rec.get("population"),
+                    universe=(rec["height"], rec["width"]),
+                    cached=rec.get("cached"),
+                )
+            else:
+                grid = text_grid.decode(
+                    rec["grid"].encode("ascii"),
+                    rec["width"],
+                    rec["height"],
+                )
+                results[rec["id"]] = JobResult(
+                    grid=grid,
+                    generations=rec["generations"],
+                    exit_reason=rec["exit_reason"],
+                    cached=rec.get("cached"),
+                )
+            pending.pop(rec["id"], None)
+        elif event == "failed":
+            failed[rec["id"]] = rec.get("error", "")
+            pending.pop(rec["id"], None)
+        elif event == "cancelled":
+            cancelled.add(rec["id"])
+            pending.pop(rec["id"], None)
+        else:
+            raise ValueError(f"unknown event {event!r}")
+
+    def _replay_file(self, path: str, pending: dict, results: dict,
+                     failed: dict, cancelled: set) -> int:
+        """Apply one JSONL file's records; returns the torn-line count."""
+        torn = 0
+        if not os.path.exists(path):
+            return 0
+        with open(path, "rb") as f:
+            raw = f.read()
+        for line in raw.split(b"\n"):
+            if not line:
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8"))
+                self._apply_record(rec, pending, results, failed, cancelled)
+            except (ValueError, KeyError, UnicodeDecodeError):
+                torn += 1
+        return torn
+
     def replay(self) -> ReplayState:
         """Rebuild queue state from the journal (crash-tolerant).
 
-        Unparseable lines are dropped, not fatal: the only way one arises is
-        a crash mid-append (a torn tail) — by the append discipline there can
-        be at most one, but replay is lenient to all of them and reports the
-        count so operators see unexpected corruption.
+        Reads, in order: the committed snapshot (if any), sealed segments
+        NEWER than it — a segment at or below the snapshot's high-water
+        mark is a fully-folded leftover of a compaction killed between
+        commit and retirement, skipped here and swept by the next
+        compaction — and finally the live file. Unparseable lines are
+        dropped, not fatal: the only way one arises is a crash mid-append
+        (a torn tail) — by the append discipline there can be at most one,
+        but replay is lenient to all of them and reports the count so
+        operators see unexpected corruption.
         """
         pending: dict[str, Job] = {}
         results: dict[str, JobResult] = {}
         failed: dict[str, str] = {}
         cancelled: set[str] = set()
         torn = 0
-        if os.path.exists(self.path):
-            with open(self.path, "rb") as f:
-                raw = f.read()
-            for line in raw.split(b"\n"):
-                if not line:
-                    continue
+        covers = -1
+        snap = compaction.read_snapshot(self.directory)
+        if snap is not None:
+            covers = snap.covers
+            for rec in snap.records:
                 try:
-                    rec = json.loads(line.decode("utf-8"))
-                    event = rec["event"]
-                    if event == "submit":
-                        job = Job.from_record(rec["job"])
-                        pending[job.id] = job
-                    elif event == "done":
-                        if "rle" in rec:
-                            results[rec["id"]] = JobResult(
-                                grid=None,
-                                generations=rec["generations"],
-                                exit_reason=rec["exit_reason"],
-                                rle=rec["rle"],
-                                population=rec.get("population"),
-                                universe=(rec["height"], rec["width"]),
-                                cached=rec.get("cached"),
-                            )
-                        else:
-                            grid = text_grid.decode(
-                                rec["grid"].encode("ascii"),
-                                rec["width"],
-                                rec["height"],
-                            )
-                            results[rec["id"]] = JobResult(
-                                grid=grid,
-                                generations=rec["generations"],
-                                exit_reason=rec["exit_reason"],
-                                cached=rec.get("cached"),
-                            )
-                        pending.pop(rec["id"], None)
-                    elif event == "failed":
-                        failed[rec["id"]] = rec.get("error", "")
-                        pending.pop(rec["id"], None)
-                    elif event == "cancelled":
-                        cancelled.add(rec["id"])
-                        pending.pop(rec["id"], None)
-                    else:
-                        raise ValueError(f"unknown event {event!r}")
+                    self._apply_record(rec, pending, results, failed,
+                                       cancelled)
                 except (ValueError, KeyError, UnicodeDecodeError):
                     torn += 1
+        for seq, seg_path in compaction.sealed_segments(self.directory):
+            if seq <= covers:
+                continue  # folded into the snapshot (torn retirement)
+            torn += self._replay_file(seg_path, pending, results, failed,
+                                      cancelled)
+        torn += self._replay_file(self.path, pending, results, failed,
+                                  cancelled)
         if torn:
             logger.warning(
                 "job journal %s: dropped %d unparseable line(s) on replay "

@@ -91,6 +91,19 @@ class Draining(Exception):
     """Admission rejected: the server is draining."""
 
 
+class JournalUnavailable(Exception):
+    """Admission rejected: the SUBMIT record could not be journaled.
+
+    The asymmetry with terminal records is the whole point: a lost *done*
+    record costs an idempotent re-run after a restart (the job is still in
+    the journal), so ``_journal_append`` survives ENOSPC/EIO there. A lost
+    *submit* record is a job the server acknowledged but the journal never
+    heard of — it would silently VANISH on replay, breaking the
+    every-accepted-job-terminates contract. So a failing submit append
+    refuses the accept instead: the server maps this to HTTP 503 (the
+    client's retry signal; nothing was admitted, nothing will run)."""
+
+
 class DeadlineExceeded(Exception):
     """The job's propagated deadline budget (X-Gol-Deadline) is spent.
 
@@ -387,8 +400,28 @@ class Scheduler:
             # re-queue — i.e. double-run — an already-completed job. The
             # fsync inside the critical section is the price of the
             # exactly-once ledger ordering.
+            # A FAILING submit append (ENOSPC, EIO) refuses the accept: an
+            # acknowledged job absent from the journal would vanish on
+            # replay — the one failure mode strictly worse than a 503.
+            # Nothing is admitted here (the job is not yet in _jobs, no
+            # bucket slot, no in-flight registration), so the refusal is
+            # clean and the client's retry starts from zero.
             if record and self.journal is not None:
-                self.journal.record_submit(job)
+                try:
+                    self.journal.record_submit(job)
+                except OSError as err:
+                    self.metrics.inc("journal_errors_total")
+                    self.metrics.inc("jobs_rejected_total")
+                    logger.error(
+                        "journal submit append failed for job %s — refusing "
+                        "the accept (an acknowledged-but-unjournaled job "
+                        "would vanish on replay): %s: %s",
+                        job.id, type(err).__name__, err,
+                    )
+                    raise JournalUnavailable(
+                        f"cannot journal the submit record: "
+                        f"{type(err).__name__}: {err}"
+                    ) from err
             job.accepted_at = self._clock()
             job.timeline["accepted"] = job.accepted_at
             self._jobs[job.id] = job
@@ -1151,6 +1184,7 @@ __all__ = [
     "DEFAULT_DISPATCH_RETRY",
     "DeadlineExceeded",
     "Draining",
+    "JournalUnavailable",
     "QueueFull",
     "Scheduler",
 ]

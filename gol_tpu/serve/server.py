@@ -89,7 +89,7 @@ from gol_tpu.obs import (
 from gol_tpu.serve.jobs import DONE, FAILED, CANCELLED, JobJournal, new_job
 from gol_tpu.serve.metrics import Metrics
 from gol_tpu.serve.scheduler import (
-    DeadlineExceeded, Draining, QueueFull, Scheduler,
+    DeadlineExceeded, Draining, JournalUnavailable, QueueFull, Scheduler,
 )
 
 # The journaled error-string prefix that marks a failure as a deadline
@@ -162,16 +162,63 @@ class GolServer:
         cache_dir: str | None = None,
         cache_entries: int = 1024,
         cache_payload: str = "packed",
+        cache_disk_bytes: int | None = None,
+        journal_segment_bytes: int | None = None,
+        journal_retain: int | None = None,
+        disk_reserve: int = 0,
         history_dir: str | None = None,
         history_bytes: int | None = None,
         **scheduler_kwargs,
     ):
         self.metrics = metrics or Metrics()
-        journal = JobJournal(journal_dir) if journal_dir else None
+        journal = (
+            JobJournal(journal_dir, **(
+                {"segment_bytes": journal_segment_bytes}
+                if journal_segment_bytes is not None else {}
+            ))
+            if journal_dir else None
+        )
+        self.journal_dir = journal_dir
+        self.journal_retain = journal_retain
+        # Durable metrics history (obs/history.py): OFF by default — no
+        # writer object, no per-tick work. With --metrics-history, every
+        # sampler tick appends the serving registry snapshot to the
+        # size-capped ring, so this process's window survives it. Built
+        # FIRST so the disk guard can journal its transitions into it.
+        self.history = None
+        if history_dir:
+            kwargs = {}
+            if history_bytes:
+                kwargs["total_bytes"] = history_bytes
+                kwargs["segment_bytes"] = min(
+                    obs_history.DEFAULT_SEGMENT_BYTES,
+                    max(1, history_bytes // 4),
+                )
+            self.history = obs_history.HistoryWriter(
+                history_dir, source="serve", **kwargs
+            )
+        # The disk-pressure watchdog (resilience/diskguard.py): with
+        # --disk-reserve N, free bytes on the journal partition are read
+        # every sampler tick and the service degrades in tiers — shed CAS
+        # writes, shed checkpoints, refuse admission with 507 — recovering
+        # automatically. 0 (the default) mounts no guard.
+        self.disk_guard = None
+        if disk_reserve and journal_dir:
+            from gol_tpu.resilience.diskguard import DiskGuard
+
+            self.disk_guard = DiskGuard(
+                journal_dir,
+                admission_bytes=disk_reserve,
+                registry=self.metrics,
+                history=self.history,
+                partition=journal_dir,
+            )
         # The tiered result cache (gol_tpu/cache): --result-cache mounts the
         # in-process LRU, --cache-dir adds the on-disk CAS tier (and implies
         # enablement). Counters ride the serving registry so hit ratios
-        # merge fleet-wide like any other serving series.
+        # merge fleet-wide like any other serving series. --cache-disk-bytes
+        # budgets the CAS (atime-LRU GC, cache/gc.py); the disk guard sheds
+        # its writes first under pressure.
         cache = None
         if result_cache or cache_dir:
             from gol_tpu.cache import ResultCache
@@ -181,7 +228,10 @@ class GolServer:
                 cas_dir=cache_dir,
                 metrics=self.metrics,
                 payload=cache_payload,
+                disk_bytes=cache_disk_bytes,
+                guard=self.disk_guard,
             )
+        self.cache = cache
         self.scheduler = scheduler or Scheduler(
             journal=journal, metrics=self.metrics, cache=cache,
             **scheduler_kwargs
@@ -197,22 +247,6 @@ class GolServer:
             registry=self.metrics,
             shed=slo_shed,
         )
-        # Durable metrics history (obs/history.py): OFF by default — no
-        # writer object, no per-tick work. With --metrics-history, every
-        # sampler tick appends the serving registry snapshot to the
-        # size-capped ring, so this process's window survives it.
-        self.history = None
-        if history_dir:
-            kwargs = {}
-            if history_bytes:
-                kwargs["total_bytes"] = history_bytes
-                kwargs["segment_bytes"] = min(
-                    obs_history.DEFAULT_SEGMENT_BYTES,
-                    max(1, history_bytes // 4),
-                )
-            self.history = obs_history.HistoryWriter(
-                history_dir, source="serve", **kwargs
-            )
         # One background thread ticks the SLO evaluation AND the dispatch-
         # gap monitor (and, when mounted, the metrics-history append);
         # sample_interval <= 0 disables the thread (tests call
@@ -224,6 +258,10 @@ class GolServer:
             marginal_rates=_tuned_marginal_rates(),
             history=self.history,
         )
+        # The storage-lifecycle tick: disk-guard watermarks, journal/CAS
+        # byte gauges, and idle-time journal compaction all ride the
+        # sampler (one thread, one cadence — the gol-serve-sampler).
+        self.sampler.add_hook(self.storage_tick)
         self._sample_interval = sample_interval
         # The capacity weight this worker advertises on /healthz (the
         # affinity layer's measured-capacity source, fleet/affinity.py):
@@ -263,6 +301,14 @@ class GolServer:
         # The SLO state rides every flight-recorder dump: a crash report
         # answers "was the service healthy when it died" on its own.
         obs_recorder.add_state_provider(obs_slo.STATE_PROVIDER, self.slo.state)
+        if self.disk_guard is not None:
+            # Same standard for the disk guard: a post-mortem should show
+            # what pressure level the process died at.
+            from gol_tpu.resilience import diskguard
+
+            obs_recorder.add_state_provider(
+                diskguard.STATE_PROVIDER, self.disk_guard.state
+            )
         if self._sample_interval > 0:
             self.sampler.start()
 
@@ -287,6 +333,10 @@ class GolServer:
         if self.history is not None:
             self.history.close()
         obs_recorder.remove_state_provider(obs_slo.STATE_PROVIDER)
+        if self.disk_guard is not None:
+            from gol_tpu.resilience import diskguard
+
+            obs_recorder.remove_state_provider(diskguard.STATE_PROVIDER)
         self.scheduler.stop(drain=drain)
         self.httpd.shutdown()
         self.httpd.server_close()
@@ -436,6 +486,53 @@ class GolServer:
         if shed:
             self.metrics.inc("jobs_shed_total")
         return shed, retry_after
+
+    def should_refuse_disk(self):
+        """Admission-path disk check: ``(refuse, free_bytes)``. True only
+        at the watchdog's deepest level — the handler answers 507 naming
+        the partition, BEFORE reading the body (refusing for lack of disk
+        must not first buffer a 17MB board)."""
+        if self.disk_guard is None or not self.disk_guard.refuse_admission():
+            return False, None
+        self.metrics.inc("jobs_refused_disk_total")
+        return True, self.disk_guard.free_bytes
+
+    def storage_tick(self) -> None:
+        """One storage-lifecycle tick (riding the gol-serve-sampler):
+        watchdog watermarks, durable-footprint gauges, and idle-time
+        journal compaction — a sealed segment compacts as soon as the
+        queue is quiet, or regardless once four have piled up (a busy
+        server must still converge on a bounded journal)."""
+        if self.disk_guard is not None:
+            self.disk_guard.tick()
+        journal = self.scheduler.journal
+        if journal is not None:
+            self.metrics.set_gauge("journal_bytes", journal.bytes_on_disk())
+            sealed = journal.sealed_count()
+            self.metrics.set_gauge("journal_segments", sealed)
+            if sealed >= 1 and (sealed >= 4
+                                or self.scheduler.stats()["queued"] == 0):
+                try:
+                    report = journal.compact(
+                        retain_results=self.journal_retain
+                    )
+                except OSError as err:
+                    # ENOSPC while compacting: the segments stay, replay
+                    # still works, the next tick retries (ideally after
+                    # the guard shed enough writers to free space).
+                    self.metrics.inc("journal_errors_total")
+                    logger.warning("journal compaction failed (will retry): "
+                                   "%s: %s", type(err).__name__, err)
+                else:
+                    if report.compacted:
+                        self.metrics.inc("compactions_total")
+                        self.metrics.set_gauge(
+                            "journal_bytes", journal.bytes_on_disk()
+                        )
+                        self.metrics.set_gauge("journal_segments",
+                                               journal.sealed_count())
+        if self.cache is not None and self.cache.cas is not None:
+            self.metrics.set_gauge("cas_bytes", self.cache.cas.usage_bytes())
 
     def timeline_json(self, job_id: str) -> dict | None:
         """GET /jobs/<id>/timeline payload, or None for an unknown id."""
@@ -654,6 +751,21 @@ def _make_handler(server: GolServer):
                             headers={"Retry-After": str(int(retry_after))},
                         )
                         return
+                    # Disk-pressure admission refusal (the watchdog's
+                    # deepest tier): 507 Insufficient Storage naming the
+                    # partition and its free bytes, BEFORE the body is
+                    # read. In-flight jobs keep running and their done
+                    # records still land — only NEW work is refused, and
+                    # admission recovers on its own above the watermark.
+                    refuse, free = server.should_refuse_disk()
+                    if refuse:
+                        self._reply(507, {
+                            "error": "insufficient storage: journal "
+                                     "partition is under disk pressure",
+                            "partition": server.journal_dir,
+                            "free_bytes": free,
+                        })
+                        return
                     ctype = wire.content_type_of(
                         self.headers.get("Content-Type")
                     )
@@ -701,6 +813,14 @@ def _make_handler(server: GolServer):
                         return
                     except (QueueFull, Draining) as e:
                         self._reply(429, {"error": str(e)})
+                        return
+                    except JournalUnavailable as e:
+                        # The submit record could not be journaled (ENOSPC
+                        # on the partition): nothing was admitted — 503 is
+                        # the client's retry signal, and acknowledging a
+                        # job the journal never heard of would let it
+                        # vanish on replay.
+                        self._reply(503, {"error": str(e)})
                         return
                     self._reply(202, out)
                 elif path == "/drain":

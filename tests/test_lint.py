@@ -200,6 +200,29 @@ def test_no_wall_clock_in_engine():
         )
 
 
+def test_no_wall_clock_in_storage_lifecycle_modules():
+    """Same rule for the storage-lifecycle modules in gol_tpu/resilience/:
+    the disk-pressure watchdog's transition decisions (diskguard.py) are
+    pure byte comparisons stamped with ``time.perf_counter`` only — a
+    stepped wall clock must never flip admission on or off — and the
+    filesystem shim (fsio.py) has no clock at all (exhaustion is about
+    bytes, not time). The CAS's atime-LRU ledger is covered by the
+    existing gol_tpu/cache/ ban (eviction recency is the injectable
+    perf_counter clock; cold entries fall back to file-mtime ORDERING,
+    never clock arithmetic), and serve/compaction.py by the serve/ ban.
+    Scoped to the two new files rather than all of resilience/ because
+    checkpoint.py's manifest ``created_unix`` is a sanctioned
+    metadata-only wall stamp (never part of validity or ordering)."""
+    for module in ("diskguard.py", "fsio.py"):
+        for needle in ("time.time(", "datetime.now"):
+            offenders = _offenders(_LIBRARY_ROOT / "resilience", needle)
+            offenders = [o for o in offenders if o.startswith(module)]
+            assert not offenders, (
+                f"wall-clock {needle} in gol_tpu/resilience/{module} (use "
+                f"time.perf_counter() for any timing path): {offenders}"
+            )
+
+
 def test_no_wall_clock_in_sparse():
     """Same rule for gol_tpu/sparse/: the sparse engine sits on the serve
     dispatch path (sparse buckets ride the scheduler) and its run stats

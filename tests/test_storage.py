@@ -1,0 +1,1070 @@
+"""Storage lifecycle (ISSUE 15): journal compaction, CAS garbage
+collection, disk-pressure survival, and the filesystem fault plane.
+
+The load-bearing assertions: (1) replay after any compaction — including
+one SIGKILLed at either durability boundary — is state-identical to
+full-log replay; (2) CAS eviction never corrupts (survivors read back
+CRC-clean, evicted fingerprints are clean misses); (3) a full disk
+degrades the service in tiers (shed CAS writes -> shed checkpoints ->
+refuse admission 507) and recovers unattended, with zero torn records at
+any stage; (4) an ENOSPC on the SUBMIT append refuses the accept (503) —
+an acknowledged job absent from the journal would vanish on replay.
+"""
+
+import errno
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gol_tpu import oracle
+from gol_tpu.cache import gc as cas_gc
+from gol_tpu.cache.store import CacheEntry, DiskCAS
+from gol_tpu.config import GameConfig
+from gol_tpu.io import text_grid
+from gol_tpu.obs import history as obs_history
+from gol_tpu.resilience import diskguard, faults, fsio
+from gol_tpu.resilience.faults import FaultPlan, InjectedCrash
+from gol_tpu.serve import compaction
+from gol_tpu.serve.jobs import DONE, JobJournal, JobResult, new_job
+from gol_tpu.serve.scheduler import JournalUnavailable, Scheduler
+from gol_tpu.serve.server import GolServer
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _wait(predicate, timeout=30.0, interval=0.01):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+# ---------------------------------------------------------------------------
+# The filesystem fault plane
+
+
+class TestFaultPlanGrammar:
+    def test_parse_exhaustion_knobs(self):
+        plan = FaultPlan.parse(
+            "enospc_after_bytes=100,eio_every=3,full_disk=1,"
+            "disk_free_bytes=42,kill_during_compaction=retire,"
+            "kill_during_cas_gc=2,kill_during_prune=1"
+        )
+        assert plan.enospc_after_bytes == 100
+        assert plan.eio_every == 3
+        assert plan.full_disk == 1
+        assert plan.disk_free_bytes == 42
+        assert plan.kill_during_compaction == "retire"
+        assert plan.kill_during_cas_gc == 2
+        assert plan.kill_during_prune == 1
+
+    def test_bad_compaction_stage_is_loud(self):
+        with pytest.raises(ValueError, match="kill_during_compaction"):
+            FaultPlan.parse("kill_during_compaction=sideways")
+
+    def test_enospc_after_bytes_budget(self, tmp_path):
+        faults.install(FaultPlan(enospc_after_bytes=100))
+        path = tmp_path / "f"
+        fd = os.open(str(path), os.O_WRONLY | os.O_CREAT)
+        try:
+            fsio.write_all(fd, b"x" * 60, "test")
+            fsio.write_all(fd, b"x" * 40, "test")  # exactly at budget: ok
+            with pytest.raises(OSError) as exc:
+                fsio.write_all(fd, b"x", "test")
+            assert exc.value.errno == errno.ENOSPC
+            # And it stays failed — the disk does not un-fill itself.
+            with pytest.raises(OSError):
+                fsio.write_all(fd, b"x", "test")
+        finally:
+            os.close(fd)
+        assert path.stat().st_size == 100
+
+    def test_eio_every_nth_write(self, tmp_path):
+        faults.install(FaultPlan(eio_every=3))
+        fd = os.open(str(tmp_path / "f"), os.O_WRONLY | os.O_CREAT)
+        try:
+            fsio.write_all(fd, b"a", "test")
+            fsio.write_all(fd, b"b", "test")
+            with pytest.raises(OSError) as exc:
+                fsio.write_all(fd, b"c", "test")
+            assert exc.value.errno == errno.EIO
+            fsio.write_all(fd, b"d", "test")  # the next two pass again
+        finally:
+            os.close(fd)
+
+    def test_full_disk_fails_everything_and_reports_zero_free(self, tmp_path):
+        faults.install(FaultPlan(full_disk=1))
+        fd = os.open(str(tmp_path / "f"), os.O_WRONLY | os.O_CREAT)
+        try:
+            with pytest.raises(OSError) as exc:
+                fsio.write_all(fd, b"x", "test")
+            assert exc.value.errno == errno.ENOSPC
+        finally:
+            os.close(fd)
+        assert fsio.free_bytes(str(tmp_path)) == 0
+
+    def test_pinned_free_bytes_and_real_statvfs(self, tmp_path):
+        faults.install(FaultPlan(disk_free_bytes=4096))
+        assert fsio.free_bytes(str(tmp_path)) == 4096
+        faults.clear()
+        assert fsio.free_bytes(str(tmp_path)) > 0  # the real filesystem
+
+
+# ---------------------------------------------------------------------------
+# Journal segmentation
+
+
+def _submit_n(journal, n, done_every=2, seed0=0):
+    """n tiny jobs journaled; every ``done_every``-th also gets a done
+    record. Returns (all ids, done ids)."""
+    ids, done = [], []
+    for i in range(n):
+        job = new_job(8, 8, text_grid.generate(8, 8, seed=seed0 + i))
+        journal.record_submit(job)
+        ids.append(job.id)
+        if i % done_every == 0:
+            job.result = JobResult(
+                grid=text_grid.generate(8, 8, seed=1000 + i),
+                generations=i, exit_reason="gen_limit",
+            )
+            journal.record_done(job)
+            done.append(job.id)
+    return ids, done
+
+
+def _replay_state(directory):
+    j = JobJournal(directory, segment_bytes=0)
+    try:
+        return j.replay()
+    finally:
+        j.close()
+
+
+def _assert_state_equal(a, b):
+    assert sorted(x.id for x in a.pending) == sorted(x.id for x in b.pending)
+    assert a.results.keys() == b.results.keys()
+    for k in a.results:
+        np.testing.assert_array_equal(a.results[k].grid, b.results[k].grid)
+        assert a.results[k].generations == b.results[k].generations
+        assert a.results[k].exit_reason == b.results[k].exit_reason
+    assert a.failed == b.failed
+    assert a.cancelled == b.cancelled
+
+
+class TestJournalSegments:
+    def test_rotation_seals_segments_and_replay_is_complete(self, tmp_path):
+        j = JobJournal(str(tmp_path), segment_bytes=500)
+        ids, done = _submit_n(j, 16)
+        j.close()
+        assert compaction.sealed_segments(str(tmp_path))
+        state = _replay_state(str(tmp_path))
+        assert sorted(x.id for x in state.pending) == sorted(
+            set(ids) - set(done))
+        assert state.results.keys() == set(done)
+        assert state.torn_lines == 0
+
+    def test_unsegmented_layout_still_replays(self, tmp_path):
+        j = JobJournal(str(tmp_path), segment_bytes=0)
+        ids, done = _submit_n(j, 8)
+        j.close()
+        assert not compaction.sealed_segments(str(tmp_path))
+        state = _replay_state(str(tmp_path))
+        assert state.results.keys() == set(done)
+
+    def test_torn_tail_in_active_only_loses_the_tail(self, tmp_path):
+        j = JobJournal(str(tmp_path), segment_bytes=400)
+        ids, done = _submit_n(j, 10)
+        j.close()
+        with open(os.path.join(str(tmp_path), compaction.ACTIVE_FILENAME),
+                  "ab") as f:
+            f.write(b'{"event": "done", "id": "xyz", "gen')
+        state = _replay_state(str(tmp_path))
+        assert state.torn_lines == 1
+        assert state.results.keys() == set(done)
+
+    def test_next_index_never_reuses_a_folded_seq(self, tmp_path):
+        j = JobJournal(str(tmp_path), segment_bytes=400)
+        _submit_n(j, 10)
+        report = j.compact()
+        assert report.compacted
+        # Every sealed segment is gone; a fresh rotation must mint a seq
+        # PAST the snapshot's covers, or replay would skip it as folded.
+        assert compaction.next_index(str(tmp_path)) == report.covers + 1
+        _submit_n(j, 10, seed0=50)
+        j.close()
+        segs = compaction.sealed_segments(str(tmp_path))
+        assert segs and all(seq > report.covers for seq, _p in segs)
+        state = _replay_state(str(tmp_path))
+        assert state.torn_lines == 0
+        assert len(state.results) == 10  # 5 + 5 across the compaction
+
+    def test_enospc_on_append_raises(self, tmp_path):
+        j = JobJournal(str(tmp_path))
+        job = new_job(8, 8, np.zeros((8, 8), np.uint8))
+        faults.install(FaultPlan(full_disk=1))
+        with pytest.raises(OSError):
+            j.record_submit(job)
+        faults.clear()
+        j.record_submit(job)  # space returned: the journal still works
+        j.close()
+
+
+# ---------------------------------------------------------------------------
+# Compaction
+
+
+class TestCompaction:
+    def _churn(self, tmp_path, n=20):
+        j = JobJournal(str(tmp_path), segment_bytes=500)
+        _submit_n(j, n)
+        return j
+
+    def test_replay_identical_to_full_log(self, tmp_path):
+        j = self._churn(tmp_path)
+        before = _replay_state(str(tmp_path))
+        report = j.compact()
+        assert report.compacted and report.segments_retired > 0
+        assert report.bytes_after < report.bytes_before
+        after = _replay_state(str(tmp_path))
+        _assert_state_equal(before, after)
+        j.close()
+
+    def test_compact_covers_failed_and_cancelled(self, tmp_path):
+        j = JobJournal(str(tmp_path), segment_bytes=300)
+        jobs = [new_job(8, 8, text_grid.generate(8, 8, seed=i))
+                for i in range(6)]
+        for job in jobs:
+            j.record_submit(job)
+        jobs[0].error = "boom"
+        j.record_failed(jobs[0])
+        j.record_cancelled(jobs[1])
+        jobs[2].result = JobResult(grid=np.zeros((8, 8), np.uint8),
+                                   generations=1, exit_reason="empty")
+        j.record_done(jobs[2])
+        before = _replay_state(str(tmp_path))
+        j.compact()
+        after = _replay_state(str(tmp_path))
+        _assert_state_equal(before, after)
+        assert after.failed == {jobs[0].id: "boom"}
+        assert after.cancelled == {jobs[1].id}
+        j.close()
+
+    def test_repeated_compaction_is_idempotent(self, tmp_path):
+        j = self._churn(tmp_path)
+        j.compact()
+        state1 = _replay_state(str(tmp_path))
+        report = j.compact()
+        assert not report.compacted and report.segments_retired == 0
+        _assert_state_equal(state1, _replay_state(str(tmp_path)))
+        j.close()
+
+    def test_bounded_footprint_under_churn(self, tmp_path):
+        """The acceptance shape, scaled down: continuous submit+done churn
+        with per-round compaction keeps the file COUNT bounded (snapshot +
+        live file, at most one uncompacted segment) while replay keeps
+        every result."""
+        j = JobJournal(str(tmp_path), segment_bytes=600)
+        done_total = []
+        for r in range(6):
+            _, done = _submit_n(j, 10, done_every=1, seed0=100 * r)
+            done_total.extend(done)
+            j.compact()
+        assert j.sealed_count() <= 1
+        files = [n for n in os.listdir(str(tmp_path))
+                 if n != compaction.LOCK_FILENAME]
+        assert len(files) <= 3  # snapshot + active + (maybe) one sealed
+        state = _replay_state(str(tmp_path))
+        assert state.results.keys() == set(done_total)
+        assert not state.pending
+        j.close()
+
+    def test_retention_window_drops_oldest_terminals(self, tmp_path):
+        j = JobJournal(str(tmp_path), segment_bytes=300)
+        _ids, done = _submit_n(j, 12, done_every=1)
+        report = j.compact(retain_results=4)
+        assert report.compacted and report.terminal_dropped == len(done) - 4
+        state = _replay_state(str(tmp_path))
+        assert state.results.keys() == set(done[-4:])
+        assert not state.pending  # dropped terminals do NOT resurrect
+        j.close()
+
+    def test_torn_snapshot_is_ignored_and_rewritten(self, tmp_path):
+        j = self._churn(tmp_path)
+        before = _replay_state(str(tmp_path))
+        # A snapshot whose commit never landed (simulated external tear):
+        # stage a garbage snapshot in place, with the segments still there.
+        snap = compaction.snapshot_path(str(tmp_path))
+        with open(snap, "wb") as f:
+            f.write(b'{"event":"snapshot_header","version":1,"covers":99}\n'
+                    b"garbage\n")
+        assert compaction.read_snapshot(str(tmp_path)) is None
+        _assert_state_equal(before, _replay_state(str(tmp_path)))
+        report = j.compact()  # retried: rewrites a valid snapshot
+        assert report.compacted
+        _assert_state_equal(before, _replay_state(str(tmp_path)))
+        j.close()
+
+    def test_crc_catches_corrupted_snapshot_body(self, tmp_path):
+        j = self._churn(tmp_path)
+        j.compact()
+        snap = compaction.snapshot_path(str(tmp_path))
+        raw = bytearray(open(snap, "rb").read())
+        # Flip a digit inside a record line (still valid JSON overall).
+        idx = raw.index(b'"width":8')
+        raw[idx + 8:idx + 9] = b"9"
+        with open(snap, "wb") as f:
+            f.write(bytes(raw))
+        assert compaction.read_snapshot(str(tmp_path)) is None
+        j.close()
+
+    @pytest.mark.parametrize("stage", ["snapshot", "retire"])
+    def test_kill_at_either_boundary_replays_identically(self, tmp_path,
+                                                         stage):
+        """The SIGKILL matrix, in-process (kill_mode=exception is the same
+        crash semantics — InjectedCrash unwinds through everything): a
+        compaction killed at the staged-but-uncommitted boundary loses
+        nothing; killed after the commit, the folded segments coexist with
+        the snapshot and replay must NOT double-apply them."""
+        j = self._churn(tmp_path)
+        before = _replay_state(str(tmp_path))
+        faults.install(FaultPlan(kill_during_compaction=stage))
+        with pytest.raises(InjectedCrash):
+            j.compact()
+        faults.clear()
+        if stage == "snapshot":
+            assert compaction.read_snapshot(str(tmp_path)) is None
+            assert compaction.sealed_segments(str(tmp_path))
+        else:
+            assert compaction.read_snapshot(str(tmp_path)) is not None
+            assert compaction.sealed_segments(str(tmp_path))  # not retired
+        _assert_state_equal(before, _replay_state(str(tmp_path)))
+        # The restart's compaction finishes the job either way.
+        report = j.compact()
+        assert (report.compacted if stage == "snapshot"
+                else report.segments_retired > 0)
+        assert not compaction.sealed_segments(str(tmp_path))
+        _assert_state_equal(before, _replay_state(str(tmp_path)))
+        j.close()
+
+    def test_concurrent_compaction_excluded_by_lock(self, tmp_path):
+        """Two interleaved compactions could commit a stale snapshot over
+        a newer one whose segments are already deleted — the advisory
+        flock makes the loser skip (and a SIGKILLed holder releases it
+        with its process, so the lock can never go stale)."""
+        import fcntl
+
+        j = self._churn(tmp_path)
+        before = _replay_state(str(tmp_path))
+        lock_fd = os.open(
+            os.path.join(str(tmp_path), compaction.LOCK_FILENAME),
+            os.O_WRONLY | os.O_CREAT)
+        try:
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            report = j.compact()  # the loser: skips, touches nothing
+            assert not report.compacted and report.segments_retired == 0
+            assert compaction.sealed_segments(str(tmp_path))
+        finally:
+            os.close(lock_fd)
+        assert j.compact().compacted  # released: the next pass proceeds
+        _assert_state_equal(before, _replay_state(str(tmp_path)))
+        j.close()
+
+    def test_snapshot_covers_header_only_read(self, tmp_path):
+        """Seq minting reads only the snapshot HEADER — and still reads a
+        valid covers off a snapshot whose BODY was corrupted after commit
+        (under-minting a seq replay would skip is the unsafe direction;
+        over-minting is a skipped number)."""
+        j = self._churn(tmp_path)
+        report = j.compact()
+        assert compaction.snapshot_covers(str(tmp_path)) == report.covers
+        raw = bytearray(open(compaction.snapshot_path(str(tmp_path)),
+                             "rb").read())
+        raw[-10:-9] = b"Z"  # corrupt the trailer: full validation fails
+        with open(compaction.snapshot_path(str(tmp_path)), "wb") as f:
+            f.write(bytes(raw))
+        assert compaction.read_snapshot(str(tmp_path)) is None
+        assert compaction.snapshot_covers(str(tmp_path)) == report.covers
+        assert compaction.next_index(str(tmp_path)) == report.covers + 1
+        j.close()
+
+    def test_half_failed_rotation_rolls_back(self, tmp_path, monkeypatch):
+        """Rename-succeeded-reopen-failed must NOT leave the appender
+        writing a sealed-named file (compaction would fold and delete it
+        under the live stream): the rotation renames back and keeps
+        appending to the live name."""
+        from gol_tpu.serve import jobs as jobs_mod
+
+        j = JobJournal(str(tmp_path), segment_bytes=300)
+        real_open = os.open
+        fail_next = {"armed": False}
+
+        def flaky_open(path, *a, **k):
+            if fail_next["armed"] and path == j.path:
+                fail_next["armed"] = False
+                raise OSError(errno.EMFILE, "injected open failure")
+            return real_open(path, *a, **k)
+
+        monkeypatch.setattr(jobs_mod.os, "open", flaky_open)
+        fail_next["armed"] = True
+        ids, done = _submit_n(j, 6)  # crosses the threshold mid-way
+        monkeypatch.undo()
+        # The live name exists and owns the stream; nothing is stranded
+        # under a sealed name that compaction could retire.
+        assert os.path.exists(j.path)
+        j.compact()
+        _ids2, done2 = _submit_n(j, 4, seed0=70)
+        j.close()
+        state = _replay_state(str(tmp_path))
+        assert state.results.keys() == set(done) | set(done2)
+        assert state.torn_lines == 0
+
+    def test_new_appends_during_compaction_survive(self, tmp_path):
+        """Records landing in the ACTIVE file while sealed segments
+        compact are untouched: compaction never reads or moves the live
+        file."""
+        j = self._churn(tmp_path)
+        live = new_job(8, 8, text_grid.generate(8, 8, seed=999))
+        j.record_submit(live)
+        j.compact()
+        state = _replay_state(str(tmp_path))
+        assert live.id in {x.id for x in state.pending}
+        j.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: the submit-record append must refuse the accept
+
+
+class TestSubmitJournalFailure:
+    def test_scheduler_refuses_and_admits_nothing(self, tmp_path):
+        journal = JobJournal(str(tmp_path))
+        sched = Scheduler(journal=journal)
+        job = new_job(8, 8, np.zeros((8, 8), np.uint8))
+        faults.install(FaultPlan(full_disk=1))
+        with pytest.raises(JournalUnavailable):
+            sched.submit(job)
+        faults.clear()
+        assert sched.job(job.id) is None  # nothing admitted
+        assert sched.stats()["queued"] == 0
+        snap = sched.metrics.snapshot()
+        assert snap["counters"]["journal_errors_total"] == 1
+        assert snap["counters"]["jobs_rejected_total"] == 1
+        assert snap["counters"].get("jobs_accepted_total", 0) == 0
+        # The journal heard of nothing: a replay is empty.
+        journal.close()
+        state = _replay_state(str(tmp_path))
+        assert not state.pending and not state.results
+
+    def test_http_503_then_accepts_after_recovery(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        sample_interval=0, flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(16, 16, seed=3)
+            body = {"width": 16, "height": 16,
+                    "cells": text_grid.encode(board).decode("ascii"),
+                    "gen_limit": 5}
+            faults.install(FaultPlan(full_disk=1))
+            code, payload = _http("POST", srv.url + "/jobs", body)
+            assert code == 503 and "journal" in payload["error"]
+            faults.clear()
+            code, payload = _http("POST", srv.url + "/jobs", body)
+            assert code == 202
+            job_id = payload["id"]
+            assert _wait(lambda: _http(
+                "GET", f"{srv.url}/jobs/{job_id}")[1].get("state") == "done")
+        finally:
+            srv.shutdown()
+
+    def test_terminal_append_failure_still_completes(self, tmp_path):
+        """The OTHER ordering: a job accepted BEFORE the disk filled still
+        terminates (in-memory DONE, result served); only its done record
+        is lost — the idempotent-re-run contract, not a 5xx."""
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        sample_interval=0, flush_age=0.01)
+        srv.start()
+        try:
+            board = text_grid.generate(16, 16, seed=4)
+            body = {"width": 16, "height": 16,
+                    "cells": text_grid.encode(board).decode("ascii"),
+                    "gen_limit": 5}
+            code, payload = _http("POST", srv.url + "/jobs", body)
+            assert code == 202
+            job_id = payload["id"]
+            faults.install(FaultPlan(full_disk=1))  # fills AFTER the accept
+            assert _wait(lambda: _http(
+                "GET", f"{srv.url}/jobs/{job_id}")[1].get("state") == "done")
+            faults.clear()
+            code, result = _http("GET", f"{srv.url}/result/{job_id}")
+            assert code == 200
+            want = oracle.run(board, GameConfig(gen_limit=5))
+            got = text_grid.decode(result["grid"].encode("ascii"), 16, 16)
+            np.testing.assert_array_equal(got, want.grid)
+            snap = srv.metrics.snapshot()
+            assert snap["counters"]["journal_errors_total"] >= 1
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# CAS garbage collection
+
+
+def _fp(i):
+    return f"{i:02x}" + "ab" * 31
+
+
+def _entry(i, h=16, w=16):
+    g = np.zeros((h, w), np.uint8)
+    g[0, i % w] = 1
+    return CacheEntry(grid=g, generations=i, exit_reason="gen_limit")
+
+
+class TestCasGC:
+    def test_scan_classifies_entries_and_garbage(self, tmp_path):
+        cas = DiskCAS(str(tmp_path), payload="text")
+        for i in range(3):
+            cas.put(_fp(i), _entry(i))
+        sub = tmp_path / _fp(0)[:2]
+        (sub / (_fp(9) + ".golp")).write_bytes(b"orphan")  # meta-less
+        (sub / ("x" + faults.__name__)).write_bytes(b"foreign")
+        staging = sub / (_fp(0) + ".xyz.inprogress")
+        staging.write_bytes(b"staging")
+        entries, mtimes, orphans = cas_gc.scan(str(tmp_path))
+        assert set(entries) == {_fp(0), _fp(1), _fp(2)}
+        assert set(mtimes) == set(entries)
+        assert len(orphans) == 3
+
+    def test_eviction_order_cold_first_then_lru(self):
+        entries = {"a": 1, "b": 1, "c": 1, "d": 1}
+        mtimes = {"a": 5.0, "b": 2.0, "c": 9.0, "d": 1.0}
+        access = {"a": 100.0, "c": 50.0}
+        # b and d are cold (no stamp): oldest mtime first; then c (older
+        # stamp), then a.
+        assert cas_gc.eviction_order(entries, mtimes, access) == [
+            "d", "b", "c", "a"]
+
+    def test_dry_run_touches_nothing(self, tmp_path):
+        cas = DiskCAS(str(tmp_path), payload="text")
+        for i in range(4):
+            cas.put(_fp(i), _entry(i))
+        report = cas_gc.collect(str(tmp_path), budget=1, apply=False)
+        assert report.dry_run and report.evicted
+        for i in range(4):
+            assert cas.get(_fp(i)) is not None  # all still there
+
+    def test_budget_evicts_lru_and_survivors_verify(self, tmp_path):
+        clock = iter(range(1, 1000))
+        cas = DiskCAS(str(tmp_path), payload="text",
+                      clock=lambda: float(next(clock)))
+        for i in range(6):
+            cas.put(_fp(i), _entry(i))
+        cas.get(_fp(0))  # 0 becomes the most recently used
+        per_entry = cas.usage_bytes() // 6
+        report = cas.gc(budget=3 * per_entry + 10, apply=True)
+        assert report.evicted
+        assert _fp(0) not in report.evicted  # MRU survives
+        assert cas.usage_bytes() <= 3 * per_entry + 10
+        # Survivors decode + CRC-verify; evicted fingerprints are misses.
+        for i in range(6):
+            got = cas.get(_fp(i))
+            if _fp(i) in report.evicted:
+                assert got is None
+            else:
+                np.testing.assert_array_equal(got.grid, _entry(i).grid)
+
+    def test_put_enforces_budget_inline(self, tmp_path):
+        clock = iter(range(1, 10000))
+        cas = DiskCAS(str(tmp_path), payload="text", max_bytes=2500,
+                      clock=lambda: float(next(clock)))
+        for i in range(20):
+            cas.put(_fp(i), _entry(i))
+            assert cas.usage_bytes() <= 2500
+        # Zipf-ish reuse: the hot entry keeps surviving...
+        for i in range(20, 30):
+            assert cas.get(_fp(i - 1)) is not None  # most recent still hit
+            cas.put(_fp(i), _entry(i))
+        # ...and nothing ever corrupts: every present entry verifies.
+        alive = sum(1 for i in range(30) if cas.get(_fp(i)) is not None)
+        assert 0 < alive < 30  # degraded hit ratio, bounded bytes
+
+    def test_kill_mid_evict_leaves_orphan_next_sweep_collects(self,
+                                                              tmp_path):
+        cas = DiskCAS(str(tmp_path), payload="packed")
+        for i in range(3):
+            cas.put(_fp(i), _entry(i))
+        faults.install(FaultPlan(kill_during_cas_gc=1))
+        with pytest.raises(InjectedCrash):
+            cas.gc(budget=1, apply=True)
+        faults.clear()
+        entries, _mtimes, orphans = cas_gc.scan(str(tmp_path))
+        assert orphans  # the victim's sidecar, meta already gone
+        assert len(entries) == 2
+        report = cas_gc.collect(str(tmp_path), None, apply=True)
+        assert report.orphan_bytes > 0
+        _entries2, _m2, orphans2 = cas_gc.scan(str(tmp_path))
+        assert not orphans2
+        # The two untouched entries still serve.
+        alive = sum(1 for i in range(3) if cas.get(_fp(i)) is not None)
+        assert alive == 2
+
+
+# ---------------------------------------------------------------------------
+# The disk-pressure watchdog
+
+
+class TestDiskGuard:
+    def _guard(self, tmp_path, free, **kwargs):
+        state = {"free": free}
+        g = diskguard.DiskGuard(
+            str(tmp_path), admission_bytes=1000,
+            free_fn=lambda: state["free"], **kwargs,
+        )
+        return g, state
+
+    def test_watermark_ordering_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="order"):
+            diskguard.DiskGuard(str(tmp_path), admission_bytes=1000,
+                                checkpoint_bytes=500)
+        with pytest.raises(ValueError, match=">= 1"):
+            diskguard.DiskGuard(str(tmp_path), admission_bytes=0)
+
+    def test_degrades_in_order_and_recovers_with_hysteresis(self, tmp_path):
+        g, state = self._guard(tmp_path, 10_000)
+        assert g.tick() == diskguard.OK
+        assert g.allow_cas_writes() and g.allow_checkpoints()
+        state["free"] = 3500  # < cas (4000)
+        assert g.tick() == diskguard.SHED_CAS
+        assert not g.allow_cas_writes() and g.allow_checkpoints()
+        state["free"] = 1500  # < checkpoint (2000)
+        assert g.tick() == diskguard.SHED_CHECKPOINTS
+        assert not g.allow_checkpoints() and not g.refuse_admission()
+        state["free"] = 900  # < admission (1000)
+        assert g.tick() == diskguard.REFUSE_ADMISSION
+        assert g.refuse_admission()
+        # Recovery: just above a watermark is NOT enough (hysteresis)...
+        state["free"] = 1100
+        assert g.tick() == diskguard.REFUSE_ADMISSION
+        # ...but past watermark * 1.25 the level steps back out, and a big
+        # jump recovers multiple tiers at once.
+        state["free"] = 1300
+        assert g.tick() == diskguard.SHED_CHECKPOINTS
+        state["free"] = 100_000
+        assert g.tick() == diskguard.OK
+        assert g.allow_cas_writes()
+
+    def test_skips_straight_to_deepest_level(self, tmp_path):
+        g, state = self._guard(tmp_path, 10_000)
+        g.tick()
+        state["free"] = 10
+        assert g.tick() == diskguard.REFUSE_ADMISSION
+
+    def test_transitions_export_and_ring_records(self, tmp_path):
+        ring_dir = str(tmp_path / "ring")
+        history = obs_history.HistoryWriter(ring_dir, source="test")
+        from gol_tpu.serve.metrics import Metrics
+
+        metrics = Metrics()
+        g, state = self._guard(tmp_path, 10_000, registry=metrics,
+                               history=history)
+        g.tick()
+        state["free"] = 500
+        g.tick()
+        state["free"] = 100_000
+        g.tick()
+        history.close()
+        snap = metrics.snapshot()
+        assert snap["counters"]["disk_guard_transitions_total"] == 2
+        assert snap["gauges"]["disk_free_bytes"] == 100_000
+        assert snap["gauges"]["disk_pressure_level"] == 0
+        records = [r["diskguard"] for r in obs_history.read_records(ring_dir)
+                   if "diskguard" in r]
+        assert [(r["from"], r["to"]) for r in records] == [
+            ("ok", "refuse-admission"), ("refuse-admission", "ok")]
+        assert records[0]["free_bytes"] == 500
+
+    def test_failing_read_holds_level(self, tmp_path):
+        calls = {"n": 0}
+
+        def free():
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise OSError("statvfs broke")
+            return 500
+
+        g = diskguard.DiskGuard(str(tmp_path), admission_bytes=1000,
+                                free_fn=free)
+        assert g.tick() == diskguard.REFUSE_ADMISSION
+        assert g.tick() == diskguard.REFUSE_ADMISSION  # held, not reset
+
+
+# ---------------------------------------------------------------------------
+# Serving under disk pressure (single worker + the fleet matrix)
+
+
+class TestServeDiskPressure:
+    def test_507_refuses_new_while_inflight_completes(self, tmp_path):
+        srv = GolServer(port=0, journal_dir=str(tmp_path / "j"),
+                        disk_reserve=1 << 20, sample_interval=0,
+                        flush_age=0.2)
+        free = {"v": 10 << 30}
+        srv.disk_guard._free_fn = lambda: free["v"]
+        srv.start()
+        try:
+            board = text_grid.generate(16, 16, seed=5)
+            body = {"width": 16, "height": 16,
+                    "cells": text_grid.encode(board).decode("ascii"),
+                    "gen_limit": 200}
+            code, payload = _http("POST", srv.url + "/jobs", body)
+            assert code == 202
+            accepted = payload["id"]
+            # The disk fills while the job is queued/running.
+            free["v"] = 10
+            srv.storage_tick()
+            code, payload = _http("POST", srv.url + "/jobs", body)
+            assert code == 507
+            assert payload["partition"] == str(tmp_path / "j")
+            assert payload["free_bytes"] == 10
+            # The ACCEPTED job still terminates and its done record lands.
+            assert _wait(lambda: _http(
+                "GET", f"{srv.url}/jobs/{accepted}")[1].get("state")
+                == "done")
+            # Space returns: admission recovers unattended.
+            free["v"] = 10 << 30
+            srv.storage_tick()
+            code, _ = _http("POST", srv.url + "/jobs", body)
+            assert code == 202
+        finally:
+            srv.shutdown()
+        state = _replay_state(str(tmp_path / "j"))
+        assert accepted in state.results  # the done record landed
+        assert state.torn_lines == 0
+
+    def test_fleet_with_one_full_disk_partition(self, tmp_path):
+        """The chaos-matrix acceptance, in-process: one starved worker
+        answers 507 through the router, the other keeps serving, zero torn
+        records anywhere, and the fleet recovers unattended."""
+        from gol_tpu.fleet.router import RouterServer
+        from gol_tpu.fleet.workers import Fleet
+
+        workers, frees = {}, {}
+        for wid in ("w0", "w1"):
+            srv = GolServer(port=0, journal_dir=str(tmp_path / wid),
+                            disk_reserve=1 << 20, sample_interval=0,
+                            flush_age=0.01)
+            frees[wid] = {"v": 10 << 30}
+            srv.disk_guard._free_fn = (
+                lambda st=frees[wid]: st["v"])
+            srv.start()
+            workers[wid] = srv
+        fleet = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            fleet.attach(srv.url, wid)
+        router = RouterServer(fleet, port=0)
+        router.start()
+        try:
+            base = router.url
+            # Find sizes owned by DIFFERENT workers while everything is
+            # healthy (HRW is deterministic; probe until both appear).
+            owner = {}
+            ids = []
+            for side in (32, 30, 64, 62, 96, 94):
+                board = text_grid.generate(side, side, seed=side)
+                code, payload = _http("POST", base + "/jobs", {
+                    "width": side, "height": side,
+                    "cells": text_grid.encode(board).decode("ascii"),
+                    "gen_limit": 5,
+                })
+                assert code == 202
+                owner[side] = payload["worker"]
+                ids.append(payload["id"])
+                if len(set(owner.values())) == 2:
+                    break
+            assert _wait(lambda: all(
+                _http("GET", f"{base}/jobs/{j}")[1].get("state") == "done"
+                for j in ids))
+            assert len(set(owner.values())) == 2, owner
+            # Starve ONE partition; keep a size the other worker owns as
+            # the healthy control.
+            starved_side, starved = next(iter(owner.items()))
+            healthy_side = next(
+                s for s, w in owner.items() if w != starved)
+            frees[starved]["v"] = 0
+            workers[starved].storage_tick()
+            board = text_grid.generate(starved_side, starved_side, seed=9)
+            code, payload = _http("POST", base + "/jobs", {
+                "width": starved_side, "height": starved_side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": 5,
+            })
+            assert code == 507, payload  # propagated, names the partition
+            assert payload["partition"] == str(tmp_path / starved)
+            # The OTHER worker's buckets still serve.
+            board = text_grid.generate(healthy_side, healthy_side, seed=10)
+            code, payload = _http("POST", base + "/jobs", {
+                "width": healthy_side, "height": healthy_side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": 5,
+            })
+            assert code == 202, (payload, owner)
+            assert payload["worker"] == owner[healthy_side]
+            # Fleet-merged gauges: free bytes by MIN, level by MAX.
+            code, snap = _http("GET", base + "/metrics?format=json")
+            assert code == 200
+            assert snap["gauges"]["disk_free_bytes"] == 0
+            assert snap["gauges"]["disk_pressure_level"] == 3
+            # Space returns on the starved partition: recovery, unattended.
+            frees[starved]["v"] = 10 << 30
+            workers[starved].storage_tick()
+            board = text_grid.generate(starved_side, starved_side, seed=11)
+            code, payload = _http("POST", base + "/jobs", {
+                "width": starved_side, "height": starved_side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": 5,
+            })
+            assert code == 202
+        finally:
+            router.shutdown(cascade=False)
+            for srv in workers.values():
+                srv.shutdown()
+        for wid in workers:
+            state = _replay_state(str(tmp_path / wid))
+            assert state.torn_lines == 0  # zero torn records anywhere
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: --checkpoint-keep pruning vs the async writer
+
+
+def _np_codec():
+    from gol_tpu.resilience.checkpoint import PayloadCodec
+
+    return PayloadCodec(
+        format="npy", suffix=".npy",
+        write=lambda path, state: np.save(path, np.asarray(state)),
+        read=lambda path: np.load(path),
+    )
+
+
+def _grid(seed, h=8, w=8):
+    return np.random.default_rng(seed).integers(
+        0, 2, size=(h, w)).astype(np.uint8)
+
+
+def _assert_no_dangling_manifest(ckdir):
+    for name in os.listdir(ckdir):
+        if name.endswith(".manifest.json"):
+            with open(os.path.join(ckdir, name)) as f:
+                manifest = json.load(f)
+            assert os.path.exists(os.path.join(ckdir, manifest["payload"]))
+
+
+class TestCheckpointPrune:
+    def _mgr(self, tmp_path, **kwargs):
+        from gol_tpu.resilience.checkpoint import CheckpointManager
+
+        return CheckpointManager(str(tmp_path), height=8, width=8,
+                                 codec=_np_codec(), **kwargs)
+
+    def test_sync_prune_behind_commit(self, tmp_path):
+        mgr = self._mgr(tmp_path, keep=2)
+        for gen in (2, 4, 6, 8):
+            mgr.save(_grid(gen), gen, 0)
+        gens = mgr._list_generations()
+        assert gens == [8, 6]
+        _assert_no_dangling_manifest(str(tmp_path))
+
+    def test_async_writer_prunes_after_deferred_commit(self, tmp_path):
+        from gol_tpu.pipeline.writer import AsyncCheckpointWriter
+
+        mgr = self._mgr(tmp_path, keep=1)
+        writer = AsyncCheckpointWriter(mgr)
+        try:
+            for gen in (2, 4, 6):
+                writer.save(_grid(gen), gen, 0)
+            writer.drain()
+        finally:
+            writer.close()
+        assert mgr._list_generations() == [6]
+        _assert_no_dangling_manifest(str(tmp_path))
+        state, info = mgr.restore()
+        np.testing.assert_array_equal(np.asarray(state), _grid(6))
+        assert info.generation == 6
+
+    def test_kill_during_prune_restores_newest(self, tmp_path):
+        """The kill-during-prune crash window: manifest deleted, payload
+        orphaned mid-prune. The newest checkpoint must restore
+        byte-identically, no manifest may dangle, and the next prune
+        sweeps the orphan."""
+        mgr = self._mgr(tmp_path, keep=1)
+        mgr.save(_grid(2), 2, 0)
+        faults.install(FaultPlan(kill_during_prune=1))
+        with pytest.raises(InjectedCrash):
+            mgr.save(_grid(4), 4, 0)
+        faults.clear()
+        _assert_no_dangling_manifest(str(tmp_path))
+        state, info = mgr.restore()
+        assert info.generation == 4  # the commit preceded the prune
+        np.testing.assert_array_equal(np.asarray(state), _grid(4))
+        # The orphaned payload of generation 2 is swept by the next save.
+        mgr.save(_grid(6), 6, 0)
+        leftovers = [n for n in os.listdir(str(tmp_path))
+                     if "00000002" in n or "00000004" in n]
+        assert not leftovers
+        state, info = mgr.restore()
+        assert info.generation == 6
+
+    def test_kill_during_prune_async_lane(self, tmp_path):
+        from gol_tpu.pipeline.writer import AsyncCheckpointWriter
+
+        mgr = self._mgr(tmp_path, keep=1)
+        writer = AsyncCheckpointWriter(mgr)
+        faults.install(FaultPlan(kill_during_prune=1))
+        try:
+            writer.save(_grid(2), 2, 0)
+            writer.save(_grid(4), 4, 0)
+            with pytest.raises(InjectedCrash):
+                writer.drain()  # gen 4 commits, then the prune dies
+        finally:
+            writer.close()
+            faults.clear()
+        _assert_no_dangling_manifest(str(tmp_path))
+        state, info = self._mgr(tmp_path, keep=1).restore()
+        assert info.generation == 4
+        np.testing.assert_array_equal(np.asarray(state), _grid(4))
+
+    def test_guard_sheds_saves_under_pressure(self, tmp_path):
+        free = {"v": 10 << 30}
+        guard = diskguard.DiskGuard(str(tmp_path), admission_bytes=1000,
+                                    free_fn=lambda: free["v"])
+        mgr = self._mgr(tmp_path, keep=2, guard=guard)
+        mgr.save(_grid(2), 2, 0)
+        free["v"] = 1500  # below the checkpoint watermark (2000)
+        mgr.save(_grid(4), 4, 0)  # shed: no new checkpoint
+        assert mgr._list_generations() == [2]
+        state, info = mgr.restore()
+        assert info.generation == 2  # the previous one remains the anchor
+        free["v"] = 10 << 30
+        mgr.save(_grid(6), 6, 0)  # recovered
+        assert 6 in mgr._list_generations()
+
+
+# ---------------------------------------------------------------------------
+# The real thing: a `gol serve` subprocess SIGKILLed mid-compaction
+
+
+def _boot_serve(tmp_path, journal_dir, env_extra=None, *extra_args):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu", "serve", "--port", "0",
+         "--journal-dir", journal_dir,
+         "--journal-segment-bytes", "600",
+         "--sample-interval", "0.2",
+         "--flush-age", "0.01", *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    url = None
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("serving on "):
+            url = line.split("serving on ", 1)[1].strip()
+            break
+    assert url, "serve subprocess never printed its URL"
+    return proc, url
+
+
+@pytest.mark.parametrize("stage", ["snapshot", "retire"])
+def test_sigkill_mid_compaction_replays_exactly_once(tmp_path, stage):
+    """End to end with a REAL process and a REAL SIGKILL: the server
+    rotates segments under load, the fault plan SIGKILLs it at a
+    compaction boundary, and the restart must serve every accepted job's
+    result exactly once, byte-identical to the oracle."""
+    journal_dir = str(tmp_path / "j")
+    proc, url = _boot_serve(
+        tmp_path, journal_dir,
+        {"GOL_FAULTS":
+         f"kill_during_compaction={stage},kill_mode=sigkill"},
+    )
+    boards = {}
+    try:
+        for i in range(10):
+            board = text_grid.generate(16, 16, seed=200 + i)
+            code, payload = _http("POST", url + "/jobs", {
+                "width": 16, "height": 16,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": 8,
+            }, timeout=60)
+            assert code == 202
+            boards[payload["id"]] = board
+        # The sampler tick compacts once the queue quiets — and dies there.
+        assert _wait(lambda: proc.poll() is not None, timeout=60), \
+            "the injected SIGKILL never fired"
+        assert proc.poll() == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+        proc.wait()
+    # Restart, faults disarmed: replay + finish everything.
+    proc, url = _boot_serve(tmp_path, journal_dir)
+    try:
+        def all_done():
+            return all(
+                _http("GET", f"{url}/jobs/{j}")[1].get("state") == "done"
+                for j in boards)
+        assert _wait(all_done, timeout=120)
+        for job_id, board in boards.items():
+            code, result = _http("GET", f"{url}/result/{job_id}")
+            assert code == 200
+            want = oracle.run(board, GameConfig(gen_limit=8))
+            got = text_grid.decode(result["grid"].encode("ascii"), 16, 16)
+            np.testing.assert_array_equal(got, want.grid)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        proc.stdout.close()
+    # Exactly-once audit over the replay-visible record set (the one
+    # enumeration auditors use: compaction.iter_records).
+    state = _replay_state(journal_dir)
+    assert state.results.keys() == set(boards)
+    assert not state.pending and state.torn_lines == 0
+    done_counts = {}
+    for rec in compaction.iter_records(journal_dir):
+        if rec.get("event") == "done":
+            done_counts[rec["id"]] = done_counts.get(rec["id"], 0) + 1
+    assert set(done_counts) == set(boards)
+    assert all(n == 1 for n in done_counts.values()), done_counts

@@ -114,22 +114,19 @@ def _fleet_workers(base: str) -> list:
 
 
 def _count_done(fleet_dir: str) -> dict:
-    """id -> [(partition, record)] across every partition journal."""
+    """id -> [(partition, record)] across every partition journal —
+    enumerated via compaction.iter_records (snapshot + sealed segments +
+    live file), so the audit survives journal rotation/compaction."""
+    from gol_tpu.serve import compaction
+
     done: dict = {}
     for name in sorted(os.listdir(fleet_dir)):
-        path = os.path.join(fleet_dir, name, "journal.jsonl")
-        if not os.path.isfile(path):
+        part = os.path.join(fleet_dir, name)
+        if not os.path.isfile(os.path.join(part, "journal.jsonl")):
             continue
-        with open(path, "rb") as f:
-            for line in f.read().split(b"\n"):
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if rec.get("event") == "done":
-                    done.setdefault(rec["id"], []).append((name, rec))
+        for rec in compaction.iter_records(part):
+            if rec.get("event") == "done":
+                done.setdefault(rec["id"], []).append((name, rec))
     return done
 
 

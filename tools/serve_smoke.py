@@ -220,21 +220,17 @@ def main(argv=None) -> int:
 
 
 def _count_done(journal_dir: str) -> dict:
-    """id -> [done records] from the journal (tolerates a torn tail)."""
-    path = os.path.join(journal_dir, "journal.jsonl")
+    """id -> [done records], enumerated via compaction.iter_records
+    (snapshot + sealed segments + live file) so the audit survives
+    journal rotation/compaction; torn tails tolerated as ever."""
+    from gol_tpu.serve import compaction
+
     done: dict = {}
-    if not os.path.exists(path):
+    if not os.path.exists(os.path.join(journal_dir, "journal.jsonl")):
         return done
-    with open(path, "rb") as f:
-        for line in f.read().split(b"\n"):
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("event") == "done":
-                done.setdefault(rec["id"], []).append(rec)
+    for rec in compaction.iter_records(journal_dir):
+        if rec.get("event") == "done":
+            done.setdefault(rec["id"], []).append(rec)
     return done
 
 

@@ -191,17 +191,15 @@ def check_sigkill_resume() -> None:
                     f"post-SIGKILL result differs on {key!r}: "
                     f"{str(out.get(key))[:80]} != {str(ref.get(key))[:80]}"
                 )
-        # Exactly one done record for the id across the whole journal.
+        # Exactly one done record for the id across the whole journal
+        # (compaction.iter_records: snapshot + sealed segments + live
+        # file, so the audit survives rotation/compaction).
+        from gol_tpu.serve import compaction
+
         done = 0
-        with open(os.path.join(journal, "journal.jsonl"),
-                  encoding="utf-8") as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if rec.get("event") == "done" and rec.get("id") == job_id:
-                    done += 1
+        for rec in compaction.iter_records(journal):
+            if rec.get("event") == "done" and rec.get("id") == job_id:
+                done += 1
         if done != 1:
             fail(f"{done} done records for {job_id} (want exactly 1)")
         print(

@@ -1,0 +1,330 @@
+"""Storage-lifecycle smoke: quota pressure, tiered shedding, compaction,
+SIGKILL-mid-compaction, exactly-once audit — end to end.
+
+The `make storage-smoke` harness, exercising the ISSUE-15 acceptance
+against real processes and real files:
+
+1. **Shed order** (in-process server, injected free-bytes): as the
+   partition "fills", the watchdog degrades in order — CAS writes shed
+   first (cache hit ratio sacrificed, results still served), then
+   admission refuses with 507 naming the partition — and every tier
+   recovers unattended when space returns;
+2. **compaction frees space**: a churn load on a segment-rotating journal
+   compacts down to snapshot + live file, replaying state-identical to
+   the unbounded log;
+3. **SIGKILL mid-compaction** (real `gol serve` subprocess, real signal):
+   the fault plan SIGKILLs the server at the compaction retire boundary;
+   the restart must finish every accepted job with EXACTLY one done
+   record per id across the replay-visible record set, every sampled
+   result byte-identical to the NumPy oracle.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/storage_smoke.py [--jobs 12]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu import oracle  # noqa: E402
+from gol_tpu.config import GameConfig  # noqa: E402
+from gol_tpu.io import text_grid  # noqa: E402
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def _wait(predicate, timeout=120.0, interval=0.05):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def fail(msg):
+    print(f"storage-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _submit_board(url, board, gen_limit):
+    return _http("POST", url + "/jobs", {
+        "width": board.shape[1], "height": board.shape[0],
+        "cells": text_grid.encode(board).decode("ascii"),
+        "gen_limit": gen_limit,
+    })
+
+
+# ---------------------------------------------------------------------------
+# Phase 1: shed order + unattended recovery (in-process, injected free bytes)
+
+
+def phase_shed_order(workdir):
+    from gol_tpu.serve.server import GolServer
+
+    print("phase 1: watchdog sheds in order, recovers unattended",
+          flush=True)
+    journal_dir = os.path.join(workdir, "shed")
+    srv = GolServer(port=0, journal_dir=journal_dir, result_cache=True,
+                    cache_dir=os.path.join(journal_dir, "cache"),
+                    disk_reserve=1 << 20, sample_interval=0,
+                    flush_age=0.01)
+    free = {"v": 10 << 30}
+    srv.disk_guard._free_fn = lambda: free["v"]
+    srv.start()
+    try:
+        board = text_grid.generate(32, 32, seed=1)
+        code, payload = _submit_board(srv.url, board, 20)
+        if code != 202:
+            fail(f"healthy submit answered {code}")
+        first = payload["id"]
+        if not _wait(lambda: _http(
+                "GET", f"{srv.url}/jobs/{first}")[1].get("state") == "done"):
+            fail("healthy job never finished")
+
+        # Tier 1: below the CAS watermark — writes shed, service healthy.
+        free["v"] = 3 << 20
+        srv.storage_tick()
+        if srv.disk_guard.level_name != "shed-cas":
+            fail(f"expected shed-cas, got {srv.disk_guard.level_name}")
+        board2 = text_grid.generate(32, 32, seed=2)
+        code, payload = _submit_board(srv.url, board2, 20)
+        if code != 202:
+            fail(f"submit under shed-cas answered {code}")
+        jid = payload["id"]
+        if not _wait(lambda: _http(
+                "GET", f"{srv.url}/jobs/{jid}")[1].get("state") == "done"):
+            fail("job under shed-cas never finished")
+        shed = srv.metrics.snapshot()["counters"].get(
+            "cas_writes_shed_total", 0)
+        if not shed:
+            fail("no CAS write was shed under pressure")
+
+        # Tier 3: below the admission watermark — 507, in-flight lands.
+        code, payload = _submit_board(srv.url, text_grid.generate(
+            32, 32, seed=3), 500)
+        if code != 202:
+            fail(f"pre-starve submit answered {code}")
+        inflight = payload["id"]
+        free["v"] = 1000
+        srv.storage_tick()
+        code, payload = _submit_board(srv.url, board, 20)
+        if code != 507:
+            fail(f"expected 507 under full disk, got {code}")
+        if payload.get("partition") != journal_dir:
+            fail(f"507 body does not name the partition: {payload}")
+        if payload.get("free_bytes") != 1000:
+            fail(f"507 body does not carry free bytes: {payload}")
+        if not _wait(lambda: _http(
+                "GET",
+                f"{srv.url}/jobs/{inflight}")[1].get("state") == "done"):
+            fail("in-flight job did not land during admission refusal")
+
+        # Space returns: recovery with NO operator action.
+        free["v"] = 10 << 30
+        srv.storage_tick()
+        code, _payload = _submit_board(srv.url, board, 20)
+        if code != 202:
+            fail(f"admission did not recover: {code}")
+        transitions = srv.metrics.snapshot()["counters"].get(
+            "disk_guard_transitions_total", 0)
+        print(f"  shed order OK ({int(shed)} CAS write(s) shed, "
+              f"{int(transitions)} guard transition(s), 507 body named "
+              f"the partition)", flush=True)
+    finally:
+        srv.shutdown()
+    from gol_tpu.serve.jobs import JobJournal
+
+    state = JobJournal(journal_dir, segment_bytes=0).replay()
+    if state.torn_lines:
+        fail(f"torn records after pressure cycling: {state.torn_lines}")
+
+
+# ---------------------------------------------------------------------------
+# Phase 2: compaction frees space, replay identical
+
+
+def phase_compaction(workdir):
+    from gol_tpu.serve import compaction
+    from gol_tpu.serve.jobs import JobJournal, JobResult, new_job
+
+    print("phase 2: compaction frees space, replay identical", flush=True)
+    journal_dir = os.path.join(workdir, "compact")
+    journal = JobJournal(journal_dir, segment_bytes=2048)
+    for i in range(40):
+        job = new_job(16, 16, text_grid.generate(16, 16, seed=i))
+        journal.record_submit(job)
+        job.result = JobResult(grid=text_grid.generate(16, 16, seed=500 + i),
+                               generations=i, exit_reason="gen_limit")
+        journal.record_done(job)
+    before_bytes = journal.bytes_on_disk()
+    before = JobJournal(journal_dir, segment_bytes=0).replay()
+    report = journal.compact()
+    journal.close()
+    if not report.compacted:
+        fail("compaction found nothing to fold")
+    after = JobJournal(journal_dir, segment_bytes=0).replay()
+    if after.results.keys() != before.results.keys():
+        fail("compaction changed the replayed result set")
+    for k in after.results:
+        if not np.array_equal(after.results[k].grid, before.results[k].grid):
+            fail(f"compaction changed result bytes for {k}")
+    if report.bytes_after >= before_bytes:
+        fail(f"compaction freed nothing ({before_bytes} -> "
+             f"{report.bytes_after})")
+    if compaction.sealed_segments(journal_dir):
+        fail("sealed segments survived compaction")
+    print(f"  compacted {report.segments_retired} segment(s): "
+          f"{before_bytes} -> {report.bytes_after} bytes, "
+          f"replay identical", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Phase 3: SIGKILL mid-compaction on a real server, exactly-once audit
+
+
+def _boot(journal_dir, faults_spec=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    if faults_spec:
+        env["GOL_FAULTS"] = faults_spec
+    else:
+        env.pop("GOL_FAULTS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu", "serve", "--port", "0",
+         "--journal-dir", journal_dir,
+         "--journal-segment-bytes", "600",
+         "--sample-interval", "0.2", "--flush-age", "0.01"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    url = None
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("serving on "):
+            url = line.split("serving on ", 1)[1].strip()
+            break
+    if not url:
+        proc.kill()
+        fail("serve subprocess never printed its URL")
+    return proc, url
+
+
+def phase_sigkill(workdir, njobs):
+    print("phase 3: SIGKILL mid-compaction, restart, exactly-once audit",
+          flush=True)
+    journal_dir = os.path.join(workdir, "kill")
+    proc, url = _boot(
+        journal_dir, "kill_during_compaction=retire,kill_mode=sigkill")
+    boards = {}
+    try:
+        for i in range(njobs):
+            board = text_grid.generate(16, 16, seed=300 + i)
+            code, payload = _submit_board(url, board, 8)
+            if code != 202:
+                fail(f"submit {i} answered {code}")
+            boards[payload["id"]] = board
+        if not _wait(lambda: proc.poll() is not None, timeout=60):
+            fail("the injected SIGKILL never fired")
+        if proc.poll() != -signal.SIGKILL:
+            fail(f"server exited {proc.poll()}, expected SIGKILL")
+        print(f"  server SIGKILLed at the compaction retire boundary "
+              f"({len(boards)} job(s) accepted)", flush=True)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+        proc.wait()
+
+    proc, url = _boot(journal_dir)
+    try:
+        def all_done():
+            return all(_http("GET", f"{url}/jobs/{j}")[1].get("state")
+                       == "done" for j in boards)
+        if not _wait(all_done):
+            fail("restart did not finish every accepted job")
+        for job_id, board in list(boards.items())[:5]:
+            code, result = _http("GET", f"{url}/result/{job_id}")
+            if code != 200:
+                fail(f"result fetch for {job_id} answered {code}")
+            want = oracle.run(board, GameConfig(gen_limit=8))
+            got = text_grid.decode(result["grid"].encode("ascii"), 16, 16)
+            if not np.array_equal(got, want.grid):
+                fail(f"result for {job_id} differs from the oracle")
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        proc.stdout.close()
+
+    # Exactly-once audit over the replay-visible record set (snapshot +
+    # segments newer than it + the live file: compaction.iter_records).
+    from gol_tpu.serve import compaction
+    from gol_tpu.serve.jobs import JobJournal
+
+    state = JobJournal(journal_dir, segment_bytes=0).replay()
+    if state.results.keys() != set(boards):
+        fail(f"replay results {len(state.results)} != accepted "
+             f"{len(boards)}")
+    if state.pending or state.torn_lines:
+        fail(f"replay left pending={len(state.pending)} "
+             f"torn={state.torn_lines}")
+    done_counts = {}
+    for rec in compaction.iter_records(journal_dir):
+        if rec.get("event") == "done":
+            done_counts[rec["id"]] = done_counts.get(rec["id"], 0) + 1
+    if set(done_counts) != set(boards):
+        fail("done-record id set differs from the accepted set")
+    dupes = {k: n for k, n in done_counts.items() if n != 1}
+    if dupes:
+        fail(f"done records not exactly-once: {dupes}")
+    print(f"  exactly-once audit OK: {len(done_counts)} done record(s), "
+          f"one per accepted job, oracle-identical samples", flush=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=12,
+                        help="jobs for the SIGKILL phase (default 12)")
+    args = parser.parse_args()
+    workdir = tempfile.mkdtemp(prefix="gol-storage-smoke-")
+    try:
+        phase_shed_order(workdir)
+        phase_compaction(workdir)
+        phase_sigkill(workdir, args.jobs)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print("storage-smoke: PASS", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
