@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke autoscale-smoke chaos-smoke storage-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke autoscale-smoke chaos-smoke storage-smoke control-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -59,6 +59,14 @@ bench:
 # check are exit-code gated inside the suite itself:
 #   make bench-diff OLD=BENCH_r17.json NEW=/tmp/BENCH_r17.json \
 #       METRIC=lanes.compaction_on.jobs_per_sec
+# The control suite's CI gate rides the two-replica lane's forward
+# throughput leaf (higher is better) — a router-tier regression fails
+# even when the single-router baseline moved with it; the >= 1.8x
+# routers2/routers1 scaling floor is exit-code gated inside the suite
+# itself (enforced on hosts with >= 3 usable cores — see the artifact's
+# gate stamp):
+#   make bench-diff OLD=BENCH_r18.json NEW=/tmp/BENCH_r18.json \
+#       METRIC=lanes.routers2.forwards_per_sec
 bench-diff:
 	@test -n "$(OLD)" && test -n "$(NEW)" || \
 		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1] [METRIC=dot.path]"; exit 2; }
@@ -164,6 +172,15 @@ chaos-smoke:
 # every accepted job with exactly one done record, oracle-identical.
 storage-smoke:
 	python3 tools/storage_smoke.py
+
+# Control-plane failover smoke (tools/control_smoke.py): a real 2-worker
+# `gol fleet --routers 2` takes half its load alternating across both
+# routers, the lease-holding router is SIGKILLed mid-load (the survivor
+# must win the flock lease, respawn a SIGKILLed worker, and place the
+# rest of the load), and the exactly-once audit spans every partition
+# journal through both kills.
+control-smoke:
+	python3 tools/control_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

@@ -2851,7 +2851,261 @@ def _bench_storage(args) -> int:
     return 0 if (ratio >= 0.97 and bounded) else 1
 
 
+def _bench_control(args) -> int:
+    """Horizontal control plane suite (--suite control) -> BENCH_r18.json.
+
+    The router-tier scaling question: does adding a router replica add
+    FORWARD throughput? One real fleet — 4 `gol serve` worker
+    subprocesses behind `gol fleet --routers 2` — takes a small batch of
+    jobs to completion, then a fixed client pool hammers the read
+    forward path (`GET /jobs/<id>`: router -> owning worker -> back,
+    the cheapest request that still exercises the full proxy hop) in
+    two lanes:
+
+    - **routers1**: every client thread targets the primary router
+      alone — the single-router ceiling (one ThreadingHTTPServer
+      process, ~one core of parse/forward/serialize);
+    - **routers2**: the same pool splits round-robin across both
+      replicas — both read the same manifest, either can look up any
+      job, so the tier's capacity should approach 2x.
+
+    The routers are real subprocesses (the lanes must scale across
+    PROCESSES, not threads under one GIL); the 4 workers leave the
+    worker tier with comfortable headroom so the router is the
+    bottleneck in both lanes. Every measured GET must return 200 — an
+    error-count gate keeps a flaky lane from inflating the ratio.
+
+    Headline: routers2/routers1 forwards/sec (the replication
+    acceptance, >= 1.8x). Per-lane forwards/sec recorded for
+    `tools/bench_diff.py --metric` gating (CI gates
+    --metric lanes.routers2.forwards_per_sec). rc 0 iff the headline
+    clears 1.8 and both lanes are error-free.
+
+    The scaling gate needs a host that can EXPRESS router-tier
+    parallelism: two router processes plus workers plus the client
+    pool require >= 3 usable cores (the fleet suite's taskset-pinned
+    lanes have the same dependency). On a smaller host the two lanes
+    time-slice one core and the ratio measures scheduler overhead, not
+    the tier — the suite still runs both lanes and writes the
+    artifact, but stamps ``gate.enforced: false`` with the reason and
+    gates only on error-free lanes (never silently passes the ratio:
+    the stamp makes a degenerate artifact impossible to misread as a
+    scaling claim).
+    """
+    import concurrent.futures
+    import shutil
+    import signal as _signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    from gol_tpu.fleet import client as fleet_client
+    from gol_tpu.io import text_grid
+
+    repeats = args.repeats
+    gen_limit = args.gen_limit if args.gen_limit is not None else 64
+    side = 32
+    njobs = 16
+    clients = 16
+    window = 2.5  # seconds per measured round
+    workroot = tempfile.mkdtemp(prefix="gol-bench-control-")
+    fleet_dir = os.path.join(workroot, "fleet")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    r0_url = f"http://127.0.0.1:{port}"
+    print(
+        f"bench control: {clients} client threads over {njobs} done jobs, "
+        f"{window}s windows, repeats {repeats}, 4 workers / 2 routers",
+        file=sys.stderr,
+    )
+
+    def _http(method, url, body=None, timeout=30):
+        return fleet_client.http_json(method, url, body, timeout=timeout)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu", "fleet",
+         "--port", str(port), "--workers", "4", "--routers", "2",
+         "--fleet-dir", fleet_dir, "--flush-age", "0.05",
+         "--health-interval", "1.0"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.perf_counter() + 300
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(f"fleet died on boot rc={proc.returncode}")
+            try:
+                status, payload = _http("GET", f"{r0_url}/healthz", timeout=2)
+                if (status == 200
+                        and payload.get("fleet", {}).get("workers") == 4):
+                    break
+            except (OSError, ValueError):
+                pass
+            if time.perf_counter() > deadline:
+                raise RuntimeError("fleet never became healthy")
+            time.sleep(0.2)
+        advert_path = os.path.join(fleet_dir, "routers", "r1", "advert.json")
+        deadline = time.perf_counter() + 120
+        while True:
+            try:
+                with open(advert_path, encoding="utf-8") as f:
+                    r1_url = json.load(f)["url"]
+                status, payload = _http("GET", f"{r1_url}/healthz", timeout=2)
+                if status == 200:
+                    break
+            except (OSError, ValueError, KeyError):
+                pass
+            if time.perf_counter() > deadline:
+                raise RuntimeError("replica r1 never came up")
+            time.sleep(0.2)
+
+        # A small batch of jobs, run to DONE: the lookup targets. The
+        # measured op is read-only, so both lanes forward identical work.
+        ids = []
+        for i in range(njobs):
+            board = text_grid.generate(side, side, seed=8000 + i)
+            status, payload = _http("POST", f"{r0_url}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": gen_limit,
+            })
+            if status != 202:
+                raise RuntimeError(f"submit rejected HTTP {status}")
+            ids.append(payload["id"])
+        deadline = time.perf_counter() + 300
+        pending = set(ids)
+        while pending:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(f"{len(pending)} seed job(s) never DONE")
+            for job_id in list(pending):
+                status, payload = _http("GET", f"{r0_url}/jobs/{job_id}")
+                if status == 200 and payload.get("state") == "done":
+                    pending.discard(job_id)
+            time.sleep(0.05)
+
+        def lane(bases: list) -> dict:
+            stop = threading.Event()
+            counts = [0] * clients
+            errors = [0] * clients
+
+            def worker(k: int) -> None:
+                base = bases[k % len(bases)]
+                job_id = ids[k % len(ids)]
+                n = 0
+                while not stop.is_set():
+                    try:
+                        status, _ = _http(
+                            "GET", f"{base}/jobs/{job_id}", timeout=10)
+                    except (OSError, ValueError):
+                        status = 0
+                    if status == 200:
+                        counts[k] += 1
+                    else:
+                        errors[k] += 1
+                    n += 1
+                    job_id = ids[(k + n * len(bases)) % len(ids)]
+
+            best = None
+            for _ in range(repeats + 1):  # first round doubles as warm-up
+                stop.clear()
+                counts[:] = [0] * clients
+                errors[:] = [0] * clients
+                pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=clients)
+                futs = [pool.submit(worker, k) for k in range(clients)]
+                t0 = time.perf_counter()
+                time.sleep(window)
+                stop.set()
+                for fut in futs:
+                    fut.result()
+                elapsed = time.perf_counter() - t0
+                pool.shutdown()
+                rate = sum(counts) / elapsed
+                if sum(errors):
+                    raise RuntimeError(
+                        f"{sum(errors)} forward(s) failed in a measured "
+                        "round — the lane is not clean")
+                best = rate if best is None else max(best, rate)
+            tag = f"{len(bases)} router(s)"
+            print(f"  {tag}: {best:.0f} forwards/s", file=sys.stderr)
+            return {
+                "routers": len(bases),
+                "forwards_per_sec": round(best, 1),
+                "window_seconds": window,
+                "client_threads": clients,
+            }
+
+        lanes = {
+            "routers1": lane([r0_url]),
+            "routers2": lane([r0_url, r1_url]),
+        }
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(workroot, ignore_errors=True)
+
+    ratio = (lanes["routers2"]["forwards_per_sec"]
+             / lanes["routers1"]["forwards_per_sec"])
+    usable = len(os.sched_getaffinity(0))
+    enforced = usable >= 3
+    print(f"  routers2/routers1 forward ratio {ratio:.2f} "
+          "(acceptance >= 1.8)", file=sys.stderr)
+    if not enforced:
+        print(f"  GATE NOT ENFORCED: {usable} usable core(s) — two router "
+              "processes cannot scale on a time-sliced core; the ratio "
+              "above measures the scheduler, not the tier", file=sys.stderr)
+    payload = {
+        "metric": "routers2_over_routers1_forwards_per_sec",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": None,  # the routers1 lane IS the baseline; floor 1.8
+        "gate": {
+            "floor": 1.8,
+            "enforced": enforced,
+            **({} if enforced else {
+                "reason": f"{usable} usable core(s); router-tier "
+                "parallelism needs >= 3 (2 router processes + workers + "
+                "client pool)"}),
+        },
+        "load": {
+            "jobs": njobs, "grid": f"{side}x{side}", "gen_limit": gen_limit,
+            "client_threads": clients, "window_seconds": window,
+            "workers": 4,
+            "note": "read forward path (GET /jobs/<id>) against DONE jobs "
+            "— router parse/forward/serialize is the measured cost; 4 "
+            "workers keep the worker tier out of the bottleneck",
+        },
+        "lanes": lanes,
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r18.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    return 0 if (ratio >= 1.8 or not enforced) else 1
+
+
 SUITES = {
+    "control": (
+        _bench_control,
+        "horizontal control plane: forward throughput (GET /jobs/<id> "
+        "through the proxy hop) with the client pool on one router vs "
+        "split across two replicas of a real `gol fleet --routers 2` "
+        "(acceptance: routers2 >= 1.8x routers1, error-free lanes; CI "
+        "gates --metric lanes.routers2.forwards_per_sec); writes "
+        "BENCH_r18.json",
+    ),
     "storage": (
         _bench_storage,
         "storage lifecycle: churn-load throughput with journal "

@@ -29,6 +29,7 @@ the loop timer (src/game_mpi_collective.c:278-328).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import re
@@ -1206,6 +1207,7 @@ def _fleet(args) -> int:
     admission stops at the router, every worker drains, local workers get
     SIGTERM, then the router exits."""
     import signal
+    import subprocess
 
     from gol_tpu.fleet.router import RouterServer
     from gol_tpu.fleet.workers import Fleet, core_slice_prefix
@@ -1250,6 +1252,8 @@ def _fleet(args) -> int:
         raise ValueError(
             f"--breaker-cooldown must be >= 0, got {args.breaker_cooldown}"
         )
+    if args.routers < 1:
+        raise ValueError(f"--routers must be >= 1, got {args.routers}")
     if args.retry_budget < 0:
         # Validated BEFORE any worker spawns (the history-flags contract):
         # forwarded verbatim, a negative budget boot-crashes every worker
@@ -1368,12 +1372,37 @@ def _fleet(args) -> int:
         spawn_prefix = core_slice_prefix(args.cores_per_worker)
         spawn_weight = float(args.cores_per_worker)
 
+    from gol_tpu.fleet import replicate
+
     fleet = Fleet(args.fleet_dir, serve_args=serve_args,
                   spawn_prefix=spawn_prefix, spawn_weight=spawn_weight)
     recovered = fleet.load()
     if recovered:
         print(f"reattached {recovered} worker partition(s) from "
               f"{fleet.manifest_path}", flush=True)
+    # This invocation's flags become the manifest's `config` block — the
+    # single source of truth a `gol router` replica boots from (set AFTER
+    # load(), so the operator's current flags supersede a stale block).
+    fleet.manifest_config = {
+        "serve_args": serve_args,
+        "health_interval": args.health_interval,
+        "big_edge": args.big_edge,
+        "cache_route": bool(args.cache_route),
+        "affinity": bool(args.affinity),
+        "breakers": not args.no_breakers,
+        "breaker_cooldown": args.breaker_cooldown,
+        "breaker_slow": args.breaker_slow,
+        "max_queue_depth": args.max_queue_depth,
+        "cores_per_worker": args.cores_per_worker,
+        "autoscale": (dataclasses.asdict(autoscale_cfg)
+                      if autoscale_cfg is not None else None),
+    }
+    # Arm the leader lease BEFORE spawning: normally this primary wins
+    # immediately, but if a surviving replica of a previous incarnation
+    # still holds the lock, the restarted primary joins as a follower for
+    # the single-writer ticks (it still performs this boot's operator-
+    # initiated spawns — the flock serializes the manifest writes).
+    fleet.enable_leader_election(label="r0")
     for url in args.attach or []:
         fleet.attach(url)
     fleet.spawn_fleet(args.workers, big_lane=args.big_lane)
@@ -1381,6 +1410,7 @@ def _fleet(args) -> int:
         raise ValueError(
             "fleet has no workers: pass --workers N and/or --attach URL"
         )
+    fleet.write_manifest()  # persist the config block even when nothing spawned
     fleet.start_health(args.health_interval)
     # The chaos-hardened data path (PR 14): breakers default ON for the
     # CLI fleet (the library RouterServer default stays off/byte-identical
@@ -1411,8 +1441,12 @@ def _fleet(args) -> int:
                 cooldown_s=args.breaker_cooldown,
                 slow_s=args.breaker_slow if args.breaker_slow > 0 else None,
             ),
+            # Per-ROUTER ring (PR 16): each replica is the single writer
+            # of its own `<fleet-dir>/routers/<id>/breaker-history`, and
+            # warm-start merges across all of them.
             "breaker_history": _BreakerRing(
-                os.path.join(args.fleet_dir, "breaker-history"),
+                os.path.join(replicate.state_dir(args.fleet_dir, "r0"),
+                             replicate.BREAKER_RING),
                 source="breaker",
             ),
         }
@@ -1421,6 +1455,8 @@ def _fleet(args) -> int:
                           cache_route=args.cache_route,
                           affinity_route=args.affinity,
                           chaos=chaos_pool,
+                          router_id="r0",
+                          state_dir=replicate.state_dir(args.fleet_dir, "r0"),
                           **breaker_kwargs)
     if not args.no_breakers:
         # Same cadence as the chaos-proxy prune: a retired worker's
@@ -1459,6 +1495,35 @@ def _fleet(args) -> int:
             interval=args.sample_interval,  # validated > 0 above
             total_bytes=args.history_bytes,
         )
+    # --routers N: N-1 extra `gol router` replica subprocesses over the
+    # same --fleet-dir. Replicas are the horizontal CONTROL plane: each
+    # serves the full job API from the shared manifest, contests the
+    # leader lease, and inherits the durable floors/breaker state — so no
+    # single router process is a SPOF. They are deliberately NOT
+    # supervised (no respawn-the-router loop: the operator's init system
+    # owns router lifetimes; the fleet only guarantees any survivor can
+    # carry the whole control plane).
+    replicas: list = []
+    for k in range(1, args.routers):
+        rid = f"r{k}"
+        rdir = replicate.state_dir(args.fleet_dir, rid)
+        os.makedirs(rdir, exist_ok=True)
+        log_path = os.path.join(rdir, "log")
+        log_f = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "gol_tpu", "router",
+                 "--fleet-dir", args.fleet_dir,
+                 "--router-id", rid, "--port", "0"],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                env={**os.environ, "PYTHONUNBUFFERED": "1"},
+            )
+        finally:
+            log_f.close()
+        replicas.append(proc)
+        print(f"router replica {rid} pid={proc.pid} (log: {log_path})",
+              flush=True)
+
     stop = {"signaled": False}
 
     def _on_signal(signum, frame):
@@ -1468,15 +1533,150 @@ def _fleet(args) -> int:
         stop["signaled"] = True
         import threading
 
-        threading.Thread(
-            target=lambda: router.shutdown(cascade=True), daemon=True
-        ).start()
+        def _cascade():
+            # Replicas go FIRST: they hold no worker processes, and
+            # stopping them before the workers drain means no replica
+            # wins the lease mid-cascade and starts "supervising" the
+            # teardown it cannot see.
+            for proc in replicas:
+                if proc.poll() is None:
+                    proc.terminate()
+            router.shutdown(cascade=True)
+            for proc in replicas:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+        threading.Thread(target=_cascade, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
     roster = ", ".join(f"{w.id}={w.url}" for w in fleet.workers())
     print(f"fleet router on {router.url} "
           f"({len(fleet.workers())} workers: {roster})", flush=True)
+    try:
+        router.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _router(args) -> int:
+    """``gol router``: one attachable router replica over a running fleet.
+
+    Boots from the shared manifest alone (`--fleet-dir` is the only
+    coordination channel): adopts the membership and the `config` block
+    the primary recorded, inherits the durable counter floors and breaker
+    evidence under ``<fleet-dir>/routers/``, and contests the leader
+    lease. While following it routes, forwards, and serves lookups like
+    any replica (active-active data plane); if the leader dies, the
+    kernel drops the flock and the next health tick here picks up the
+    single-writer ticks (respawn supervision, scale decisions).
+
+    SIGTERM/SIGINT stop THIS replica only (``cascade=False``): workers
+    belong to the fleet, not to any one router."""
+    import signal
+
+    from gol_tpu.fleet import replicate
+    from gol_tpu.fleet.router import RouterServer
+    from gol_tpu.fleet.workers import Fleet, core_slice_prefix
+
+    if not re.match(r"^[A-Za-z0-9][A-Za-z0-9._-]*$", args.router_id):
+        raise ValueError(
+            f"--router-id must be alphanumeric/._- (got {args.router_id!r})"
+        )
+    manifest = os.path.join(args.fleet_dir, "manifest.json")
+    if not os.path.exists(manifest):
+        raise ValueError(
+            f"no fleet manifest at {manifest}: start "
+            f"`gol fleet --fleet-dir {args.fleet_dir}` first"
+        )
+    fleet = Fleet(args.fleet_dir, replica=True)
+    recovered = fleet.load()
+    cfg = fleet.manifest_config or {}
+    # A replica spawns nothing at boot, but a replica-turned-leader
+    # respawns dead partitions and scales — with the primary's recorded
+    # spawn recipe, not a divergent one.
+    fleet.serve_args = list(cfg.get("serve_args") or [])
+    cores = int(cfg.get("cores_per_worker") or 0)
+    if cores:
+        fleet._spawn_prefix = core_slice_prefix(cores)
+        fleet._spawn_weight = float(cores)
+    leading = fleet.enable_leader_election(label=args.router_id)
+    breaker_kwargs = {}
+    if cfg.get("breakers", True):
+        from gol_tpu.fleet.breaker import BreakerConfig
+        from gol_tpu.obs.history import HistoryWriter as _BreakerRing
+
+        cooldown = float(cfg.get("breaker_cooldown", 5.0))
+        slow = float(cfg.get("breaker_slow", 1.0))
+        breaker_kwargs = {
+            "breakers": True,
+            "breaker_config": BreakerConfig(
+                cooldown_s=cooldown, slow_s=slow if slow > 0 else None,
+            ),
+            "breaker_history": _BreakerRing(
+                os.path.join(
+                    replicate.state_dir(args.fleet_dir, args.router_id),
+                    replicate.BREAKER_RING),
+                source="breaker",
+            ),
+        }
+    router = RouterServer(
+        fleet, host=args.host, port=args.port,
+        big_edge=int(cfg.get("big_edge", 1024)),
+        cache_route=bool(cfg.get("cache_route")),
+        affinity_route=bool(cfg.get("affinity")),
+        router_id=args.router_id,
+        state_dir=replicate.state_dir(args.fleet_dir, args.router_id),
+        **breaker_kwargs)
+    if breaker_kwargs:
+        fleet.add_tick_hook(router.prune_breakers)
+    if isinstance(cfg.get("autoscale"), dict):
+        # Armed but leader-gated: the tick no-ops until THIS replica holds
+        # the lease, then scale decisions continue where the dead leader's
+        # stopped. Its decision ring lives in this replica's own state dir
+        # (single writer per directory), not the primary's legacy path.
+        from gol_tpu.fleet.autoscale import AutoscaleConfig, Autoscaler
+        from gol_tpu.obs.history import HistoryWriter
+
+        try:
+            autoscale_cfg = AutoscaleConfig(**cfg["autoscale"])
+        except (TypeError, ValueError) as err:
+            raise ValueError(
+                f"manifest autoscale config is invalid: {err}") from err
+        autoscaler = Autoscaler(
+            fleet, router, autoscale_cfg,
+            queue_capacity=int(cfg.get("max_queue_depth", 1024)),
+            history=HistoryWriter(
+                os.path.join(
+                    replicate.state_dir(args.fleet_dir, args.router_id),
+                    "autoscaler-history"),
+                source="autoscaler",
+            ),
+        )
+        router.autoscaler = autoscaler
+        fleet.add_tick_hook(autoscaler.tick)
+    fleet.start_health(float(cfg.get("health_interval", 1.0)))
+    stop = {"signaled": False}
+
+    def _on_signal(signum, frame):
+        if stop["signaled"]:
+            raise SystemExit(1)
+        stop["signaled"] = True
+        import threading
+
+        threading.Thread(
+            target=lambda: router.shutdown(cascade=False), daemon=True
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(f"fleet router on {router.url} "
+          f"(replica {args.router_id} over {args.fleet_dir}, "
+          f"{recovered} partition(s) adopted, "
+          f"{'leading' if leading else 'following'})", flush=True)
     try:
         router.serve_forever()
     except KeyboardInterrupt:
@@ -1728,6 +1928,54 @@ def _submit_retry():
     return policy, budget
 
 
+class _ServerRing:
+    """The ``--servers A,B,C`` failover ring: every base is a router
+    REPLICA over one fleet (shared manifest — any replica can place,
+    forward, or look up any job), so idempotent GETs rotate freely on
+    connection trouble, while the job-creating POST rotates ONLY on
+    delivery-impossible failures (refused/DNS/unreachable: no byte
+    reached any queue). An ambiguous failure — reset or timeout AFTER
+    the bytes went out — never rotates: the first router may have
+    accepted and journaled the job, and a blind resubmit to a sibling
+    double-runs the board under two ids (the ambiguous-504 contract,
+    now applied across replicas). A plain ``--server`` invocation gets a
+    one-element ring, so every single-server path is pinned unchanged."""
+
+    def __init__(self, spec):
+        if isinstance(spec, str):
+            bases = [s.strip().rstrip("/") for s in spec.split(",")]
+        else:
+            bases = [s.rstrip("/") for s in spec]
+        self.bases = [b for b in bases if b]
+        if not self.bases:
+            raise ValueError("--servers needs at least one URL")
+        self._i = 0  # the preferred base: last one that answered
+
+    @property
+    def current(self) -> str:
+        return self.bases[self._i]
+
+    def prefer(self, base: str) -> None:
+        if base in self.bases:
+            self._i = self.bases.index(base)
+
+    def rotation(self) -> list:
+        """Every base, preferred first — the probe order for idempotent
+        reads."""
+        return self.bases[self._i:] + self.bases[:self._i]
+
+    def others(self, base: str) -> list:
+        """Failover candidates for a dead ``base``, in ring order after
+        it (empty for a one-element ring)."""
+        if len(self.bases) < 2:
+            return []
+        try:
+            i = self.bases.index(base)
+        except ValueError:
+            return list(self.bases)
+        return self.bases[i + 1:] + self.bases[:i]
+
+
 def _submit(args) -> int:
     """``gol submit``: client for a running ``gol serve`` instance.
 
@@ -1743,7 +1991,8 @@ def _submit(args) -> int:
         width = DEFAULT_WIDTH
     if height <= 0:
         height = DEFAULT_HEIGHT
-    base = args.server.rstrip("/")
+    ring = _ServerRing(getattr(args, "servers", None) or args.server)
+    base = ring.current
     # --shard-across: against a fleet router, fan the multi-board submit
     # round-robin over the fleet's workers directly (GET /fleet lists
     # them); against a single `gol serve` — no /fleet endpoint — the flag
@@ -1880,8 +2129,37 @@ def _submit(args) -> int:
                     # reached the queue — a 400 created no job).
                     continue
 
+        def submit_failover(target):
+            # --servers: a dead ROUTER rotates the POST to the next
+            # replica — but only on delivery-impossible failures, where
+            # no byte reached any queue (see _ServerRing). The rotation
+            # applies to ring bases only: a --shard-across WORKER target
+            # failing surfaces as before (the job's placement is the
+            # router's business, not a reason to re-pick routers).
+            from gol_tpu.resilience.retry import delivery_impossible
+
+            tried = {target}
+            while True:
+                try:
+                    return target, submit_to(target)
+                except OSError as err:
+                    if target not in ring.bases \
+                            or not delivery_impossible(err):
+                        raise
+                    nxt = next((b for b in ring.others(target)
+                                if b not in tried), None)
+                    if nxt is None:
+                        raise
+                    print(f"gol submit: router {target} unreachable "
+                          f"({type(err).__name__}); failing over to {nxt}",
+                          file=sys.stderr)
+                    tried.add(nxt)
+                    wire_mode.setdefault(nxt, wire_default)
+                    target = nxt
+                    ring.prefer(nxt)
+
         try:
-            status, payload = submit_to(target)
+            target, (status, payload) = submit_failover(target)
             if status == 429:
                 # A shed burst: the membership that 429'd may already be
                 # stale — an autoscaled fleet is likely scaling up RIGHT
@@ -1895,7 +2173,7 @@ def _submit(args) -> int:
                       f"refreshed membership, retrying on {retry}",
                       file=sys.stderr)
                 target = retry
-                status, payload = submit_to(target)
+                target, (status, payload) = submit_failover(target)
         except OSError as err:
             # Exchange trouble the policy refused to retry: either
             # no-contact retries ran out, or — the case that matters —
@@ -1948,7 +2226,7 @@ def _submit(args) -> int:
     if outdir:
         os.makedirs(outdir, exist_ok=True)
     return _collect_results(dict(ids), args, outdir,
-                            retry=(policy, budget))
+                            retry=(policy, budget), ring=ring)
 
 
 class _ShardTargets:
@@ -2012,7 +2290,8 @@ class _ShardTargets:
         self.refresh(force=True)
 
 
-def _collect_results(pending: dict, args, outdir, retry=None) -> int:
+def _collect_results(pending: dict, args, outdir, retry=None,
+                     ring=None) -> int:
     """Poll every submitted job to a terminal state and write its result.
 
     ``pending`` maps job id -> (input path, server base URL) — with
@@ -2089,6 +2368,27 @@ def _collect_results(pending: dict, args, outdir, retry=None) -> int:
                     retryable=_connection_trouble, budget=budget,
                 )
             except (urllib.error.URLError, ConnectionError, OSError) as e:
+                # --servers: a status GET is idempotent, and any replica
+                # router can look up any job — re-home this job to the
+                # next ring base that is not itself past the no-contact
+                # cutoff. Only ring bases re-home (a --shard-across
+                # WORKER base has no siblings with its journal); with
+                # every router dead, each base ages past the cutoff and
+                # the per-target give-up below fires exactly as before.
+                moved = None
+                if ring is not None and job_base in ring.bases:
+                    now2 = time.perf_counter()
+                    for cand in ring.others(job_base):
+                        last_contact.setdefault(cand, now2)
+                        if now2 - last_contact[cand] <= args.server_timeout:
+                            moved = cand
+                            break
+                if moved is not None:
+                    print(f"gol submit: router {job_base} unreachable "
+                          f"({type(e).__name__}); polling job {job_id} "
+                          f"via {moved}", file=sys.stderr)
+                    pending[job_id] = (path, moved)
+                    continue
                 if target_down(e):
                     rc = 1
                 continue
@@ -2338,18 +2638,35 @@ def _top(args) -> int:
     until interrupted) — the scriptable/test lane."""
     from gol_tpu.obs import top as obs_top
 
-    base = args.server.rstrip("/")
+    ring = _ServerRing(getattr(args, "servers", None) or args.server)
     if args.interval <= 0:
         raise ValueError(f"--interval must be > 0, got {args.interval}")
     ansi = sys.stdout.isatty() and not args.no_ansi
     frames = 0
     try:
         while True:
-            metrics = _fetch_json(f"{base}/metrics?format=json")
+            # --servers: probe the ring preferred-first; the dashboard
+            # follows whichever replica answers (the title names it, so
+            # the operator always knows WHICH router's view this is).
+            # One base — the plain --server invocation — is pinned:
+            # same fetches, same title.
+            metrics, answered = {}, None
+            for cand in ring.rotation():
+                metrics = _fetch_json(f"{cand}/metrics?format=json")
+                if metrics:
+                    answered = cand
+                    ring.prefer(cand)
+                    break
+            base = answered or ring.current
             slo = _fetch_json(f"{base}/slo")
+            title = f"gol top — {base}"
+            if len(ring.bases) > 1:
+                title += (f" [answered by {base}]" if answered
+                          else f" [all {len(ring.bases)} routers "
+                               "unreachable]")
             frame = obs_top.render_frame(
                 metrics, slo or None, ansi=ansi,
-                title=f"gol top — {base}",
+                title=title,
             )
             if ansi:
                 sys.stdout.write(obs_top.CLEAR)
@@ -2414,9 +2731,31 @@ def _fleet_trace(args) -> int:
     process, cross-process flow arrows router→worker per job. Unreachable
     workers are skipped with a note — tracing the survivors during the
     incident that killed a worker is the point."""
+    import urllib.error
+
     from gol_tpu.obs import fleettrace
 
-    doc = fleettrace.export(args.server, args.output)
+    ring = _ServerRing(getattr(args, "servers", None) or args.server)
+    doc = None
+    last_err = None
+    for cand in ring.rotation():
+        # --servers: the stitched export reads idempotent debug
+        # endpoints, so trying the next replica router is always safe.
+        try:
+            doc = fleettrace.export(cand, args.output)
+            if len(ring.bases) > 1:
+                print(f"fleet-trace: exported via router {cand}",
+                      file=sys.stderr)
+            break
+        except (urllib.error.URLError, ConnectionError, OSError) as err:
+            last_err = err
+            if len(ring.bases) > 1:
+                print(f"fleet-trace: router {cand} unreachable "
+                      f"({type(err).__name__}); trying the next replica",
+                      file=sys.stderr)
+    if doc is None:
+        raise ValueError(
+            f"no router in {', '.join(ring.bases)} answered: {last_err}")
     other = doc.get("otherData", {})
     processes = other.get("processes", {})
     events = doc.get("traceEvents", [])
@@ -3045,7 +3384,34 @@ def build_parser() -> argparse.ArgumentParser:
         "Health probes stay direct — chaos exercises the data plane's "
         "defenses, not the supervisor. NEVER set this in production",
     )
+    flt.add_argument(
+        "--routers", type=int, default=1, metavar="N",
+        help="total router replicas over this fleet (default 1). N-1 "
+        "extra `gol router` subprocesses boot from the shared manifest, "
+        "serve the full job API active-active, and contest the leader "
+        "lease for the single-writer ticks — kill any one (the leader "
+        "included) and the survivors carry the control plane",
+    )
     flt.set_defaults(func=_fleet)
+
+    rtr = sub.add_parser(
+        "router",
+        help="one attachable router replica over a running fleet: boots "
+        "from the shared manifest (membership + config), inherits the "
+        "durable floors/breaker state, contests the leader lease. "
+        "SIGTERM stops this replica only — never the workers",
+    )
+    rtr.add_argument("--fleet-dir", required=True, metavar="DIR",
+                     help="the running fleet's --fleet-dir (the manifest "
+                     "is the only coordination channel)")
+    rtr.add_argument("--router-id", required=True, metavar="ID",
+                     help="this replica's identity (its durable state "
+                     "lives under <fleet-dir>/routers/<ID>/)")
+    rtr.add_argument("--host", default="127.0.0.1")
+    rtr.add_argument("--port", type=int, default=0,
+                     help="0 = any free port (default; the URL is "
+                     "advertised in <fleet-dir>/routers/<ID>/advert.json)")
+    rtr.set_defaults(func=_router)
 
     cpt = sub.add_parser(
         "compact",
@@ -3149,6 +3515,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ftr.add_argument("--server", default="http://127.0.0.1:8000",
                      help="the fleet router (or a single gol serve) URL")
+    ftr.add_argument("--servers", default=None, metavar="A,B,C",
+                     help="comma-separated router REPLICA URLs over one "
+                     "fleet (overrides --server): the export tries each "
+                     "in turn until one answers")
     ftr.add_argument("-o", "--output", default="fleet-trace.json",
                      help="stitched Chrome trace JSON path "
                      "(default fleet-trace.json)")
@@ -3179,6 +3549,10 @@ def build_parser() -> argparse.ArgumentParser:
         "the live dispatch-gap ratio",
     )
     topp.add_argument("--server", default="http://127.0.0.1:8000")
+    topp.add_argument("--servers", default=None, metavar="A,B,C",
+                      help="comma-separated router REPLICA URLs over one "
+                      "fleet (overrides --server): each frame follows "
+                      "whichever replica answers, and the title names it")
     topp.add_argument("--interval", type=float, default=2.0, metavar="S",
                       help="seconds between refreshes (default 2)")
     topp.add_argument(
@@ -3210,6 +3584,14 @@ def build_parser() -> argparse.ArgumentParser:
     sbm.add_argument("height")
     sbm.add_argument("input_files", nargs="+")
     sbm.add_argument("--server", default="http://127.0.0.1:8000")
+    sbm.add_argument(
+        "--servers", default=None, metavar="A,B,C",
+        help="comma-separated router REPLICA URLs over one fleet "
+        "(overrides --server): job-creating POSTs fail over ONLY on "
+        "delivery-impossible errors (refused/DNS/unreachable — nothing "
+        "reached any queue); ambiguous failures surface for audit, never "
+        "blind-resubmit. Status/result GETs rotate freely",
+    )
     sbm.add_argument(
         "--variant", default="tpu", choices=sorted(VARIANTS),
         help="reference program whose loop accounting the jobs use",
@@ -3296,9 +3678,9 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     # Default command is `run`, preserving the bare `<w> <h> <file>` contract.
     if not argv or argv[0] not in (
-        "run", "generate", "show", "serve", "fleet", "submit", "batch",
-        "tune", "trace-report", "fleet-trace", "history-report", "top",
-        "slo-report", "compact", "gc", "-h", "--help"
+        "run", "generate", "show", "serve", "fleet", "router", "submit",
+        "batch", "tune", "trace-report", "fleet-trace", "history-report",
+        "top", "slo-report", "compact", "gc", "-h", "--help"
     ):
         argv = ["run", *argv]
     args = build_parser().parse_args(argv)

@@ -274,6 +274,14 @@ class Autoscaler:
         """One autoscaler evaluation (rides ``Fleet.health_tick``)."""
         if self._closed or getattr(self.router, "_draining", False):
             return None
+        if not getattr(self.fleet, "supervise", True):
+            # Leader-gated (fleet/lease.py): only the lease holder makes
+            # scale decisions — two replicas double-counting one queue
+            # spike would spawn twice the workers. A follower also skips
+            # the streak/cooldown bookkeeping on purpose: when it takes
+            # over, it starts from clean hysteresis instead of streaks
+            # accumulated while powerless to act.
+            return None
         signals = self.signals()
         decision = self.decide(signals)
         victim = None

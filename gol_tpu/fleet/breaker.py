@@ -165,6 +165,19 @@ class CircuitBreaker:
             self._recent.append(bool(slow))
             self._maybe_trip_locked()
 
+    def reopen(self) -> None:
+        """Warm-start restore (fleet/replicate.py): re-arm the OPEN a
+        previous router incarnation's durable ring recorded, with a fresh
+        cooldown from NOW — the successor then makes first contact the
+        way every open breaker does, via one half-open probe after the
+        cooldown, instead of re-learning the failure on real traffic.
+        Fires ``on_transition`` like any trip, so the restore itself
+        lands in the ring (keeping warm-start idempotent across
+        successive router respawns). No-op unless CLOSED."""
+        with self._lock:
+            if self._state == CLOSED:
+                self._open_locked()
+
     def on_failure(self) -> None:
         with self._lock:
             if self._state == HALF_OPEN:

@@ -187,6 +187,41 @@ class MonotonicCounters:
                 out[wid] = self._rebuild({}, floored)
         return out
 
+    def state(self) -> dict:
+        """JSON-round-trippable floors (``fleet/replicate.FloorsStore``
+        persists it after every fresh scrape): the banked bases, the
+        last-seen values — without which a dead worker's stand-in totals
+        and the regression fallback vanish on router restart — and the
+        incarnation generations."""
+        with self._lock:
+            return {
+                "version": 1,
+                "last": [[wid, list(skey), value]
+                         for (wid, skey), value in self._last.items()],
+                "base": [[wid, list(skey), value]
+                         for (wid, skey), value in self._base.items()],
+                "incarnations": dict(self._incarnation),
+            }
+
+    def seed(self, state: dict | None) -> None:
+        """Adopt floors a previous router incarnation persisted. Only a
+        fresh instance seeds (floors already in motion outrank any file).
+        Live workers then simply continue their series (value >= seeded
+        last: no bank); a worker that restarted while no router watched
+        shows value < seeded last and banks the lost run — the merged
+        series stays monotonic through the ROUTER's own outage window."""
+        if not state:
+            return
+        with self._lock:
+            if self._last or self._base:
+                return
+            for wid, skey, value in state.get("last") or []:
+                self._last[(wid, tuple(skey))] = float(value)
+            for wid, skey, value in state.get("base") or []:
+                self._base[(wid, tuple(skey))] = float(value)
+            for wid, gen in (state.get("incarnations") or {}).items():
+                self._incarnation[wid] = int(gen)
+
 
 def merge_metrics(snapshots: dict[str, dict]) -> dict:
     """Merge per-worker /metrics JSON snapshots into one fleet view.
@@ -318,6 +353,8 @@ class RouterServer:
         breaker_config: BreakerConfig | None = None,
         breaker_history=None,
         chaos=None,
+        router_id: str = "r0",
+        state_dir: str | None = None,
     ):
         if big_edge < placement.PLACEMENT_QUANTUM:
             raise ValueError(
@@ -375,8 +412,40 @@ class RouterServer:
         # direct: chaos tests the data plane's defenses, not the
         # supervisor's eyesight.
         self.chaos = chaos
+        # Replica identity: which router THIS process is ("r0" is the
+        # `gol fleet` primary; `gol router` replicas pick their own).
+        # Stamped on /healthz and /fleet so clients and smokes can tell
+        # which replica answered.
+        self.router_id = router_id
         self.registry = Registry(prefix="gol_fleet")
         self._counter_floors = MonotonicCounters()
+        # Durable coordination state (fleet/replicate.py): with a state
+        # dir mounted, the counter floors persist after every fresh
+        # scrape and re-seed on boot (merged across ALL replicas' dirs),
+        # and breakers some incarnation left open re-arm warm. Without
+        # one, behavior is byte-identical to the in-memory-only router.
+        self._floors_store = None
+        self._state_dir = state_dir
+        if state_dir is not None:
+            from gol_tpu.fleet import replicate as _replicate
+
+            self._floors_store = _replicate.FloorsStore(state_dir)
+            self._counter_floors.seed(
+                _replicate.load_merged_floors(fleet.fleet_dir)
+            )
+            if self.breakers_enabled:
+                # Re-arm, don't re-learn: every worker some replica's
+                # durable ring last recorded open/half-open starts OPEN
+                # here, with a fresh cooldown — first contact is one
+                # half-open probe, not fail_threshold real jobs.
+                for wid in sorted(_replicate.warm_breaker_states(
+                        fleet.fleet_dir)):
+                    br = self.breaker(wid)
+                    if br is not None:
+                        br.reopen()
+                        logger.warning(
+                            "router %s: breaker for %s restored OPEN from "
+                            "the durable ring", self.router_id, wid)
         # Single-flight scrape state (all guarded by the condition).
         self._scrape_done = threading.Condition()
         self._scrape_busy = False
@@ -413,7 +482,15 @@ class RouterServer:
         host, port = self.address
         return f"http://{host}:{port}"
 
+    def _advertise(self) -> None:
+        if self._state_dir is not None:
+            from gol_tpu.fleet import replicate as _replicate
+
+            _replicate.advertise(self.fleet.fleet_dir, self.router_id,
+                                 self.url)
+
     def start(self) -> None:
+        self._advertise()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="gol-fleet-http", daemon=True
         )
@@ -421,6 +498,7 @@ class RouterServer:
         logger.info("fleet router listening on %s", self.url)
 
     def serve_forever(self) -> None:
+        self._advertise()
         logger.info("fleet router listening on %s", self.url)
         self.httpd.serve_forever()
 
@@ -519,6 +597,9 @@ class RouterServer:
             self.fleet.terminate()
         else:
             self.fleet.stop_health()
+        # Voluntary lease hand-off: a surviving replica should win on its
+        # very next tick, not wait for the kernel to reap this process.
+        self.fleet.release_leadership()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
@@ -1078,6 +1159,11 @@ class RouterServer:
             merged = merge_metrics(self._counter_floors.adjust(
                 {k: v for k, v in snaps.items() if v}, incarnations
             ))
+            if self._floors_store is not None:
+                # Persist what this scrape banked (no-op when unmoved):
+                # the merged series' monotonicity now survives THIS
+                # router dying, not just the workers.
+                self._floors_store.save(self._counter_floors.state())
             result = (snaps, merged)
             return result
         finally:
@@ -1090,6 +1176,8 @@ class RouterServer:
                 self._scrape_done.notify_all()
 
     def metrics_json(self) -> dict:
+        self.registry.set_gauge("router_leader",
+                                1 if self.fleet.leading else 0)
         snaps, merged = self._merged_snapshot()
         # The snapshot may be shared with concurrent scrapers: never
         # mutate it in place.
@@ -1101,8 +1189,20 @@ class RouterServer:
             entry["health"] = health.get(wid, {})
             workers[wid] = entry
         merged["workers"] = workers
+        routers = []
+        if self._state_dir is not None:
+            from gol_tpu.fleet import replicate as _replicate
+
+            routers = _replicate.list_routers(self.fleet.fleet_dir)
         merged["fleet"] = {
             **self.fleet.stats(),
+            # Which replica answered this scrape, whether it leads, and
+            # the advertised replica roster — `gol top`'s control-plane
+            # panel (absent for embedded routers with no state dir, so
+            # their payloads stay byte-identical).
+            "router_id": self.router_id,
+            "leader": self.fleet.leading,
+            **({"routers": routers} if routers else {}),
             "draining": self._draining,
             "router": self.registry.snapshot(),
             **({"breakers": self.breaker_states()}
@@ -1119,6 +1219,10 @@ class RouterServer:
             "workers": stats["workers"],
             "workers_healthy": stats["healthy"],
             "workers_backpressured": stats["backpressured"],
+            # 1 on the replica that holds the leader lease (or on any
+            # lease-less single-router fleet) — sum across replicas on a
+            # dashboard and alert on != 1.
+            "router_leader": 1 if self.fleet.leading else 0,
         }
         fleet_counters = {
             "worker_restarts": stats["restarts"],
@@ -1154,8 +1258,16 @@ class RouterServer:
         return merge_slo(self._collect("/slo"))
 
     def fleet_json(self) -> dict:
+        routers = []
+        if self._state_dir is not None:
+            from gol_tpu.fleet import replicate as _replicate
+
+            routers = _replicate.list_routers(self.fleet.fleet_dir)
         return {
             "fleet_dir": self.fleet.fleet_dir,
+            "router_id": self.router_id,
+            "leader": self.fleet.leading,
+            **({"routers": routers} if routers else {}),
             "draining": self._draining,
             "big_edge": self.big_edge,
             "cache_route": self.cache_route,
@@ -1297,6 +1409,8 @@ def _make_handler(router: RouterServer):
                 self._reply(200, {
                     "ok": True,
                     "router": True,
+                    "id": router.router_id,
+                    "leader": router.fleet.leading,
                     "draining": router._draining,
                     "fleet": router.fleet.stats(),
                 })

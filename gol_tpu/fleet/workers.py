@@ -42,11 +42,20 @@ import sys
 import threading
 import time
 
-from gol_tpu.fleet import client
+from gol_tpu.fleet import client, lease
 
 logger = logging.getLogger(__name__)
 
 MANIFEST = "manifest.json"
+# Cross-process serialization of manifest writes (fleet/lease.py, the
+# compaction.lock discipline): an attached second router, a respawning
+# supervisor, and an offline `gol compact` may all hold Fleet objects on
+# one fleet dir — the in-process _manifest_lock cannot see each other.
+MANIFEST_LOCK = "manifest.lock"
+# The leader lease: whoever flocks it runs the single-writer ticks
+# (respawn supervision, autoscaling). SIGKILL-safe — the kernel releases
+# it with the holder's last fd, and any survivor acquires it next tick.
+LEADER_LOCK = "leader.lock"
 _URL_RE = re.compile(rb"serving on (http://\S+)")
 
 
@@ -149,6 +158,7 @@ class Fleet:
         http=client.http_json,
         spawn_prefix=None,
         spawn_weight: float | None = None,
+        replica: bool = False,
     ):
         self.fleet_dir = fleet_dir
         os.makedirs(fleet_dir, exist_ok=True)
@@ -172,6 +182,25 @@ class Fleet:
         self._health_stop = threading.Event()
         self._respawns: dict[str, threading.Thread] = {}
         self._manifest_lock = threading.Lock()
+        # Replica mode (`gol router`): this Fleet is a READ view of a
+        # membership some other process owns — load() adopts without
+        # respawning, the manifest is never written while following, and
+        # supervision stays off until the leader lease is won. The data
+        # plane (placement, forwards, probes) is identical either way:
+        # HRW is deterministic, so every replica routes like the leader.
+        self.replica = replica
+        # Whether THIS process runs the single-writer ticks (respawn,
+        # and — via the autoscaler's gate — scale decisions). Flips
+        # False -> True exactly once, when the leader lease is won; a
+        # live holder never loses it (flock releases only on death).
+        self.supervise = not replica
+        self._lease: lease.FlockLease | None = None
+        # Optional fleet-level config block carried IN the manifest (the
+        # serve args, router flags, and autoscale settings a replica
+        # needs to take over as leader): set by the spawning CLI before
+        # the first write, adopted by load()/reconcile on replicas —
+        # membership AND configuration share one source of truth.
+        self.manifest_config: dict | None = None
         # Per-tick hooks (the autoscaler's ride on the health loop): each
         # is called after the worker probes of every health tick, inside
         # the tick's own exception guard.
@@ -465,20 +494,35 @@ class Fleet:
         # health thread's banner adoption) share one .tmp path — two
         # interleaved truncate/write/replace sequences would publish a
         # garbled manifest and break the router-restart recovery lane.
+        # The threading lock covers THIS process; the blocking flock on
+        # manifest.lock covers every other one (a second router replica,
+        # an offline tool) — both writers complete, strictly in turn, so
+        # the .tmp stage can never interleave across processes either.
+        if self.replica and not self.supervise:
+            return  # a follower READS membership; only the leader writes
         with self._manifest_lock:
             with self._lock:
                 doc = {
                     "version": 1,
+                    **({"config": self.manifest_config}
+                       if self.manifest_config else {}),
                     "partitions": [w.manifest_record()
                                    for w in self._workers.values()],
                 }
-            tmp = self.manifest_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(doc, f, indent=1)
-                f.write("\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.manifest_path)
+            lock_fd = lease.acquire(
+                os.path.join(self.fleet_dir, MANIFEST_LOCK), blocking=True
+            )
+            try:
+                tmp = self.manifest_path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(doc, f, indent=1)
+                    f.write("\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.manifest_path)
+            finally:
+                if lock_fd is not None:
+                    lease.release(lock_fd)
 
     def load(self) -> int:
         """Reattach the fleet a previous router left behind (the router-
@@ -491,6 +535,8 @@ class Fleet:
             return 0
         with open(self.manifest_path, "r", encoding="utf-8") as f:
             doc = json.load(f)
+        if isinstance(doc.get("config"), dict):
+            self.manifest_config = doc["config"]
         n = 0
         for rec in doc.get("partitions", []):
             weight = rec.get("weight")
@@ -508,10 +554,17 @@ class Fleet:
             if alive:
                 logger.info("fleet: reattached live worker %s at %s",
                             worker.id, worker.url)
-            elif worker.attached:
+            elif worker.attached or self.replica:
+                # A replica never respawns at boot — the partition is the
+                # LEADER's to revive; adopt it unhealthy and keep probing
+                # (exactly the dead-attached-worker posture). If this
+                # replica later wins the lease, its supervised ticks take
+                # over the respawn.
                 worker.healthy = False
-                logger.warning("fleet: attached worker %s unreachable at %s; "
-                               "will keep probing", worker.id, worker.url)
+                logger.warning("fleet: %s worker %s unreachable at %s; "
+                               "will keep probing",
+                               "attached" if worker.attached else "adopted",
+                               worker.id, worker.url)
             else:
                 self._add(worker)
                 self._respawn(worker)
@@ -520,6 +573,113 @@ class Fleet:
             self._add(worker)
             n += 1
         return n
+
+    def reconcile_from_manifest(self) -> int:
+        """Follower-side membership sync: adopt what the leader's manifest
+        says, without writing anything back. New partitions (a scale-up)
+        appear, a respawned worker's fresh URL replaces the dead one, and
+        partitions the leader retired (a scale-down) drop out — so every
+        replica routes over the same membership the leader supervises,
+        one tick behind at most. Returns the number of changes applied.
+
+        Never touches a worker whose subprocess THIS fleet owns
+        (``proc`` set): reconciliation is for adopted views only, and a
+        follower never spawns."""
+        try:
+            with open(self.manifest_path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return 0  # no manifest yet; writes are atomic, so never torn
+        if isinstance(doc.get("config"), dict):
+            self.manifest_config = doc["config"]
+        recs = {rec["id"]: rec for rec in doc.get("partitions", [])
+                if rec.get("id")}
+        changed = 0
+        with self._lock:
+            for wid, rec in recs.items():
+                url = rec.get("url")
+                url = url.rstrip("/") if url else None
+                worker = self._workers.get(wid)
+                if worker is None:
+                    weight = rec.get("weight")
+                    self._workers[wid] = Worker(
+                        id=wid,
+                        url=url,
+                        journal_dir=(
+                            os.path.join(self.fleet_dir, rec["journal"])
+                            if rec.get("journal") else None),
+                        big=bool(rec.get("big")),
+                        attached=bool(rec.get("attached")),
+                        pid=rec.get("pid"),
+                        weight=float(weight) if weight else None,
+                        healthy=False,  # this tick's probe promotes it
+                    )
+                    changed += 1
+                elif worker.proc is None:
+                    if url is not None and worker.url != url:
+                        # The leader respawned it: route to the new
+                        # process once the probe (same tick) confirms it.
+                        worker.url = url
+                        worker.pid = rec.get("pid")
+                        worker.failures = 0
+                        worker.healthy = False
+                        changed += 1
+                    elif worker.pid != rec.get("pid"):
+                        worker.pid = rec.get("pid")
+                        changed += 1
+            for wid in [w for w in self._workers if w not in recs]:
+                worker = self._workers[wid]
+                if (worker.proc is None and not worker.respawning
+                        and not worker.retiring):
+                    del self._workers[wid]  # the leader retired it
+                    changed += 1
+        if changed:
+            logger.info("fleet: reconciled %d membership change(s) from "
+                        "the manifest", changed)
+        return changed
+
+    # -- leader election ----------------------------------------------------
+
+    def enable_leader_election(self, label: str = "") -> bool:
+        """Arm the SIGKILL-safe leader lease on ``<fleet_dir>/leader.lock``
+        and contest it once now; every later health tick re-contests.
+        Returns whether this process leads right now. While not leading,
+        ``supervise`` is False: no respawns, no manifest writes, and the
+        autoscaler's tick no-ops — single-writer control with an
+        active-active data plane."""
+        if self._lease is None:
+            self._lease = lease.FlockLease(
+                os.path.join(self.fleet_dir, LEADER_LOCK), label=label
+            )
+        self.supervise = self._lease.try_acquire()
+        return self.supervise
+
+    @property
+    def leading(self) -> bool:
+        """Whether this process runs the single-writer ticks (True for a
+        lease-holding or lease-less fleet — a plain one-router fleet
+        supervises unconditionally, exactly as before elections existed)."""
+        return self.supervise
+
+    def _poll_leadership(self) -> None:
+        if self._lease is None or self.supervise:
+            return  # lease-less fleet, or already the holder (for life)
+        if self._lease.try_acquire():
+            self.supervise = True
+            logger.warning(
+                "fleet: leader lease acquired — this router now owns the "
+                "single-writer ticks (respawn supervision, scale "
+                "decisions); adopting membership from the manifest"
+            )
+            self.reconcile_from_manifest()
+
+    def release_leadership(self) -> None:
+        """Voluntary hand-off at shutdown so a survivor wins the lease
+        without waiting for the kernel to reap this process."""
+        if self._lease is not None:
+            self._lease.release()
+            if self.replica:
+                self.supervise = False
 
     # -- health ------------------------------------------------------------
 
@@ -545,15 +705,25 @@ class Fleet:
         if worker.proc is not None and worker.proc.poll() is not None:
             logger.warning("fleet: worker %s (pid %s) exited rc=%s",
                            worker.id, worker.pid, worker.proc.returncode)
-            self._respawn_async(worker)
+            if self.supervise:
+                self._respawn_async(worker)
             return
         if worker.url is None:
+            if worker.proc is None:
+                # Adopted from a manifest written mid-boot (the previous
+                # supervisor died between launch and banner): there is no
+                # log offset to scan — only the leader may relaunch the
+                # partition (its _respawn kills any half-booted orphan
+                # first; never two journal writers).
+                if self.supervise and not worker.attached:
+                    self._respawn_async(worker)
+                return
             # A boot that outlived _await_ready's patience (e.g.
             # --warm-plans compiling on a loaded host) but whose process is
             # alive: keep looking for its banner every tick — otherwise the
             # worker serves forever on a port the router never learns and
             # its partition is stranded.
-            if worker.proc is None or worker.proc.poll() is not None:
+            if worker.proc.poll() is not None:
                 return
             matches = _URL_RE.findall(
                 self._read_log(worker)[worker.log_offset:]
@@ -572,7 +742,7 @@ class Fleet:
                         "probes; routing around it", worker.id, worker.failures,
                     )
                 worker.healthy = False
-                if not worker.attached:
+                if not worker.attached and self.supervise:
                     self._respawn_async(worker)
             return
         worker.failures = 0
@@ -601,6 +771,14 @@ class Fleet:
             worker.backpressure = burning
 
     def health_tick(self) -> None:
+        # Leadership first: a survivor must claim the dead leader's lease
+        # on THIS tick (the takeover latency the zero-SPOF story promises
+        # is one health interval), then probe with its new authority.
+        self._poll_leadership()
+        if not self.supervise:
+            # Followers track the leader's membership instead of writing
+            # their own: the manifest is the single source of truth.
+            self.reconcile_from_manifest()
         for worker in self.workers():
             self.check_worker(worker)
         for hook in list(self._tick_hooks):

@@ -304,6 +304,30 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
                 + _color(wslo, f"{wslo:>12}", ansi)
             )
 
+    # -- router replicas (PR 16: horizontal control plane) ------------------
+    # Present only when the answering router advertises a replica roster;
+    # every older payload skips the panel byte-identically. "(this view)"
+    # names the replica whose scrape built THIS frame — under --servers
+    # failover the dashboard may follow a different replica next frame.
+    routers = (fleet or {}).get("routers") or []
+    if routers:
+        me = (fleet or {}).get("router_id")
+        lines.append("")
+        lines.append(f"  {'router':<8} {'state':<8} {'pid':>7}  url")
+        for r in routers:
+            alive = bool(r.get("alive"))
+            state = "alive" if alive else "gone"
+            marker = ""
+            if r.get("id") == me:
+                marker = (" (this view, leader)" if (fleet or {}).get("leader")
+                          else " (this view)")
+            lines.append(
+                f"  {str(r.get('id', '?')):<8} "
+                + _color("ok" if alive else "critical", f"{state:<8}", ansi)
+                + f" {int(r.get('pid') or 0):>7}  {r.get('url', '')}"
+                f"{marker}"
+            )
+
     return "\n".join(lines) + "\n"
 
 

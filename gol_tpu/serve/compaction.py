@@ -45,7 +45,6 @@ other way around).
 from __future__ import annotations
 
 import dataclasses
-import fcntl
 import json
 import logging
 import os
@@ -53,6 +52,7 @@ import re
 import tempfile
 import zlib
 
+from gol_tpu.fleet import lease
 from gol_tpu.resilience import STAGING_SUFFIX, faults, fsio
 
 logger = logging.getLogger(__name__)
@@ -311,27 +311,28 @@ def compact(directory: str,
     two interleaved passes (an offline ``gol compact`` racing the live
     server's idle tick) could otherwise commit a STALE snapshot over a
     newer one whose folded segments are already deleted, losing their
-    records. The loser skips and reports ``compacted=False``."""
-    lock_fd = os.open(os.path.join(directory, LOCK_FILENAME),
-                      os.O_WRONLY | os.O_CREAT, 0o644)
+    records. The loser skips and reports ``compacted=False``. (The flock
+    discipline itself — open+LOCK_EX|LOCK_NB, close-releases, kernel
+    drops it on SIGKILL — is the shared ``fleet/lease.py`` helper; the
+    replicated control plane's manifest writes and leader lease ride the
+    same primitive.)"""
+    lock_fd = lease.acquire(os.path.join(directory, LOCK_FILENAME))
+    if lock_fd is None:
+        logger.warning(
+            "journal compaction in %s skipped: another compaction "
+            "holds the lock (a live server's tick, or a concurrent "
+            "`gol compact`)", directory)
+        bytes_now = journal_bytes(directory)
+        return CompactionReport(
+            compacted=False, covers=snapshot_covers(directory),
+            segments_retired=0, records_kept=0, terminal_dropped=0,
+            bytes_before=bytes_now, bytes_after=bytes_now,
+            torn_lines=0,
+        )
     try:
-        try:
-            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError:
-            logger.warning(
-                "journal compaction in %s skipped: another compaction "
-                "holds the lock (a live server's tick, or a concurrent "
-                "`gol compact`)", directory)
-            bytes_now = journal_bytes(directory)
-            return CompactionReport(
-                compacted=False, covers=snapshot_covers(directory),
-                segments_retired=0, records_kept=0, terminal_dropped=0,
-                bytes_before=bytes_now, bytes_after=bytes_now,
-                torn_lines=0,
-            )
         return _compact_locked(directory, retain_results)
     finally:
-        os.close(lock_fd)  # closing releases the flock
+        lease.release(lock_fd)  # closing releases the flock
 
 
 def _compact_locked(directory: str,

@@ -1,0 +1,873 @@
+"""Horizontal control plane (PR 16): replicated routers, durable
+coordination state, zero SPOFs.
+
+The load-bearing blocks:
+
+- TestFlockLease pins the SIGKILL-safety the whole leadership design
+  rests on: the kernel drops a flock with its holder, including a
+  ``kill -9``'d one — no heartbeat files, no timeouts, no clocks.
+- TestManifestFlock is the satellite regression for the two-writer
+  manifest race: ``write_manifest`` used to hold only a threading.Lock,
+  so a second ROUTER PROCESS could interleave its tmp-write/rename and
+  tear the membership record both replicas route from.
+- TestRouterReplicaChaos is the client->router chaos matrix (the PR-14
+  matrix covered router->worker): latency, reset, refusal on the hop the
+  CLIENT dials, plus a router dropped mid-load with a second replica up
+  — every accepted job still ends DONE exactly once and byte-identical
+  to the oracle.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+from gol_tpu import oracle
+from gol_tpu.chaos import ChaosPlan, ProxyPool
+from gol_tpu.config import GameConfig
+from gol_tpu.fleet import client as fleet_client
+from gol_tpu.fleet import lease, replicate
+from gol_tpu.fleet.breaker import CLOSED, OPEN, BreakerConfig, CircuitBreaker
+from gol_tpu.fleet.router import MonotonicCounters, RouterServer
+from gol_tpu.fleet.workers import LEADER_LOCK, MANIFEST_LOCK, Fleet, Worker
+from gol_tpu.io import text_grid
+from gol_tpu.obs.history import HistoryWriter
+from gol_tpu.serve.server import GolServer
+
+
+def _http(method, url, body=None, timeout=30, headers=None):
+    return fleet_client.http_json(method, url, body, timeout=timeout,
+                                  headers=headers)
+
+
+def _wait(predicate, timeout=60.0, interval=0.02):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# The flock lease primitive
+
+
+class TestFlockLease:
+    def test_exclusive_and_idempotent_within_process(self, tmp_path):
+        """flock is per-OPEN-FILE, not per-process: two FlockLease
+        objects in ONE process conflict exactly like two processes do —
+        which is what makes the whole election testable in-process."""
+        path = str(tmp_path / "leader.lock")
+        a = lease.FlockLease(path, label="a")
+        b = lease.FlockLease(path, label="b")
+        assert a.try_acquire() is True
+        assert a.try_acquire() is True  # idempotent re-contest
+        assert b.try_acquire() is False
+        assert a.held and not b.held
+        a.release()
+        assert not a.held
+        assert b.try_acquire() is True
+        b.release()
+
+    def test_module_acquire_release(self, tmp_path):
+        path = str(tmp_path / "some.lock")
+        fd = lease.acquire(path)
+        assert fd is not None
+        assert lease.acquire(path) is None  # held: non-blocking refusal
+        lease.release(fd)
+        fd2 = lease.acquire(path)
+        assert fd2 is not None
+        lease.release(fd2)
+
+    def test_blocking_acquire_waits_for_the_holder(self, tmp_path):
+        path = str(tmp_path / "serial.lock")
+        fd = lease.acquire(path)
+        got = {}
+
+        def contend():
+            got["fd"] = lease.acquire(path, blocking=True)
+
+        t = threading.Thread(target=contend)
+        t.start()
+        time.sleep(0.1)
+        assert "fd" not in got  # still blocked behind the holder
+        lease.release(fd)
+        t.join(timeout=10)
+        assert got.get("fd") is not None
+        lease.release(got["fd"])
+
+    def test_sigkill_drops_the_lock(self, tmp_path):
+        """The design's keystone: a ``kill -9``'d holder releases by
+        KERNEL action — the survivor acquires without any timeout or
+        heartbeat protocol."""
+        path = str(tmp_path / "leader.lock")
+        ready = str(tmp_path / "ready")
+        holder = subprocess.Popen([
+            sys.executable, "-c",
+            "import fcntl, os, sys, time\n"
+            f"fd = os.open({path!r}, os.O_WRONLY | os.O_CREAT, 0o644)\n"
+            "fcntl.flock(fd, fcntl.LOCK_EX)\n"
+            f"open({ready!r}, 'w').close()\n"
+            "time.sleep(600)\n",
+        ])
+        try:
+            assert _wait(lambda: os.path.exists(ready), timeout=30)
+            assert lease.acquire(path) is None  # the child really holds it
+            os.kill(holder.pid, signal.SIGKILL)
+            holder.wait(timeout=30)
+            fd = lease.acquire(path)
+            assert fd is not None  # dropped with the corpse, instantly
+            lease.release(fd)
+        finally:
+            if holder.poll() is None:
+                holder.kill()
+                holder.wait()
+
+
+# ---------------------------------------------------------------------------
+# Satellite regression: cross-process manifest writes are flock-serialized
+
+
+class TestManifestFlock:
+    def test_writer_blocks_behind_a_foreign_lock_holder(self, tmp_path):
+        """``write_manifest`` used to take only ``self._lock`` — a
+        threading.Lock, invisible to a second router PROCESS, whose
+        interleaved tmp-write/rename could tear the membership both
+        replicas route from. Now the write blocks on the cross-process
+        ``manifest.lock`` flock first."""
+        fleet = Fleet(str(tmp_path))
+        fleet.attach("http://127.0.0.1:1/", "w0")
+        lock_fd = lease.acquire(os.path.join(str(tmp_path), MANIFEST_LOCK))
+        assert lock_fd is not None
+        os.remove(fleet.manifest_path)
+        done = threading.Event()
+
+        def write():
+            fleet.write_manifest()
+            done.set()
+
+        t = threading.Thread(target=write)
+        t.start()
+        try:
+            time.sleep(0.15)
+            assert not done.is_set()  # serialized behind the foreign lock
+            assert not os.path.exists(fleet.manifest_path)
+        finally:
+            lease.release(lock_fd)
+            t.join(timeout=10)
+        assert done.is_set()
+        with open(fleet.manifest_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert [p["id"] for p in doc["partitions"]] == ["w0"]
+
+    def test_two_writer_hammering_never_tears_the_manifest(self, tmp_path):
+        """Two Fleet instances over ONE fleet dir (two open files — a
+        real flock conflict, same as two processes) hammer writes
+        concurrently; every intermediate read parses and the final doc is
+        whole."""
+        a = Fleet(str(tmp_path))
+        b = Fleet(str(tmp_path))
+        a.attach("http://127.0.0.1:1/", "wa")
+        b.attach("http://127.0.0.1:2/", "wb")
+        stop = threading.Event()
+        torn = []
+
+        def hammer(fleet):
+            while not stop.is_set():
+                fleet.write_manifest()
+
+        def read():
+            while not stop.is_set():
+                try:
+                    with open(a.manifest_path, encoding="utf-8") as f:
+                        doc = json.load(f)
+                    if doc.get("version") != 1:
+                        torn.append(doc)
+                except FileNotFoundError:
+                    pass
+                except ValueError as err:
+                    torn.append(repr(err))
+
+        threads = [threading.Thread(target=hammer, args=(a,)),
+                   threading.Thread(target=hammer, args=(b,)),
+                   threading.Thread(target=read)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not torn
+        with open(a.manifest_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["version"] == 1 and len(doc["partitions"]) == 1
+
+    def test_follower_replica_never_writes(self, tmp_path):
+        primary = Fleet(str(tmp_path))
+        primary.attach("http://127.0.0.1:1/", "w0")
+        assert primary.enable_leader_election("r0") is True
+        follower = Fleet(str(tmp_path), replica=True)
+        follower.load()
+        assert follower.enable_leader_election("r1") is False
+        before = open(primary.manifest_path, "rb").read()
+        follower.attach("http://127.0.0.1:9/", "w9")  # in-memory only
+        assert open(primary.manifest_path, "rb").read() == before
+        primary.release_leadership()
+        follower.release_leadership()
+
+    def test_config_block_round_trips(self, tmp_path):
+        primary = Fleet(str(tmp_path))
+        primary.manifest_config = {"serve_args": ["--max-batch", "8"],
+                                   "big_edge": 2048}
+        primary.attach("http://127.0.0.1:1/", "w0")
+        replica = Fleet(str(tmp_path), replica=True)
+        replica.load()
+        assert replica.manifest_config == primary.manifest_config
+
+
+# ---------------------------------------------------------------------------
+# Leader election over the shared fleet dir
+
+
+class TestLeaderElection:
+    def test_lease_less_fleet_supervises_unconditionally(self, tmp_path):
+        fleet = Fleet(str(tmp_path))
+        assert fleet.leading is True  # exactly as before elections existed
+
+    def test_follower_takes_over_on_release(self, tmp_path):
+        primary = Fleet(str(tmp_path))
+        primary.attach("http://127.0.0.1:1/", "w0")
+        assert primary.enable_leader_election("r0") is True
+        replica = Fleet(str(tmp_path), replica=True)
+        replica.load()
+        assert replica.enable_leader_election("r1") is False
+        assert not replica.leading
+        # While following, a health tick re-contests but cannot win.
+        replica._poll_leadership()
+        assert not replica.leading
+        primary.release_leadership()
+        replica._poll_leadership()  # what every health tick runs
+        assert replica.leading
+        assert not os.path.exists(os.path.join(str(tmp_path), "nonsense"))
+        replica.release_leadership()
+        assert not replica.leading  # a replica demotes on voluntary release
+
+    def test_replica_load_adopts_dead_partitions_without_respawn(
+            self, tmp_path):
+        primary = Fleet(str(tmp_path))
+        # A LOCAL partition record (journal set, not attached) whose
+        # process is gone: the old load() would have respawned it.
+        primary._workers["w0"] = Worker(id="w0", url="http://127.0.0.1:1",
+                                        journal_dir=str(tmp_path / "w0"))
+        primary.write_manifest()
+        replica = Fleet(str(tmp_path), replica=True)
+        n = replica.load()
+        assert n == 1
+        worker = replica.worker("w0")
+        assert worker is not None
+        assert worker.proc is None  # adopted, never spawned
+        assert worker.healthy is False  # probed, not trusted
+
+    def test_reconcile_follows_the_leaders_manifest(self, tmp_path):
+        primary = Fleet(str(tmp_path))
+        primary.attach("http://127.0.0.1:1/", "w0")
+        replica = Fleet(str(tmp_path), replica=True)
+        replica.load()
+        assert {w.id for w in replica.workers()} == {"w0"}
+        # Scale-up appears...
+        primary.attach("http://127.0.0.1:2/", "w1")
+        assert replica.reconcile_from_manifest() >= 1
+        assert {w.id for w in replica.workers()} \
+            == {"w0", "w1"}
+        # ...a respawn's fresh URL replaces the dead one...
+        primary.worker("w0").url = "http://127.0.0.1:3"
+        primary.write_manifest()
+        replica.reconcile_from_manifest()
+        assert replica.worker("w0").url == "http://127.0.0.1:3"
+        # ...and a retire drops out.
+        with primary._lock:
+            del primary._workers["w1"]
+        primary.write_manifest()
+        replica.reconcile_from_manifest()
+        assert {w.id for w in replica.workers()} == {"w0"}
+
+
+# ---------------------------------------------------------------------------
+# Durable counter floors
+
+
+class TestDurableFloors:
+    def _snap(self, value):
+        return {"counters": {"jobs_completed_total": value}}
+
+    def test_state_seed_round_trip_survives_router_restart(self):
+        """The regression the floors exist to prevent, now for ROUTER
+        death: worker respawns banked into a router's floors must not
+        reset when the router itself is replaced."""
+        counters = MonotonicCounters()
+        counters.adjust({"w0": self._snap(100.0)})
+        # The worker respawns: its raw counter regresses, the floor banks
+        # the old run.
+        snap = counters.adjust({"w0": self._snap(5.0)})
+        assert snap["w0"]["counters"]["jobs_completed_total"] == 105.0
+        state = json.loads(json.dumps(counters.state()))  # disk-shaped
+        successor = MonotonicCounters()
+        successor.seed(state)
+        snap = successor.adjust({"w0": self._snap(7.0)})
+        assert snap["w0"]["counters"]["jobs_completed_total"] == 107.0
+
+    def test_seed_banks_a_respawn_during_the_router_outage(self):
+        """A worker that restarted while NO router watched answers the
+        successor's first scrape with value < the seeded last — the
+        regression fallback banks the lost run."""
+        counters = MonotonicCounters()
+        counters.adjust({"w0": self._snap(50.0)})
+        successor = MonotonicCounters()
+        successor.seed(counters.state())
+        snap = successor.adjust({"w0": self._snap(2.0)})
+        assert snap["w0"]["counters"]["jobs_completed_total"] == 52.0
+
+    def test_seed_is_first_writer_only(self):
+        counters = MonotonicCounters()
+        counters.adjust({"w0": self._snap(10.0)})
+        counters.seed({"version": 1, "base": [], "incarnations": {},
+                       "last": [["w0", ["c", "jobs_completed_total"],
+                                 999.0]]})
+        snap = counters.adjust({"w0": self._snap(11.0)})
+        assert snap["w0"]["counters"]["jobs_completed_total"] == 11.0
+
+    def test_floors_store_roundtrip_and_tolerance(self, tmp_path):
+        store = replicate.FloorsStore(str(tmp_path / "r0"))
+        assert store.load() is None
+        state = {"version": 1, "base": [], "last": [], "incarnations": {}}
+        store.save(state)
+        assert replicate.FloorsStore(str(tmp_path / "r0")).load() == state
+        # Damage tolerance: garbage loads as None, never raises.
+        with open(store.path, "w", encoding="utf-8") as f:
+            f.write("{torn")
+        assert replicate.FloorsStore(str(tmp_path / "r0")).load() is None
+
+    def test_save_skips_unchanged_state(self, tmp_path):
+        store = replicate.FloorsStore(str(tmp_path / "r0"))
+        state = {"version": 1, "base": [["w0", ["c", "x"], 5.0]],
+                 "last": [], "incarnations": {}}
+        store.save(state)
+        stamp = os.stat(store.path).st_mtime_ns
+        store.save(dict(state))
+        assert os.stat(store.path).st_mtime_ns == stamp  # zero I/O idle
+
+    def test_merged_floors_take_the_larger_total(self, tmp_path):
+        key = ["counters", "jobs_completed_total"]
+        replicate.FloorsStore(
+            str(tmp_path / replicate.ROUTERS_SUBDIR / "r0")).save({
+                "version": 1, "base": [["w0", key, 100.0]],
+                "last": [["w0", key, 5.0]], "incarnations": {"w0": 2}})
+        replicate.FloorsStore(
+            str(tmp_path / replicate.ROUTERS_SUBDIR / "r1")).save({
+                "version": 1, "base": [["w0", key, 40.0]],
+                "last": [["w0", key, 9.0]], "incarnations": {"w0": 3}})
+        merged = replicate.load_merged_floors(str(tmp_path))
+        assert merged is not None
+        assert merged["base"] == [["w0", key, 100.0]]  # 105 beats 49
+        assert merged["last"] == [["w0", key, 5.0]]
+        assert merged["incarnations"] == {"w0": 3}  # max wins
+
+    def test_merged_floors_none_when_nothing_persisted(self, tmp_path):
+        assert replicate.load_merged_floors(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# Breaker warm-start
+
+
+class TestBreakerWarmStart:
+    def test_reopen_trips_only_from_closed(self):
+        transitions = []
+        br = CircuitBreaker(BreakerConfig(cooldown_s=60.0),
+                            on_transition=lambda *a: transitions.append(a),
+                            label="w0")
+        assert br.state == CLOSED
+        br.reopen()
+        assert br.state == OPEN
+        assert br.penalty() == 1  # fresh cooldown from NOW
+        br.reopen()  # idempotent: already open
+        assert transitions == [("w0", CLOSED, OPEN)]
+
+    def _ring(self, tmp_path, rid, events):
+        ring = HistoryWriter(
+            os.path.join(replicate.state_dir(str(tmp_path), rid),
+                         replicate.BREAKER_RING),
+            source="breaker")
+        for worker, old, new in events:
+            ring.append({"breaker": {"worker": worker, "from": old,
+                                     "to": new}})
+        ring.close()
+
+    def test_warm_states_fold_to_last_word_per_worker(self, tmp_path):
+        self._ring(tmp_path, "r0", [
+            ("w0", "closed", "open"),
+            ("w0", "open", "half-open"),
+            ("w0", "half-open", "closed"),  # recovered: NOT warm
+            ("w1", "closed", "open"),       # died open: warm
+        ])
+        assert replicate.warm_breaker_states(str(tmp_path)) == {"w1": "open"}
+
+    def test_half_open_at_death_rearms_open(self, tmp_path):
+        self._ring(tmp_path, "r0", [("w0", "open", "half-open")])
+        assert replicate.warm_breaker_states(str(tmp_path)) == {"w0": "open"}
+
+    def test_any_replicas_open_verdict_wins(self, tmp_path):
+        self._ring(tmp_path, "r0", [("w0", "half-open", "closed")])
+        self._ring(tmp_path, "r1", [("w0", "closed", "open")])
+        assert replicate.warm_breaker_states(str(tmp_path)) == {"w0": "open"}
+
+    def test_empty_fleet_dir_is_cold(self, tmp_path):
+        assert replicate.warm_breaker_states(str(tmp_path)) == {}
+
+
+# ---------------------------------------------------------------------------
+# Router advertisement / roster
+
+
+class TestRouterRoster:
+    def test_advertise_and_list(self, tmp_path):
+        replicate.advertise(str(tmp_path), "r0", "http://127.0.0.1:8000")
+        routers = replicate.list_routers(str(tmp_path))
+        assert len(routers) == 1
+        advert = routers[0]
+        assert advert["id"] == "r0"
+        assert advert["url"] == "http://127.0.0.1:8000"
+        assert advert["pid"] == os.getpid()
+        assert advert["alive"] is True  # our own pid exists
+
+    def test_dead_pid_reads_gone(self, tmp_path):
+        directory = replicate.state_dir(str(tmp_path), "rX")
+        os.makedirs(directory)
+        with open(os.path.join(directory, replicate.ADVERT_FILENAME),
+                  "w", encoding="utf-8") as f:
+            json.dump({"id": "rX", "url": "http://x", "pid": 2 ** 22 + 9},
+                      f)
+        routers = replicate.list_routers(str(tmp_path))
+        assert routers and routers[0]["alive"] is False
+
+
+# ---------------------------------------------------------------------------
+# The client->router chaos matrix (satellite: the hop PR 14 left bare)
+
+
+@pytest.fixture(scope="module")
+def control_workers(tmp_path_factory):
+    root = tmp_path_factory.mktemp("control-fleet")
+    workers = {}
+    for wid in ("w0", "w1"):
+        srv = GolServer(port=0, journal_dir=str(root / wid), flush_age=0.01)
+        srv.start()
+        workers[wid] = srv
+    yield root, workers
+    for srv in workers.values():
+        srv.shutdown()
+
+
+_HOP_PLANS = {
+    "latency": "seed=201,latency=0.3,latency_ms=30",
+    "reset": "seed=202,reset=0.15",
+    "refuse": "seed=203,refuse=0.2",
+}
+
+
+class TestRouterReplicaChaos:
+    """Two replica routers over ONE fleet, chaos on the CLIENT->ROUTER
+    hop (PR 14's matrix chaoses router->worker; this is the other hop).
+    The client stance mirrors `gol submit --servers`: POSTs rotate to the
+    sibling only on connection-level trouble, GETs rotate freely — and
+    the audit is the same: every accepted job DONE exactly once, every
+    result oracle-byte-identical, and no id EVER holds two done records
+    (a reset-after-accept orphan completes exactly once under its own
+    id)."""
+
+    GENS = 6
+    JOBS = 6
+
+    def _rig(self, tmp_path, workers):
+        primary = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            primary.attach(srv.url, wid)
+        assert primary.enable_leader_election("r0") is True
+        r0 = RouterServer(primary, port=0, router_id="r0",
+                          state_dir=replicate.state_dir(
+                              primary.fleet_dir, "r0"))
+        r0.start()
+        follower = Fleet(str(tmp_path / "fleet"), replica=True)
+        follower.load()
+        assert follower.enable_leader_election("r1") is False
+        r1 = RouterServer(follower, port=0, router_id="r1",
+                          state_dir=replicate.state_dir(
+                              follower.fleet_dir, "r1"))
+        r1.start()
+        return r0, r1
+
+    def _boards(self, salt):
+        return [text_grid.generate(32, 32, seed=9000 + 64 * salt + i)
+                for i in range(self.JOBS)]
+
+    def _submit_one(self, bases, board):
+        meta = {"gen_limit": self.GENS}
+        body = {"width": 32, "height": 32,
+                "cells": text_grid.encode(board).decode("ascii"), **meta}
+        for attempt in range(200):
+            base = bases[attempt % len(bases)]
+            try:
+                status, payload = _http("POST", f"{base}/jobs", body,
+                                        timeout=10)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                # Refused: rotate to the sibling replica. A reset is
+                # ambiguous — the production client surfaces it; the
+                # matrix resubmits KNOWINGLY (fresh id), and the audit
+                # proves the possible orphan still lands exactly one
+                # done record under its own id.
+                time.sleep(0.02)
+                continue
+            if status == 202 and isinstance(payload, dict) \
+                    and payload.get("id"):
+                return payload["id"], base
+            if status in (429, 503, 504):
+                time.sleep(0.02)
+                continue
+            raise AssertionError(f"unexpected submit answer {status}: "
+                                 f"{payload}")
+        raise AssertionError("submit never landed")
+
+    def _await_done(self, bases, job_id):
+        for attempt in range(600):
+            base = bases[attempt % len(bases)]
+            try:
+                status, payload = _http("GET", f"{base}/jobs/{job_id}",
+                                        timeout=10)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.02)
+                continue
+            state = (payload.get("state")
+                     if isinstance(payload, dict) else None)
+            if state == "done":
+                return
+            if state in ("failed", "cancelled"):
+                raise AssertionError(f"job {job_id} ended {state}")
+            time.sleep(0.02)
+        raise AssertionError(f"job {job_id} never finished")
+
+    def _fetch_result(self, bases, job_id):
+        for attempt in range(300):
+            base = bases[attempt % len(bases)]
+            try:
+                status, payload = _http("GET", f"{base}/result/{job_id}",
+                                        timeout=10)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.02)
+                continue
+            if status != 200 or not isinstance(payload, dict):
+                time.sleep(0.02)
+                continue
+            grid = text_grid.decode(payload["grid"].encode("ascii"),
+                                    payload["width"], payload["height"])
+            return payload, grid
+        raise AssertionError(f"result {job_id} never fetched")
+
+    def _audit(self, root, workers, accepted):
+        def done():
+            records: dict = {}
+            for wid in workers:
+                path = root / wid / "journal.jsonl"
+                if not path.exists():
+                    continue
+                for line in path.read_bytes().split(b"\n"):
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "done":
+                        records.setdefault(rec["id"], []).append(wid)
+            return records
+
+        assert _wait(lambda: set(accepted) <= set(done()), timeout=20)
+        records = done()
+        for job_id in accepted:
+            assert len(records[job_id]) == 1, (job_id, records[job_id])
+        # NO id anywhere holds two done records — the exactly-once
+        # catch-all that also covers reset-after-accept orphans.
+        for job_id, where in records.items():
+            assert len(where) == 1, (job_id, where)
+
+    @pytest.mark.parametrize("fault", sorted(_HOP_PLANS))
+    def test_hop_fault_class(self, fault, tmp_path, control_workers):
+        root, workers = control_workers
+        r0, r1 = self._rig(tmp_path, workers)
+        pool = ProxyPool(ChaosPlan.parse(_HOP_PLANS[fault]))
+        try:
+            # Chaos fronts the CLIENT->ROUTER hop: the client dials the
+            # proxies; the routers themselves stay clean.
+            bases = [pool.url_for(r0.url), pool.url_for(r1.url)]
+            accepted = {}
+            for board in self._boards(sorted(_HOP_PLANS).index(fault)):
+                job_id, _ = self._submit_one(bases, board)
+                accepted[job_id] = board
+            for job_id in accepted:
+                self._await_done(bases, job_id)
+            for job_id, board in accepted.items():
+                result, got = self._fetch_result(bases, job_id)
+                want = oracle.run(board, GameConfig(gen_limit=self.GENS))
+                np.testing.assert_array_equal(np.asarray(got), want.grid)
+                assert result["generations"] == want.generations
+            assert pool.stats().get(fault, 0) > 0  # the fault FIRED
+        finally:
+            pool.close()
+            r1.shutdown(cascade=False)
+            r0.shutdown(cascade=False)
+        self._audit(root, workers, accepted)
+
+    def test_router_down_mid_load_is_invisible(self, tmp_path,
+                                               control_workers):
+        """The tentpole's acceptance row: with N=2 replicas, dropping the
+        leader router mid-load costs nothing — the client fails over to
+        the survivor, the survivor takes the lease, and every accepted
+        job (submitted via EITHER router) ends DONE exactly once and
+        oracle-identical."""
+        root, workers = control_workers
+        r0, r1 = self._rig(tmp_path, workers)
+        killed = False
+        try:
+            accepted = {}
+            boards = self._boards(11)
+            for board in boards[:self.JOBS // 2]:
+                job_id, _ = self._submit_one([r0.url], board)
+                accepted[job_id] = board
+            # Drop the leader mid-load. In-process the shutdown releases
+            # the lease the way the kernel would on SIGKILL (the
+            # kernel-drop itself is pinned in TestFlockLease); the REAL
+            # kill -9 end-to-end runs in tools/control_smoke.py.
+            r0.shutdown(cascade=False)
+            killed = True
+            with pytest.raises((urllib.error.URLError, ConnectionError,
+                                OSError)):
+                _http("GET", f"{r0.url}/healthz", timeout=2)
+            # The survivor takes the lease on its next tick...
+            r1.fleet._poll_leadership()
+            assert r1.fleet.leading
+            # ...and carries the rest of the load alone.
+            for board in boards[self.JOBS // 2:]:
+                job_id, _ = self._submit_one([r1.url], board)
+                accepted[job_id] = board
+            for job_id in accepted:
+                self._await_done([r1.url], job_id)
+            for job_id, board in accepted.items():
+                result, got = self._fetch_result([r1.url], job_id)
+                want = oracle.run(board, GameConfig(gen_limit=self.GENS))
+                np.testing.assert_array_equal(np.asarray(got), want.grid)
+        finally:
+            r1.shutdown(cascade=False)
+            if not killed:
+                r0.shutdown(cascade=False)
+        self._audit(root, workers, accepted)
+
+    def test_floors_survive_router_replacement(self, tmp_path,
+                                               control_workers):
+        """Durable coordination state end to end: a router that scraped
+        real workers persists its floors; a SUCCESSOR router (fresh id,
+        fresh process state) seeds from the merged files and its merged
+        counters never regress."""
+        root, workers = control_workers
+        primary = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            primary.attach(srv.url, wid)
+        r0 = RouterServer(primary, port=0, router_id="r0",
+                          state_dir=replicate.state_dir(
+                              primary.fleet_dir, "r0"))
+        r0.start()
+        try:
+            board = text_grid.generate(32, 32, seed=321)
+            job_id, _ = self._submit_one([r0.url], board)
+            self._await_done([r0.url], job_id)
+            status, merged = _http("GET", f"{r0.url}/metrics?format=json")
+            assert status == 200
+            floors_path = os.path.join(
+                replicate.state_dir(primary.fleet_dir, "r0"),
+                replicate.FLOORS_FILENAME)
+            assert _wait(lambda: os.path.exists(floors_path), timeout=10)
+        finally:
+            r0.shutdown(cascade=False)
+        successor = Fleet(str(tmp_path / "fleet"), replica=True)
+        successor.load()
+        r2 = RouterServer(successor, port=0, router_id="r2",
+                          state_dir=replicate.state_dir(
+                              successor.fleet_dir, "r2"))
+        r2.start()
+        try:
+            status, merged2 = _http("GET", f"{r2.url}/metrics?format=json")
+            assert status == 200
+            done_before = sum(
+                (w.get("counters") or {}).get("jobs_completed_total", 0)
+                for w in (merged.get("workers") or {}).values())
+            done_after = sum(
+                (w.get("counters") or {}).get("jobs_completed_total", 0)
+                for w in (merged2.get("workers") or {}).values())
+            assert done_after >= done_before  # monotonic across routers
+            assert merged2["fleet"]["router_id"] == "r2"
+        finally:
+            r2.shutdown(cascade=False)
+
+
+# ---------------------------------------------------------------------------
+# The --servers client ring (satellite: `gol top` against a dead router)
+
+
+class TestServerRing:
+    def test_ring_parsing_and_rotation(self):
+        from gol_tpu.cli import _ServerRing
+
+        ring = _ServerRing("http://a:1, http://b:2/,http://c:3")
+        assert ring.bases == ["http://a:1", "http://b:2", "http://c:3"]
+        assert ring.current == "http://a:1"
+        assert ring.others("http://b:2") == ["http://c:3", "http://a:1"]
+        ring.prefer("http://c:3")
+        assert ring.rotation() == ["http://c:3", "http://a:1", "http://b:2"]
+        assert _ServerRing("http://solo:1").others("http://solo:1") == []
+        with pytest.raises(ValueError):
+            _ServerRing(" , ")
+
+    def test_top_fails_over_and_names_the_answering_router(
+            self, tmp_path, capsys, monkeypatch):
+        """Satellite regression: `gol top` against a DEAD router used to
+        render empty frames forever. With --servers it walks the ring,
+        renders the survivor's view, and the title names which replica
+        answered."""
+        import argparse
+
+        from gol_tpu import cli
+
+        primary = Fleet(str(tmp_path / "fleet"))
+        primary.attach("http://127.0.0.1:1/", "w0")
+        live = RouterServer(primary, port=0, router_id="r1",
+                            state_dir=replicate.state_dir(
+                                primary.fleet_dir, "r1"))
+        live.start()
+        try:
+            dead = "http://127.0.0.1:9"  # discard port: refuses instantly
+            args = argparse.Namespace(
+                server=dead, servers=f"{dead},{live.url}",
+                interval=0.05, iterations=1, no_ansi=True)
+            rc = cli._top(args)
+            out = capsys.readouterr().out
+        finally:
+            live.shutdown(cascade=False)
+        assert rc == 0
+        assert f"gol top — {live.url.rstrip('/')}" in out
+        assert "answered by" in out
+        assert "router" in out  # the replica panel rendered
+
+    def test_top_single_server_title_is_pinned(self, tmp_path, capsys):
+        import argparse
+
+        from gol_tpu import cli
+
+        args = argparse.Namespace(
+            server="http://127.0.0.1:9", servers=None,
+            interval=0.05, iterations=1, no_ansi=True)
+        assert cli._top(args) == 0
+        out = capsys.readouterr().out
+        assert "gol top — http://127.0.0.1:9" in out
+        assert "answered by" not in out
+        assert "routers unreachable" not in out  # no ring annotations
+
+    def test_collect_results_rehomes_polling_to_a_live_replica(
+            self, tmp_path, control_workers, capsys):
+        """`gol submit --wait --servers`: a job recorded against the dead
+        router's base is polled (and its result fetched) via the
+        surviving replica — any replica can look up any job."""
+        import argparse
+
+        from gol_tpu import cli
+
+        root, workers = control_workers
+        primary = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            primary.attach(srv.url, wid)
+        live = RouterServer(primary, port=0, router_id="r1",
+                            state_dir=replicate.state_dir(
+                                primary.fleet_dir, "r1"))
+        live.start()
+        try:
+            board = text_grid.generate(32, 32, seed=77)
+            status, payload = _http("POST", f"{live.url}/jobs", {
+                "width": 32, "height": 32,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": 4})
+            assert status == 202
+            dead = "http://127.0.0.1:9"
+            src = str(tmp_path / "in.txt")
+            text_grid.write_grid(src, board)
+            args = argparse.Namespace(
+                poll_interval=0.05, server_timeout=30.0, wire="text")
+            ring = cli._ServerRing([dead, live.url])
+            rc = cli._collect_results(
+                {payload["id"]: (src, dead)}, args, str(tmp_path),
+                ring=ring)
+            err = capsys.readouterr().err
+        finally:
+            live.shutdown(cascade=False)
+        assert rc == 0
+        assert "polling job" in err and live.url.rstrip("/") in err
+        got = text_grid.read_grid(
+            os.path.join(str(tmp_path), "in.txt.out"), 32, 32)
+        want = oracle.run(board, GameConfig(gen_limit=4))
+        np.testing.assert_array_equal(np.asarray(got), want.grid)
+
+
+# ---------------------------------------------------------------------------
+# Leader-gated ticks
+
+
+class TestLeaderGatedTicks:
+    def test_follower_autoscaler_tick_noops(self, tmp_path):
+        from gol_tpu.fleet.autoscale import AutoscaleConfig, Autoscaler
+
+        primary = Fleet(str(tmp_path))
+        primary.attach("http://127.0.0.1:1/", "w0")
+        assert primary.enable_leader_election("r0")
+        follower = Fleet(str(tmp_path), replica=True)
+        follower.load()
+        follower.enable_leader_election("r1")
+
+        class _Router:
+            def slo_json(self):
+                raise AssertionError("a follower must not even scrape")
+
+            url = "http://x"
+
+        scaler = Autoscaler(follower, _Router(),
+                            AutoscaleConfig(min_workers=1, max_workers=4))
+        assert scaler.tick() is None  # gated before any work
+        primary.release_leadership()
+        follower.release_leadership()
+
+    def test_follower_health_tick_reconciles_membership(self, tmp_path):
+        primary = Fleet(str(tmp_path))
+        primary.attach("http://127.0.0.1:1/", "w0")
+        assert primary.enable_leader_election("r0")
+        follower = Fleet(str(tmp_path), replica=True)
+        follower.load()
+        follower.enable_leader_election("r1")
+        primary.attach("http://127.0.0.1:2/", "w1")
+        follower.health_tick()  # reconciles BEFORE probing
+        assert {w.id for w in follower.workers()} \
+            == {"w0", "w1"}
+        primary.release_leadership()
+        follower.release_leadership()

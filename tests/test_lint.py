@@ -134,6 +134,32 @@ def test_no_wall_clock_in_fleet():
         )
 
 
+def test_no_wall_clock_in_lease_or_replicate():
+    """The gol_tpu/fleet/ pin of the rule above for the PR-16 control
+    plane (the whole-tree fleet test already covers both files; this one
+    exists so a future split of the coordination layer out of fleet/
+    cannot silently drop it). fleet/lease.py holds NO clocks BY DESIGN —
+    leadership is a kernel flock, not a TTL: any timestamp-based lease
+    would need wall-clock comparisons ACROSS processes, which step under
+    NTP and turn two concurrent 'leaders' into a split brain.
+    fleet/replicate.py persists floors/breaker state with NO timestamps
+    for the same reason — perf_counter anchors do not compare across
+    processes, so durable coordination state must carry no time at
+    all."""
+    for name in ("lease.py", "replicate.py"):
+        path = _LIBRARY_ROOT / "fleet" / name
+        assert path.exists(), f"gol_tpu/fleet/{name} moved; update this pin"
+        source = path.read_text(encoding="utf-8")
+        for needle in ("time.time(", "datetime.now", "perf_counter("):
+            hits = [i + 1 for i, line in enumerate(source.splitlines())
+                    if needle in line and not line.lstrip().startswith("#")]
+            assert not hits, (
+                f"clock call {needle} in gol_tpu/fleet/{name}:{hits} — "
+                "the control plane is clock-free by design (flock "
+                "leases, not TTLs; timestamp-free durable state)"
+            )
+
+
 def test_no_wall_clock_in_cache():
     """Same rule for gol_tpu/cache/: the result cache sits on the serve
     admission path (consult-before-enqueue) and feeds the same latency

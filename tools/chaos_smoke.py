@@ -23,9 +23,10 @@ processes, with the chaos proxy armed on the router->worker data path:
 6. SIGTERM the fleet (graceful cascade, rc 0), then audit:
    - every accepted id holds EXACTLY one done record across both
      partition journals (none lost, none double-run);
-   - the durable breaker ring (``<fleet-dir>/breaker-history``) recorded
-     the victim's open AND the re-close — the decision trail an operator
-     replays after the fact.
+   - the durable breaker ring (``<fleet-dir>/routers/r0/breaker-history``,
+     the primary router's per-replica state dir) recorded the victim's
+     open AND the re-close — the decision trail an operator replays
+     after the fact.
 
 Exit code 0 on success, 1 with a diagnostic on any violation:
 
@@ -383,7 +384,8 @@ def main(argv=None) -> int:
         proc = None
 
         # The durable breaker ring recorded the open AND the re-close.
-        ring_dir = os.path.join(fleet_dir, "breaker-history")
+        ring_dir = os.path.join(fleet_dir, "routers", "r0",
+                                "breaker-history")
         transitions = [r["breaker"] for r
                        in obs_history.read_records(ring_dir)
                        if "breaker" in r and "record_kind" not in r]

@@ -1,0 +1,379 @@
+"""Control-plane failover smoke: SIGKILL the lease-holding router,
+verify the survivor takes over with zero lost work.
+
+The `make control-smoke` harness, exercising the horizontal control
+plane end-to-end against real OS processes:
+
+1. boot ``gol fleet --workers 2 --routers 2`` on a fresh ``--fleet-dir``
+   (the primary router ``r0`` holds the leader flock; replica ``r1``
+   boots from the shared manifest and advertises its URL under
+   ``<fleet-dir>/routers/r1/advert.json``);
+2. submit the first half of the load ALTERNATING between both routers —
+   any replica must place and forward, not just the leader;
+3. SIGKILL the lease-holding router (``r0``, the ``gol fleet`` process
+   itself) while jobs are in flight: the kernel drops its flock, and
+   the surviving replica's next health tick must win the lease and
+   report ``leader: true`` on ``/healthz``;
+4. SIGKILL a worker that accepted work: the SURVIVOR's health loop must
+   detect and respawn it on the same partition (supervision ticks
+   transferred with the lease, not just the label);
+5. submit the second half of the load through the survivor, then wait
+   until every accepted job reports DONE through it;
+6. verify every result against the NumPy oracle (byte-identical through
+   both kills);
+7. SIGTERM the survivor and the workers, then audit across ALL
+   partition journals that every accepted id has EXACTLY one done
+   record fleet-wide (none lost, none double-run through the router
+   handoff).
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/control_smoke.py [--jobs 60] [--gen-limit 300]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu import oracle  # noqa: E402
+from gol_tpu.config import GameConfig  # noqa: E402
+from gol_tpu.io import text_grid  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _start_fleet(port: int, fleet_dir: str):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "fleet",
+            "--port", str(port),
+            "--workers", "2",
+            "--routers", "2",
+            "--fleet-dir", fleet_dir,
+            "--flush-age", "0.05",
+            "--health-interval", "0.5",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.perf_counter() + 300
+    base = f"http://127.0.0.1:{port}"
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(
+                f"fleet died on boot rc={proc.returncode}:\n{out[-4000:]}"
+            )
+        try:
+            status, payload = _http("GET", f"{base}/healthz", timeout=2)
+            if (status == 200 and payload.get("leader")
+                    and payload.get("fleet", {}).get("workers") == 2):
+                return proc
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("fleet did not become healthy within 300s")
+
+
+def _wait_replica(fleet_dir: str, rid: str, timeout: float = 120):
+    """Wait for the replica's advert + a live /healthz; return (url, pid)."""
+    advert_path = os.path.join(fleet_dir, "routers", rid, "advert.json")
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        try:
+            with open(advert_path, encoding="utf-8") as f:
+                advert = json.load(f)
+            status, payload = _http(
+                "GET", f"{advert['url']}/healthz", timeout=2)
+            if status == 200 and payload.get("id") == rid:
+                return advert["url"], advert["pid"]
+        except (OSError, ValueError, KeyError):
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"replica {rid} never advertised a live /healthz")
+
+
+def _fleet_workers(base: str) -> list:
+    status, payload = _http("GET", f"{base}/fleet")
+    if status != 200:
+        raise RuntimeError(f"GET /fleet -> {status}: {payload}")
+    return payload["workers"]
+
+
+def _count_done(fleet_dir: str) -> dict:
+    """id -> [(partition, record)] across every partition journal."""
+    from gol_tpu.serve import compaction
+
+    done: dict = {}
+    for name in sorted(os.listdir(fleet_dir)):
+        part = os.path.join(fleet_dir, name)
+        if not os.path.isfile(os.path.join(part, "journal.jsonl")):
+            continue
+        for rec in compaction.iter_records(part):
+            if rec.get("event") == "done":
+                done.setdefault(rec["id"], []).append((name, rec))
+    return done
+
+
+def _term_and_wait(pid: int, label: str, timeout: float = 60) -> bool:
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        return True
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True
+        time.sleep(0.1)
+    print(f"control-smoke: {label} pid {pid} ignored SIGTERM")
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    return False
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jobs", type=int, default=60)
+    parser.add_argument("--gen-limit", type=int, default=300)
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-control-smoke-")
+    fleet_dir = os.path.join(workdir, "fleet")
+    port = _free_port()
+    r0_url = f"http://127.0.0.1:{port}"
+    cfg = GameConfig(gen_limit=args.gen_limit)
+    sides = (32, 30)
+
+    rc = 1
+    proc = None
+    cleanup_pids: list = []
+    try:
+        proc = _start_fleet(port, fleet_dir)
+        r1_url, r1_pid = _wait_replica(fleet_dir, "r1")
+        cleanup_pids.append(("replica r1", r1_pid))
+        print(f"control-smoke: 2-router fleet up — r0 {r0_url} (leader), "
+              f"r1 {r1_url}")
+
+        # First half of the load, alternating routers: ANY replica places.
+        accepted = {}  # id -> (board, router that accepted it)
+        half = args.jobs // 2
+        taken_by = {"r0": 0, "r1": 0}
+        for i in range(half):
+            side = sides[i % 2]
+            board = text_grid.generate(side, side, seed=7000 + i)
+            rid, base = ("r0", r0_url) if i % 2 == 0 else ("r1", r1_url)
+            status, payload = _http("POST", f"{base}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": args.gen_limit,
+            })
+            if status != 202:
+                print(f"control-smoke: submit {i} via {rid} rejected "
+                      f"HTTP {status}: {payload}")
+                return 1
+            accepted[payload["id"]] = board
+            taken_by[rid] += 1
+        if not (taken_by["r0"] and taken_by["r1"]):
+            print(f"control-smoke: expected both routers to accept work, "
+                  f"got {taken_by}")
+            return 1
+        print(f"control-smoke: {half} jobs accepted ({taken_by}); "
+              f"SIGKILL leader r0 (pid {proc.pid}) mid-load")
+
+        # Kill the lease holder with jobs in flight. The kernel drops its
+        # flock; r1's next health tick must win the lease.
+        cleanup_pids.extend(
+            ("worker " + w["id"], w["pid"])
+            for w in _fleet_workers(r1_url) if w.get("pid"))
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.communicate()
+        proc = None
+
+        deadline = time.perf_counter() + 120
+        took_over = False
+        while time.perf_counter() < deadline:
+            try:
+                status, payload = _http("GET", f"{r1_url}/healthz", timeout=2)
+                if status == 200 and payload.get("leader"):
+                    took_over = True
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.2)
+        if not took_over:
+            print("control-smoke: r1 never took the lease after r0's "
+                  "SIGKILL")
+            return 1
+        print("control-smoke: survivor r1 holds the lease")
+
+        # Supervision moved with the lease: SIGKILL a worker, the SURVIVOR
+        # must respawn it on the same partition.
+        victim = _fleet_workers(r1_url)[0]
+        print(f"control-smoke: SIGKILL worker {victim['id']} "
+              f"(pid {victim['pid']}) under the survivor's watch")
+        os.kill(victim["pid"], signal.SIGKILL)
+        deadline = time.perf_counter() + 300
+        respawned = False
+        while time.perf_counter() < deadline:
+            try:
+                workers = _fleet_workers(r1_url)
+            except (RuntimeError, urllib.error.URLError, OSError):
+                time.sleep(0.2)
+                continue
+            mine = next((w for w in workers if w["id"] == victim["id"]), None)
+            if mine and mine.get("healthy") and mine.get("restarts", 0) >= 1:
+                respawned = True
+                cleanup_pids.append(("worker " + mine["id"], mine["pid"]))
+                break
+            time.sleep(0.2)
+        if not respawned:
+            print("control-smoke: survivor never respawned the killed "
+                  "worker — supervision ticks did not transfer")
+            return 1
+        print("control-smoke: survivor respawned the worker "
+              "(ticks continue)")
+
+        # Second half of the load through the survivor alone.
+        for i in range(half, args.jobs):
+            side = sides[i % 2]
+            board = text_grid.generate(side, side, seed=7000 + i)
+            status, payload = _http("POST", f"{r1_url}/jobs", {
+                "width": side, "height": side,
+                "cells": text_grid.encode(board).decode("ascii"),
+                "gen_limit": args.gen_limit,
+            })
+            if status != 202:
+                print(f"control-smoke: post-failover submit {i} rejected "
+                      f"HTTP {status}: {payload}")
+                return 1
+            accepted[payload["id"]] = board
+
+        # Every accepted job must reach DONE through the survivor.
+        deadline = time.perf_counter() + 600
+        pending = set(accepted)
+        while pending and time.perf_counter() < deadline:
+            for job_id in list(pending):
+                try:
+                    status, payload = _http(
+                        "GET", f"{r1_url}/jobs/{job_id}", timeout=10)
+                except (urllib.error.URLError, OSError):
+                    break
+                if status >= 500:
+                    continue  # respawn window; keep polling
+                if status != 200:
+                    print(f"control-smoke: job {job_id} LOST "
+                          f"(HTTP {status}: {payload})")
+                    return 1
+                state = payload["state"]
+                if state == "done":
+                    pending.discard(job_id)
+                elif state in ("failed", "cancelled"):
+                    print(f"control-smoke: job {job_id} ended {state}: "
+                          f"{payload}")
+                    return 1
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            print(f"control-smoke: {len(pending)} job(s) never completed")
+            return 1
+        print(f"control-smoke: all {len(accepted)} jobs DONE through "
+              "both kills")
+
+        # Results byte-identical to the oracle, fetched via the survivor.
+        for job_id, board in accepted.items():
+            status, result = _http("GET", f"{r1_url}/result/{job_id}")
+            if status != 200:
+                print(f"control-smoke: result {job_id} HTTP {status}")
+                return 1
+            want = oracle.run(board, cfg)
+            got = text_grid.decode(
+                result["grid"].encode("ascii"),
+                result["width"], result["height"],
+            )
+            if (not np.array_equal(np.asarray(got), want.grid)
+                    or result["generations"] != want.generations):
+                print(f"control-smoke: result {job_id} diverges from the "
+                      "oracle")
+                return 1
+        print("control-smoke: every result oracle-identical")
+
+        # Orderly teardown: the survivor first (cascade=False — workers
+        # outlive any one router), then each worker.
+        for label, pid in cleanup_pids:
+            _term_and_wait(pid, label)
+        cleanup_pids = []
+
+        done = _count_done(fleet_dir)
+        lost = set(accepted) - set(done)
+        extra = set(done) - set(accepted)
+        dup = {k: [p for p, _ in v] for k, v in done.items() if len(v) != 1}
+        if lost or extra or dup:
+            print(f"control-smoke: lost={lost} unknown={extra} "
+                  f"duplicated={dup}")
+            return 1
+        print(
+            f"control-smoke: PASS — {len(accepted)} jobs exactly-once "
+            "through a leader SIGKILL (lease transferred, ticks continued, "
+            "worker respawned by the survivor), results oracle-identical"
+        )
+        rc = 0
+        return 0
+    finally:
+        for _, pid in cleanup_pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, OSError):
+                pass
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        if rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"control-smoke: artifacts kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
