@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke autoscale-smoke chaos-smoke storage-smoke control-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke macro-smoke autoscale-smoke chaos-smoke storage-smoke control-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -145,6 +145,15 @@ fleettrace-smoke:
 # an identical result with exactly one done record.
 sparse-smoke:
 	python3 tools/sparse_smoke.py
+
+# Macrocell deep-time smoke (tools/macro_smoke.py): the Gosper gun runs
+# 10^6 generations on the hash-consed macro engine and its population
+# must match the closed-form glider census anchored by a shallow sparse
+# run (pop(g) = pop(g0) + 5*(g-g0)/30, same period-30 phase); then a
+# fresh-process rerun on the same CAS directory must serve content-tier
+# hits and finish with strictly less device work.
+macro-smoke:
+	python3 tools/macro_smoke.py
 
 # Elastic-fleet smoke (tools/autoscale_smoke.py): a real 1-worker
 # `gol fleet --autoscale` under a step load must scale up, survive a

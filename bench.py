@@ -2415,6 +2415,152 @@ def _bench_sparse(args) -> int:
     return 0 if headline >= 10.0 else 1
 
 
+def _bench_macro(args) -> int:
+    """Hash-consed macrocell suite (--suite macro) -> BENCH_r19.json.
+
+    ISSUE 17's deep-time claim: every per-generation engine costs
+    Omega(gens), while the macrocell lane's memoized centered advance
+    costs ~O(log gens) supersteps once the tree's working set is interned.
+    The load is the Gosper gun — unbounded live growth (one glider every
+    30 generations), so this is the HARD case for hashlife, not a still
+    life it can collapse:
+
+    - **macro** lane: the gun to 10^6 generations in a 2^20-per-side
+      plane universe on a cold memo (fresh store, fresh CAS directory),
+      plus a warm-restart lane (fresh process-local state, same CAS)
+      showing the content tier eliminate the leaf device work;
+    - **sparse** lane: the same gun, measured per-generation at a depth
+      it can actually reach in bench time, then extrapolated LINEARLY to
+      10^6 generations. The extrapolation is a deliberate lower bound on
+      the true sparse cost: the glider stream grows the active-tile set
+      linearly with depth, so real sparse cost is quadratic in
+      generations — the reported ratio understates the win.
+
+    Headline: sparse_estimated_s / macro_s at 10^6 generations, gated by
+    exit code at >= 50x (the ISSUE 17 acceptance floor). CI gates the
+    leaf via ``tools/bench_diff.py --metric lanes.macro.speedup_vs_sparse``.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from gol_tpu.config import GameConfig
+    from gol_tpu.io import rle as rle_codec
+    from gol_tpu.macro import MacroMemo, NodeStore, simulate_macro
+    from gol_tpu.sparse import SparseBoard, TileMemo, simulate_sparse
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "patterns", "gosper_gun.rle"),
+              encoding="utf-8") as f:
+        gun_rle = f.read()
+    tile = 256
+    macro_universe = 1 << 20
+    macro_gens = 1_000_000
+    sparse_universe = 1 << 13
+    sparse_gens = 3000
+
+    def gun_board(universe: int) -> SparseBoard:
+        at = universe // 2
+        return SparseBoard.from_rle(gun_rle, universe, universe, tile,
+                                    x=at, y=at)
+
+    print(f"bench macro: gosper gun, tile {tile}, "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+
+    # Sparse baseline: measured per-generation at a reachable depth. Warm
+    # the tile-step programs outside the timer (one compile per ladder
+    # rung, paid once per process) — the macro leaf base cases ride the
+    # SAME compiled runners, so the warm-up serves both lanes.
+    simulate_sparse(gun_board(sparse_universe), GameConfig(gen_limit=1),
+                    TileMemo())
+    t0 = time.perf_counter()
+    sparse_result = simulate_sparse(gun_board(sparse_universe),
+                                    GameConfig(gen_limit=sparse_gens),
+                                    TileMemo())
+    sparse_s = time.perf_counter() - t0
+    assert sparse_result.generations == sparse_gens, sparse_result.generations
+    sparse_s_per_gen = sparse_s / sparse_gens
+    sparse_est_s = sparse_s_per_gen * macro_gens
+    print(f"  sparse: {sparse_gens} generations in {sparse_s:.1f}s "
+          f"({sparse_s_per_gen * 1000:.2f} ms/gen) -> linear lower bound "
+          f"{sparse_est_s:.0f}s at {macro_gens} generations",
+          file=sys.stderr)
+
+    cas_dir = tempfile.mkdtemp(prefix="bench_macro_cas_")
+    try:
+        # Cold macro lane: fresh node store, fresh memo, empty CAS.
+        memo = MacroMemo(NodeStore(tile), cas_dir=cas_dir)
+        t0 = time.perf_counter()
+        cold = simulate_macro(gun_board(macro_universe),
+                              GameConfig(gen_limit=macro_gens), memo)
+        macro_s = time.perf_counter() - t0
+        assert cold.generations == macro_gens, cold.generations
+        assert cold.exit_reason == "gen_limit", cold.exit_reason
+        print(f"  macro (cold): {macro_gens} generations in {macro_s:.1f}s "
+              f"({cold.stats.supersteps} supersteps, "
+              f"{cold.stats.leaf_gen_steps} leaf device steps, "
+              f"population {cold.board.population()})", file=sys.stderr)
+
+        # Warm-restart lane: everything process-local discarded, only the
+        # CAS directory survives (the serve-restart shape).
+        memo2 = MacroMemo(NodeStore(tile), cas_dir=cas_dir)
+        t0 = time.perf_counter()
+        warm = simulate_macro(gun_board(macro_universe),
+                              GameConfig(gen_limit=macro_gens), memo2)
+        warm_s = time.perf_counter() - t0
+        assert warm.board.population() == cold.board.population()
+        print(f"  macro (warm CAS): rerun in {warm_s:.1f}s "
+              f"({warm.stats.cas_hits} content hits, "
+              f"{warm.stats.leaf_gen_steps} leaf device steps)",
+              file=sys.stderr)
+    finally:
+        shutil.rmtree(cas_dir, ignore_errors=True)
+
+    headline = sparse_est_s / macro_s
+    print(f"  macro at 10^6 generations = {headline:.1f}x the sparse "
+          f"lane's linear lower bound (acceptance >= 50x)", file=sys.stderr)
+    payload = {
+        "metric": "macro_deep_time_speedup",
+        "value": headline,
+        "unit": "x sparse wall time (linear lower bound) at 10^6 gens",
+        "vs_baseline": headline / 50.0,  # over the acceptance floor
+        "lanes": {
+            "macro": {
+                "universe": f"{macro_universe}x{macro_universe}",
+                "generations": macro_gens,
+                "cold_s": macro_s,
+                "warm_cas_s": warm_s,
+                "supersteps": cold.stats.supersteps,
+                "leaf_gen_steps_cold": cold.stats.leaf_gen_steps,
+                "leaf_gen_steps_warm": warm.stats.leaf_gen_steps,
+                "cas_hits_warm": warm.stats.cas_hits,
+                "population": cold.board.population(),
+                "speedup_vs_sparse": headline,
+            },
+            "sparse": {
+                "universe": f"{sparse_universe}x{sparse_universe}",
+                "generations": sparse_gens,
+                "measured_s": sparse_s,
+                "s_per_gen": sparse_s_per_gen,
+                "estimated_s_at_macro_gens": sparse_est_s,
+                "extrapolation": "linear (lower bound; true cost is "
+                                 "quadratic in the glider stream)",
+            },
+        },
+        "load": {"pattern": "gosper_gun", "tile": tile},
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r19.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    print(json.dumps(payload))
+    return 0 if headline >= 50.0 else 1
+
+
 def _bench_chaos(args) -> int:
     """Chaos-hardened data path suite (--suite chaos) -> BENCH_r16.json.
 
@@ -3152,6 +3298,16 @@ SUITES = {
         "2^14^2, <= 1% occupancy; CI gates "
         "--metric sizes.u16384.ratio_dense_over_sparse); writes "
         "BENCH_r14.json",
+    ),
+    "macro": (
+        _bench_macro,
+        "hash-consed macrocell deep time: the Gosper gun to 10^6 "
+        "generations in a 2^20^2 plane universe on a cold memo + a "
+        "warm-CAS restart lane, vs the sparse lane's per-generation cost "
+        "extrapolated linearly (a deliberate lower bound — true sparse "
+        "cost is quadratic in the glider stream); acceptance: macro >= "
+        "50x the sparse lower bound, exit-code gated (CI gates --metric "
+        "lanes.macro.speedup_vs_sparse); writes BENCH_r19.json",
     ),
     "tune": (
         _bench_tune,

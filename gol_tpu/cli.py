@@ -185,6 +185,18 @@ def _run(args) -> int:
     from gol_tpu.platform_env import enable_compile_cache
     from gol_tpu.resilience import faults
 
+    if args.gens is not None:
+        # --gens is the deep-time spelling of --gen-limit (the macro lane's
+        # natural vocabulary); one value drives every lane either way.
+        if args.gens < 0:
+            raise ValueError(f"--gens must be >= 0, got {args.gens}")
+        args.gen_limit = args.gens
+    if args.macro_cas and args.engine not in ("macro", "auto"):
+        # A silently-ignored persistence flag would misreport what ran.
+        raise ValueError(
+            "--macro-cas applies to the macro engine lane; add "
+            "--engine macro (or auto)"
+        )
     enable_compile_cache(args.compile_cache)
 
     if args.fault_plan:
@@ -262,6 +274,12 @@ def _run(args) -> int:
         # guard admits — giant universes come in as --pattern instead.
         _validate_sparse_flags(args)
         return _run_sparse_file(args, variant, config, width, height)
+
+    if args.engine == "macro":
+        # Same A/B lane, macrocell engine: byte-gates the tree against the
+        # dense/sparse answers from the CLI.
+        _validate_macro_flags(args)
+        return _run_macro_file(args, variant, config, width, height)
 
     if args.host:
         # lax is what the host oracle effectively is, so it stays accepted;
@@ -761,6 +779,15 @@ def _validate_sparse_flags(args) -> None:
         )
 
 
+def _validate_macro_flags(args) -> None:
+    _validate_lane_flags(args, "the macro engine lane")
+    if args.kernel != "auto":
+        raise ValueError(
+            "--kernel does not apply to the macro engine lane (leaf steps "
+            "ride the sparse tile kernel family)"
+        )
+
+
 def _parse_universe(spec: str) -> tuple[int, int]:
     m = re.fullmatch(r"(\d+)x(\d+)", spec)
     if not m:
@@ -785,6 +812,30 @@ def _run_sparse(variant, config, board, read_ms, output_path) -> int:
         print(f"Reading file:\t{read_ms:.2f} msecs")
     t0 = time.perf_counter()
     result = simulate_sparse(board, config, TileMemo())
+    exec_ms = (time.perf_counter() - t0) * 1000
+    comments = (
+        f"generations {result.generations} exit {result.exit_reason}",
+    )
+    return _report_and_write(
+        variant,
+        result.generations,
+        exec_ms,
+        lambda: _write_text(output_path, result.board.to_rle(comments)),
+    )
+
+
+def _run_macro(args, variant, config, board, read_ms, output_path) -> int:
+    """Drive a macrocell simulation (gol_tpu/macro) and write the result
+    as RLE — same output contract as the sparse lane, because the result
+    is byte-identical by construction; only the generation count scales
+    differently (O(log gens) guarded jumps)."""
+    from gol_tpu.macro import MacroMemo, NodeStore, simulate_macro
+
+    if variant.io_timings:
+        print(f"Reading file:\t{read_ms:.2f} msecs")
+    memo = MacroMemo(NodeStore(board.tile), cas_dir=args.macro_cas)
+    t0 = time.perf_counter()
+    result = simulate_macro(board, config, memo)
     exec_ms = (time.perf_counter() - t0) * 1000
     comments = (
         f"generations {result.generations} exit {result.exit_reason}",
@@ -840,7 +891,18 @@ def _run_pattern(args, variant) -> int:
         from gol_tpu.sparse.engine import auto_engine
 
         engine_pick = auto_engine(height, width, tile)
-    if engine_pick == "sparse":
+        if engine_pick == "sparse":
+            # A sparse-routed auto run upgrades to the macrocell lane when
+            # the generation count clears the crossover AND the placement
+            # provably keeps the whole run off the torus seam (auto must
+            # never pick an engine that can raise mid-run). Byte-identical
+            # either way — this only changes how fast the answer arrives.
+            from gol_tpu.macro import auto_macro
+
+            if auto_macro(height, width, tile, config.gen_limit,
+                          (y, x, y + ph - 1, x + pw - 1)):
+                engine_pick = "macro"
+    if engine_pick in ("sparse", "macro"):
         if args.kernel != "auto":
             raise ValueError(
                 "--kernel does not apply to the sparse engine (the tile "
@@ -849,6 +911,9 @@ def _run_pattern(args, variant) -> int:
             )
         board = SparseBoard.from_pattern(pattern, x, y, height, width, tile)
         output_path = args.output or "./sparse_output.rle"
+        if engine_pick == "macro":
+            return _run_macro(args, variant, config, board, read_ms,
+                              output_path)
         return _run_sparse(variant, config, board, read_ms, output_path)
     # Dense engine on a pattern input: materialize (guarded), place, run
     # the classic device lane.
@@ -896,6 +961,24 @@ def _run_sparse_file(args, variant, config, width, height) -> int:
     board = SparseBoard.from_dense(grid, args.tile or DEFAULT_TILE)
     output_path = args.output or "./sparse_output.rle"
     return _run_sparse(variant, config, board, read_ms, output_path)
+
+
+def _run_macro_file(args, variant, config, width, height) -> int:
+    """``--engine macro`` over a dense input file: the same A/B lane as
+    ``_run_sparse_file``, driven through the macrocell tree."""
+    from gol_tpu.sparse.board import (
+        DEFAULT_TILE,
+        SparseBoard,
+        dense_cells_guard,
+    )
+
+    dense_cells_guard(height, width, what="input file")
+    t0 = time.perf_counter()
+    grid = text_grid.read_grid(args.input_file, width, height)
+    read_ms = (time.perf_counter() - t0) * 1000
+    board = SparseBoard.from_dense(grid, args.tile or DEFAULT_TILE)
+    output_path = args.output or "./sparse_output.rle"
+    return _run_macro(args, variant, config, board, read_ms, output_path)
 
 
 def _run_host(args, variant, config, width, height, output_path) -> int:
@@ -2833,6 +2916,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument("--gen-limit", type=int, default=GameConfig().gen_limit)
     run.add_argument(
+        "--gens", type=int, default=None, metavar="N",
+        help="alias for --gen-limit (the deep-time spelling: the macro "
+        "engine reaches e.g. --gens 1000000000 in O(log N) jumps)",
+    )
+    run.add_argument(
         "--similarity-frequency", type=int, default=GameConfig().similarity_frequency
     )
     run.add_argument(
@@ -2853,15 +2941,26 @@ def build_parser() -> argparse.ArgumentParser:
         "to the pattern's own RLE extents",
     )
     run.add_argument(
-        "--engine", default="auto", choices=("auto", "dense", "sparse"),
+        "--engine", default="auto", choices=("auto", "dense", "sparse",
+                                             "macro"),
         help="engine family: dense (the classic O(area) lanes), sparse "
-        "(tiled O(live-area) — gol_tpu/sparse), or auto (sparse above "
-        "the area threshold when the extents tile evenly)",
+        "(tiled O(live-area) — gol_tpu/sparse), macro (hash-consed "
+        "macrocell, O(log gens) deep time — gol_tpu/macro), or auto "
+        "(sparse above the area threshold when the extents tile evenly, "
+        "upgraded to macro above the generation threshold when the "
+        "placement keeps the run off the torus seam)",
     )
     run.add_argument(
         "--tile", type=int, default=0, metavar="N",
-        help="sparse engine tile edge (default 256); universe extents "
-        "must be multiples of it",
+        help="sparse/macro engine tile edge (default 256); universe "
+        "extents must be multiples of it (and it must be even for macro "
+        "— the macrocell leaf splits in half)",
+    )
+    run.add_argument(
+        "--macro-cas", default=None, metavar="DIR",
+        help="mount a disk CAS tier under the macro engine's advance memo "
+        "(gol_tpu/cache): memoized superstep results persist across "
+        "runs and restarts, and `gol gc` budgets the directory",
     )
     run.add_argument("--no-check-similarity", action="store_true")
     run.add_argument("--output", default=None, help="override the output file path")

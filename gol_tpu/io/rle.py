@@ -35,17 +35,25 @@ MAX_PATTERN_CELLS = 1 << 26
 
 _HEADER_RE = re.compile(
     r"^\s*x\s*=\s*(\d+)\s*,\s*y\s*=\s*(\d+)"
-    r"(?:\s*,\s*rule\s*=\s*([^\s,]+))?\s*$",
+    r"(?:\s*,\s*rule\s*=\s*(.+?))?\s*$",
     re.IGNORECASE,
 )
 _ITEM_RE = re.compile(r"(\d*)([A-Za-z.$!])")
 
-# Accepted spellings of the one rule this tree implements.
+# Accepted spellings of the one rule this tree implements, compared after
+# lowercasing and stripping ALL whitespace: exporters disagree on case
+# (``b3/s23``), spacing (``B3 / S23``), and B/S order (``S23/B3``), and
+# the legacy survival/birth form spells it ``23/3``. An unsupported rule
+# is still a loud error naming the rule — silently running a HighLife
+# pattern under Conway semantics would corrupt results, not degrade them.
 _B3S23 = frozenset({"b3/s23", "s23/b3", "23/3"})
 
 
 def _check_rule(rule: str | None) -> None:
-    if rule is not None and rule.lower() not in _B3S23:
+    if rule is None:
+        return
+    canonical = re.sub(r"\s+", "", rule).lower()
+    if canonical not in _B3S23:
         raise ValueError(
             f"RLE rule {rule!r} is not B3/S23; only Conway's Life is "
             "implemented (rule-space generalization is a roadmap item)"

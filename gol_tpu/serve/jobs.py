@@ -144,6 +144,12 @@ class Job:
     place_x: int = 0
     place_y: int = 0
     tile: int = 0
+    # Run this sparse job on the macrocell engine (gol_tpu/macro/) instead
+    # of the per-generation sparse loop. Journaled (replay must pick the
+    # same engine for work-accounting stability) but NOT a result axis:
+    # the macro engine is byte-identical to sparse by contract, so the
+    # flag is an execution hint, like picking a kernel.
+    macro: bool = False
     state: str = QUEUED
     # The result-cache key (gol_tpu/cache/fingerprint.py), computed by the
     # scheduler at admission when a cache is mounted; None otherwise (and
@@ -209,6 +215,15 @@ class Job:
                 f"no_cache must be a JSON boolean, got "
                 f"{type(self.no_cache).__name__}"
             )
+        # Same strictness again for the engine hint, and it only means
+        # anything on the sparse input form.
+        if not isinstance(self.macro, bool):
+            raise TypeError(
+                f"macro must be a JSON boolean, got "
+                f"{type(self.macro).__name__}"
+            )
+        if self.macro and self.rle is None:
+            raise ValueError("macro jobs take the sparse input form (rle)")
         self.priority = int(self.priority)
         if self.deadline_s is not None:
             self.deadline_s = float(self.deadline_s)
@@ -327,6 +342,9 @@ class Job:
                 "x": self.place_x,
                 "y": self.place_y,
                 "tile": self.tile,
+                # Only when set, like no_cache below: default-engine
+                # records stay byte-stable and old journals replay sparse.
+                **({"macro": True} if self.macro else {}),
             }
         else:
             payload = {"cells": text_grid.encode(self.board).decode("ascii")}
@@ -359,6 +377,7 @@ class Job:
                 "place_x": rec.get("x", 0),
                 "place_y": rec.get("y", 0),
                 "tile": rec.get("tile", 0),
+                "macro": rec.get("macro", False),
             }
         return cls(
             id=rec["id"],

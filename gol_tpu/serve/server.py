@@ -382,7 +382,7 @@ class GolServer:
         kwargs = {}
         for field in (
             "convention", "gen_limit", "check_similarity",
-            "similarity_frequency", "priority", "no_cache",
+            "similarity_frequency", "priority", "no_cache", "macro",
         ):
             if field in body:
                 kwargs[field] = body[field]
@@ -397,6 +397,8 @@ class GolServer:
             **kwargs,
         )
         self.metrics.inc("sparse_submits_total")
+        if job.macro:
+            self.metrics.inc("macro_submits_total")
         return self._admit(job, trace_header, deadline_header)
 
     def submit_packed(self, raw: bytes,

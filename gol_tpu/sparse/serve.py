@@ -64,10 +64,17 @@ def run_batch(key, jobs) -> list:
     """Run a sparse bucket's claimed jobs, in order (the sparse analog of
     ``batcher.run_batch``; per-job tile batching happens inside the sparse
     engine). Pure function of the specs — safe to re-run on retry."""
+    from gol_tpu.macro import serve as macro_serve
     from gol_tpu.serve.jobs import JobResult
 
     out = []
     for job in jobs:
+        if getattr(job, "macro", False):
+            # Macro jobs share the sparse bucket (same input form, same
+            # scheduler lanes); only the engine differs — and its results
+            # are byte-identical by contract, just reached in O(log) jumps.
+            out.append(macro_serve.run_job(job))
+            continue
         with obs_trace.span("sparse.job", job=job.id,
                             universe=f"{job.height}x{job.width}",
                             tile=job.tile):

@@ -177,6 +177,46 @@ def sparse_auto_area(default: int) -> int:
     return area
 
 
+def macro_fingerprint() -> str:
+    """The macro-engine crossover is one number per host, like the sparse
+    one — grid/convention/family wildcarded."""
+    return plans.fingerprint("macro", 0, 0, "any", "any", (1, 1),
+                             plans.device_kind())
+
+
+# The admissible sparse/macro crossover band: below 2^6 generations the
+# tree build alone dwarfs any per-generation loop; above 2^40 the macro
+# lane would effectively never engage, which defeats recording a plan at
+# all. Outside the band = corrupt/hand-edited entry, degrade loudly.
+MACRO_GENS_FLOOR = 1 << 6
+MACRO_GENS_CEIL = 1 << 40
+
+
+def macro_auto_gens(default: int) -> int:
+    """The measured sparse/macro generation-count crossover for
+    ``--engine auto``: the plan-cached value this host measured, else the
+    bundled default, else ``default`` (the macro engine's shipped
+    constant). Invalid entries are rejected loudly — a corrupt cache must
+    not route shallow runs onto the tree engine."""
+    entry = _store().get(macro_fingerprint())
+    if entry is None:
+        entry = _store().get_default("macro")
+    if not entry:
+        return default
+    try:
+        gens = int(entry["auto_gens"])
+        if not MACRO_GENS_FLOOR <= gens <= MACRO_GENS_CEIL:
+            raise ValueError(f"auto_gens {gens} outside "
+                             f"[{MACRO_GENS_FLOOR}, {MACRO_GENS_CEIL}]")
+    except (KeyError, TypeError, ValueError) as err:
+        logger.warning("unusable macro crossover plan (%s: %s); using the "
+                       "built-in threshold", type(err).__name__, err)
+        return default
+    if gens != default:
+        logger.info("tuned macro auto threshold: %d generations", gens)
+    return gens
+
+
 def warm_entries() -> list[dict]:
     """Shapes recorded by the offline tuner for server warmup: each entry is
     ``{"height", "width", "convention", ...}`` — `gol serve --warm-plans`

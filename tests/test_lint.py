@@ -260,3 +260,16 @@ def test_no_wall_clock_in_sparse():
             f"wall-clock {needle} in gol_tpu/sparse/ (use "
             f"time.perf_counter() for any timing path): {offenders}"
         )
+
+
+def test_no_wall_clock_in_macro():
+    """Same rule for gol_tpu/macro/: macro jobs ride the same scheduler
+    lanes as sparse ones and the advance memo feeds the same CAS — and a
+    content-addressed engine has no legitimate wall-clock need at all
+    (node identity is content, memo keys carry no time-of-day)."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "macro", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/macro/ (use "
+            f"time.perf_counter() for any timing path): {offenders}"
+        )

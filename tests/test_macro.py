@@ -1,0 +1,403 @@
+"""Macrocell engine (gol_tpu/macro) tests.
+
+The acceptance surface of ISSUE 17:
+
+- hash-consing: two stamps of the same subtree are ONE object, and node
+  identity is decomposition-independent;
+- advance byte-identity vs the sparse engine at checkpointed generations
+  for glider/gosper-gun/r-pentomino/acorn, BOTH conventions, including
+  non-power-of-two generation counts;
+- early-exit parity (empty and similar, the convention-specific
+  accounting included) against the per-generation sparse loop;
+- memo restart-hits through the DiskCAS tier, journal replay of macro
+  jobs (the SIGKILL shape), and eviction under the `gol gc` budget.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.cache import gc as cas_gc
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.macro import (
+    MacroMemo,
+    MacroPlaneError,
+    NodeStore,
+    MacroUniverse,
+    auto_macro,
+    simulate_macro,
+)
+from gol_tpu.serve.jobs import DONE, JobJournal, new_job
+from gol_tpu.serve.scheduler import Scheduler
+from gol_tpu.sparse import SparseBoard, simulate_sparse
+
+PATTERNS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "patterns")
+
+CONVENTIONS = (Convention.C, Convention.CUDA)
+
+GLIDER_RLE = "x = 3, y = 3, rule = B3/S23\nbob$2bo$3o!"
+# An L-tromino: becomes a block at generation 1 and stays — the minimal
+# nonempty SIMILAR-exit fixture.
+PRE_BLOCK_RLE = "x = 2, y = 2, rule = B3/S23\n2o$ob!"
+
+
+def _pattern(name: str) -> str:
+    with open(os.path.join(PATTERNS_DIR, name + ".rle"),
+              encoding="utf-8") as f:
+        return f.read()
+
+
+def _board(rle: str, size: int, tile: int, at: int) -> SparseBoard:
+    return SparseBoard.from_rle(rle, size, size, tile, x=at, y=at)
+
+
+def _assert_parity(rle, size, tile, at, config, checkpoints=()):
+    """The byte-gate: macro vs the sparse per-generation loop — final
+    cells, generation count, exit reason, and the exact state at every
+    checkpointed generation."""
+    seen = {}
+    macro = simulate_macro(
+        _board(rle, size, tile, at), config, checkpoints=checkpoints,
+        on_checkpoint=lambda g, b: seen.__setitem__(g, b),
+    )
+    sparse = simulate_sparse(_board(rle, size, tile, at), config)
+    assert macro.generations == sparse.generations
+    assert macro.exit_reason == sparse.exit_reason
+    assert macro.board == sparse.board
+    for g in checkpoints:
+        if g > config.gen_limit:
+            assert g not in seen
+            continue
+        ref = simulate_sparse(_board(rle, size, tile, at),
+                              GameConfig(gen_limit=g,
+                                         convention=config.convention))
+        assert seen[g] == ref.board, f"checkpoint {g} diverged"
+    return macro
+
+
+# ---------------------------------------------------------------------------
+# Hash-consing
+# ---------------------------------------------------------------------------
+
+
+class TestNodeStore:
+    def test_two_stamps_one_object(self):
+        """The interning law: identical subtrees — built through different
+        call sequences — are the same Python object at every level."""
+        store = NodeStore(4)
+        rng = np.random.default_rng(7)
+        cells = (rng.random((4, 4)) < 0.5).astype(np.uint8)
+        a = store.leaf(cells)
+        b = store.leaf(cells.copy())
+        assert a is b
+        e = store.empty(0)
+        n1 = store.node(a, e, e, a)
+        n2 = store.node(b, store.leaf(np.zeros((4, 4), np.uint8)), e, b)
+        assert n1 is n2
+        assert store.node(n1, n1, n2, n2) is store.node(n2, n2, n1, n1)
+
+    def test_interning_is_decomposition_independent(self):
+        """A universe built from a board and one rebuilt from the dense
+        flattening share every node — content decides identity, not the
+        construction path."""
+        store = NodeStore(4)
+        board = SparseBoard.from_rle(_pattern("glider"), 32, 32, 4,
+                                     x=13, y=9)
+        u = MacroUniverse.from_board(store, board)
+        rebuilt = store.from_dense(u.root.to_dense(4))
+        assert rebuilt is u.root
+
+    def test_empty_is_canonical_per_level(self):
+        store = NodeStore(4)
+        z = store.leaf(np.zeros((4, 4), np.uint8))
+        assert z is store.empty(0)
+        assert store.node(z, z, z, z) is store.empty(1)
+
+    def test_board_round_trip(self):
+        board = SparseBoard.from_rle(_pattern("gosper_gun"), 64, 64, 8,
+                                     x=11, y=23)
+        store = NodeStore(8)
+        u = MacroUniverse.from_board(store, board)
+        assert u.population() == board.population()
+        assert u.to_board() == board
+
+    def test_leaf_constraints(self):
+        with pytest.raises(ValueError):
+            NodeStore(2)  # below the board's MIN_TILE
+        with pytest.raises(ValueError):
+            NodeStore(5)  # odd leaves cannot split
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity vs the sparse engine
+# ---------------------------------------------------------------------------
+
+
+class TestMacroParity:
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_glider_checkpoints(self, convention):
+        _assert_parity(
+            GLIDER_RLE, 128, 8, 60,
+            GameConfig(gen_limit=137, convention=convention),
+            checkpoints=(1, 30, 64, 100, 137),
+        )
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_gosper_gun_checkpoints(self, convention):
+        """Non-power-of-two limit, non-power-of-two checkpoints, a
+        growing population — the canonical deep-time fixture."""
+        _assert_parity(
+            _pattern("gosper_gun"), 512, 8, 200,
+            GameConfig(gen_limit=210, convention=convention),
+            checkpoints=(1, 31, 137, 209, 210, 1000),
+        )
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_r_pentomino_checkpoints(self, convention):
+        _assert_parity(
+            _pattern("r_pentomino"), 512, 16, 220,
+            GameConfig(gen_limit=300, convention=convention),
+            checkpoints=(100, 255, 300),
+        )
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_acorn_checkpoints(self, convention):
+        _assert_parity(
+            _pattern("acorn"), 512, 16, 200,
+            GameConfig(gen_limit=250, convention=convention),
+            checkpoints=(3, 97, 250),
+        )
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    @pytest.mark.parametrize("gens", (0, 1, 5, 100))
+    def test_tiny_generation_counts(self, convention, gens):
+        _assert_parity(GLIDER_RLE, 64, 8, 30,
+                       GameConfig(gen_limit=gens, convention=convention))
+
+
+# ---------------------------------------------------------------------------
+# Early-exit parity
+# ---------------------------------------------------------------------------
+
+
+class TestMacroExits:
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    @pytest.mark.parametrize("gens", (129, 130, 131, 400))
+    def test_diehard_empty_exit(self, convention, gens):
+        """Diehard dies at generation 130: the empty exit fires with the
+        convention's own accounting (C reports the empty board at 130;
+        CUDA reports the last NONEMPTY board at 129)."""
+        macro = _assert_parity(
+            _pattern("diehard"), 512, 8, 200,
+            GameConfig(gen_limit=gens, convention=convention),
+        )
+        if gens >= 130:
+            assert macro.exit_reason == "empty"
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    @pytest.mark.parametrize("frequency", (1, 2, 5, 7))
+    def test_still_life_similar_exit(self, convention, frequency):
+        for gens in (0, 1, 4, 5, 6, 60):
+            _assert_parity(
+                PRE_BLOCK_RLE, 64, 8, 30,
+                GameConfig(gen_limit=gens, convention=convention,
+                           similarity_frequency=frequency),
+            )
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    def test_similarity_disabled(self, convention):
+        macro = _assert_parity(
+            PRE_BLOCK_RLE, 64, 8, 30,
+            GameConfig(gen_limit=50, convention=convention,
+                       check_similarity=False),
+        )
+        assert macro.exit_reason == "gen_limit"
+
+    @pytest.mark.parametrize("convention", CONVENTIONS)
+    @pytest.mark.parametrize("frequency", (1, 3))
+    def test_initially_empty_universe(self, convention, frequency):
+        for gens in (0, 1, 10):
+            config = GameConfig(gen_limit=gens, convention=convention,
+                                similarity_frequency=frequency)
+            macro = simulate_macro(SparseBoard(64, 64, 8), config)
+            sparse = simulate_sparse(SparseBoard(64, 64, 8), config)
+            assert macro.generations == sparse.generations
+            assert macro.exit_reason == sparse.exit_reason
+            assert macro.board == sparse.board
+
+    def test_plane_error_at_the_seam(self):
+        """A pattern whose light cone reaches the universe edge raises the
+        plane/torus divergence error instead of silently drifting from
+        the (toroidal) sparse answer."""
+        board = SparseBoard.from_rle(GLIDER_RLE, 32, 32, 4, x=1, y=1)
+        with pytest.raises(MacroPlaneError, match="--engine sparse"):
+            simulate_macro(board, GameConfig(gen_limit=200))
+
+
+# ---------------------------------------------------------------------------
+# Memo: DiskCAS restarts + `gol gc` eviction
+# ---------------------------------------------------------------------------
+
+
+class TestMacroMemo:
+    def test_restart_hits_warm_cas(self, tmp_path):
+        """A fresh process (new store, new memo — only the CAS directory
+        survives) re-runs the same deep question on cache hits: the
+        content tier IS the cross-restart knowledge base."""
+        cas = str(tmp_path / "cas")
+        config = GameConfig(gen_limit=210)
+        board_spec = (_pattern("gosper_gun"), 512, 8, 200)
+
+        memo1 = MacroMemo(NodeStore(8), cas_dir=cas)
+        cold = simulate_macro(_board(*board_spec), config, memo1)
+        assert cold.stats.cas_hits == 0
+        assert os.listdir(cas)
+
+        memo2 = MacroMemo(NodeStore(8), cas_dir=cas)  # "restart"
+        warm = simulate_macro(_board(*board_spec), config, memo2)
+        assert warm.board == cold.board
+        assert warm.stats.cas_hits > 0
+        assert warm.stats.leaf_gen_steps < cold.stats.leaf_gen_steps
+
+    def test_gc_budget_evicts_macro_entries(self, tmp_path):
+        """`gol gc` over a macro CAS directory: entries are evicted to
+        budget with the standard report, and a post-GC run still answers
+        correctly (recomputing what was evicted)."""
+        cas = str(tmp_path / "cas")
+        config = GameConfig(gen_limit=137)
+        memo = MacroMemo(NodeStore(8), cas_dir=cas)
+        ref = simulate_macro(_board(GLIDER_RLE, 128, 8, 60), config, memo)
+
+        def entries():
+            found = []
+            for root, _dirs, names in os.walk(cas):
+                found += [n for n in names if not n.startswith(".")]
+            return found
+
+        files = entries()
+        assert len(files) > 1
+        report = cas_gc.collect(cas, budget=1, apply=True)
+        assert report.evicted
+        assert len(entries()) < len(files)
+        memo2 = MacroMemo(NodeStore(8), cas_dir=cas)
+        again = simulate_macro(_board(GLIDER_RLE, 128, 8, 60), config,
+                               memo2)
+        assert again.board == ref.board
+
+    def test_memo_keys_scoped_by_time_and_leaf(self):
+        """The content key carries the jump size and the leaf edge: the
+        same node advanced by different t must never collide."""
+        memo = MacroMemo(NodeStore(8))
+        board = SparseBoard.from_rle(GLIDER_RLE, 32, 32, 8, x=14, y=14)
+        u = MacroUniverse.from_board(memo.store, board)
+        k1 = memo.key(u.root, 1)
+        k2 = memo.key(u.root, 2)
+        assert k1 != k2
+        assert k1.endswith("-8") and "-1-" in k1
+
+
+# ---------------------------------------------------------------------------
+# Serve lane: macro jobs, journal replay (the SIGKILL shape)
+# ---------------------------------------------------------------------------
+
+
+def _macro_job(**over):
+    spec = dict(rle=GLIDER_RLE, place_x=30, place_y=30, tile=8,
+                gen_limit=100, macro=True)
+    spec.update(over)
+    return new_job(128, 128, None, **spec)
+
+
+def _await(jobs, timeout=60):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if all(j.state == DONE for j in jobs):
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"jobs stuck: {[(j.id, j.state, j.error) for j in jobs]}"
+    )
+
+
+class TestMacroServe:
+    def test_macro_job_byte_identical_to_sparse_job(self):
+        sched = Scheduler(flush_age=0.01)
+        sched.start()
+        try:
+            macro = sched.submit(_macro_job())
+            sparse = sched.submit(_macro_job(macro=False))
+            _await([macro, sparse])
+        finally:
+            sched.stop()
+        assert macro.result.rle == sparse.result.rle
+        assert macro.result.generations == sparse.result.generations
+        assert macro.result.exit_reason == sparse.result.exit_reason
+
+    def test_macro_flag_validation(self):
+        with pytest.raises(TypeError, match="JSON boolean"):
+            _macro_job(macro="true")
+        with pytest.raises(ValueError, match="sparse input form"):
+            new_job(8, 8, np.zeros((8, 8), np.uint8), macro=True)
+
+    def test_journal_replay_reruns_macro(self, tmp_path):
+        """The SIGKILL-shaped auto-resume: a journaled-but-unfinished
+        macro job replays from its spec — engine flag included — and
+        re-runs to a byte-identical result on the next boot."""
+        journal = JobJournal(str(tmp_path))
+        sched = Scheduler(journal=journal, flush_age=0.01)  # never started
+        job = sched.submit(_macro_job())
+        journal.close()
+        with open(journal.path, encoding="utf-8") as f:
+            rec = json.loads(f.readline())
+        assert rec["job"]["macro"] is True
+        assert "cells" not in rec["job"]
+
+        journal2 = JobJournal(str(tmp_path))
+        replay = journal2.replay()
+        assert [j.id for j in replay.pending] == [job.id]
+        assert replay.pending[0].macro is True
+        sched2 = Scheduler(journal=journal2, flush_age=0.01)
+        sched2.resubmit_replayed(replay.pending)
+        sched2.start()
+        try:
+            replayed = sched2.job(job.id)
+            _await([replayed])
+        finally:
+            sched2.stop()
+        journal2.close()
+        direct = simulate_sparse(
+            SparseBoard.from_rle(GLIDER_RLE, 128, 128, 8, x=30, y=30),
+            GameConfig(gen_limit=100),
+        )
+        assert replayed.result.rle == direct.board.to_rle()
+        assert replayed.result.generations == direct.generations
+
+
+# ---------------------------------------------------------------------------
+# Auto crossover
+# ---------------------------------------------------------------------------
+
+
+class TestAutoMacro:
+    def test_deep_centered_run_upgrades(self):
+        assert auto_macro(1 << 16, 1 << 16, 256, 20_000,
+                          (30_000, 30_000, 30_100, 30_100))
+
+    def test_shallow_run_stays_sparse(self):
+        assert not auto_macro(1 << 16, 1 << 16, 256, 100,
+                              (30_000, 30_000, 30_100, 30_100))
+
+    def test_seam_risk_stays_sparse(self):
+        # Margin (~2k cells) below the generation count: the run COULD
+        # reach the torus seam, so auto must not pick a raising lane.
+        assert not auto_macro(1 << 16, 1 << 16, 256, 20_000,
+                              (2_000, 30_000, 2_100, 30_100))
+
+    def test_odd_tile_and_unknown_bbox_stay_sparse(self):
+        assert not auto_macro(1 << 16, 1 << 16, 255, 20_000,
+                              (30_000, 30_000, 30_100, 30_100))
+        assert not auto_macro(1 << 16, 1 << 16, 256, 20_000, None)

@@ -1,0 +1,132 @@
+"""Macrocell deep-time smoke: 10^6 generations + census + warm-CAS restart.
+
+The `make macro-smoke` harness, exercising ISSUE 17's end-to-end
+acceptance behaviors:
+
+1. **Gosper gun to 10^6 generations** — the macro engine runs the gun a
+   MILLION generations in a 2^20-cell-per-side universe (a board no
+   per-generation engine could touch in smoke time) and the resulting
+   population must match the closed-form glider census: the gun emits
+   one 5-cell glider every 30 generations, and on a plane nothing ever
+   collides, so for any two generations with the same period-30 phase,
+   ``pop(g) = pop(g0) + 5 * (g - g0) / 30``. The anchor ``pop(g0)`` is
+   measured by the per-generation sparse engine at a shallow g0 with
+   ``g0 ≡ 10^6 (mod 30)`` — so the tree's answer at depth 10^6 is gated
+   by an independent engine plus arithmetic, not by another tree run.
+
+2. **Restart hits the warm CAS** — a second run of the same question
+   from a FRESH node store and memo (everything process-local discarded;
+   only the CAS directory survives, the restart shape) must serve
+   content-tier hits and finish with strictly less device work.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/macro_smoke.py
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+UNIVERSE = 1 << 20
+TILE = 256
+GENS = 1_000_000
+# Same period-30 phase as GENS (10^6 ≡ 10 ≡ 40 mod 30), deep enough that
+# the gun has started emitting.
+ANCHOR_GENS = 40
+
+
+def fail(msg: str) -> None:
+    print(f"MACRO-SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _gun_rle() -> str:
+    with open(os.path.join(REPO, "patterns", "gosper_gun.rle"),
+              encoding="utf-8") as f:
+        return f.read()
+
+
+def _board(universe: int, tile: int):
+    from gol_tpu.sparse import SparseBoard
+
+    at = universe // 2
+    return SparseBoard.from_rle(_gun_rle(), universe, universe, tile,
+                                x=at, y=at)
+
+
+def main() -> int:
+    from gol_tpu.config import GameConfig
+    from gol_tpu.macro import MacroMemo, NodeStore, simulate_macro
+    from gol_tpu.sparse import simulate_sparse
+
+    assert GENS % 30 == ANCHOR_GENS % 30, "census anchor must share phase"
+
+    # The census anchor, from the independent per-generation engine.
+    anchor = simulate_sparse(_board(8192, TILE),
+                             GameConfig(gen_limit=ANCHOR_GENS))
+    expected = (anchor.board.population()
+                + 5 * (GENS - ANCHOR_GENS) // 30)
+
+    cas_dir = tempfile.mkdtemp(prefix="macro_smoke_cas_")
+    try:
+        memo = MacroMemo(NodeStore(TILE), cas_dir=cas_dir)
+        t0 = time.perf_counter()
+        cold = simulate_macro(_board(UNIVERSE, TILE),
+                              GameConfig(gen_limit=GENS), memo)
+        cold_s = time.perf_counter() - t0
+        if cold.generations != GENS or cold.exit_reason != "gen_limit":
+            fail(f"cold run ended ({cold.generations}, {cold.exit_reason}),"
+                 f" want ({GENS}, gen_limit)")
+        pop = cold.board.population()
+        if pop != expected:
+            fail(f"census mismatch at {GENS} generations: population {pop},"
+                 f" closed form {expected} (anchor "
+                 f"{anchor.board.population()} at {ANCHOR_GENS})")
+        print(
+            f"  census gate: {GENS} generations in {cold_s:.1f}s, "
+            f"population {pop} == {anchor.board.population()} + "
+            f"5*({GENS}-{ANCHOR_GENS})/30 "
+            f"({cold.stats.supersteps} supersteps, "
+            f"{cold.stats.leaf_gen_steps} leaf device steps)",
+            file=sys.stderr,
+        )
+
+        # Restart: fresh store + memo, same CAS directory.
+        memo2 = MacroMemo(NodeStore(TILE), cas_dir=cas_dir)
+        t0 = time.perf_counter()
+        warm = simulate_macro(_board(UNIVERSE, TILE),
+                              GameConfig(gen_limit=GENS), memo2)
+        warm_s = time.perf_counter() - t0
+        if warm.board.population() != pop:
+            fail(f"warm rerun diverged: population "
+                 f"{warm.board.population()} vs {pop}")
+        if warm.stats.cas_hits == 0:
+            fail("restart run served 0 CAS hits — the content tier did "
+                 "not survive the restart")
+        if warm.stats.leaf_gen_steps >= cold.stats.leaf_gen_steps:
+            fail(f"restart run did {warm.stats.leaf_gen_steps} leaf device"
+                 f" steps, not less than the cold run's "
+                 f"{cold.stats.leaf_gen_steps}")
+        print(
+            f"  restart gate: warm CAS rerun in {warm_s:.1f}s "
+            f"({warm.stats.cas_hits} content hits, "
+            f"{warm.stats.leaf_gen_steps} vs {cold.stats.leaf_gen_steps} "
+            f"leaf device steps)",
+            file=sys.stderr,
+        )
+    finally:
+        shutil.rmtree(cas_dir, ignore_errors=True)
+
+    print("MACRO-SMOKE PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
