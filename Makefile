@@ -6,7 +6,7 @@
 
 VARIANTS := game mpi collective async openmp cuda tpu
 
-.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke macro-smoke autoscale-smoke chaos-smoke storage-smoke control-smoke soak soak-tpu clean $(VARIANTS)
+.PHONY: all test bench bench-diff serve-smoke tune-smoke obs-smoke pipeline-smoke megabatch-smoke slo-smoke fleet-smoke cache-smoke fleettrace-smoke sparse-smoke macro-smoke autoscale-smoke chaos-smoke storage-smoke control-smoke shard-smoke soak soak-tpu clean $(VARIANTS)
 
 all: tpu
 
@@ -67,6 +67,15 @@ bench:
 # gate stamp):
 #   make bench-diff OLD=BENCH_r18.json NEW=/tmp/BENCH_r18.json \
 #       METRIC=lanes.routers2.forwards_per_sec
+# The shard suite's CI gate rides the n=4 lane's device-time aggregate
+# cell-updates/sec leaf (higher is better) — a halo/barrier/checkpoint
+# overhead regression or an HRW balance regression inflates the slowest
+# worker's CPU makespan and fails the gate even when the n=1 baseline
+# moved with it; the >= 2x n4/n1 strong-scaling floor and the
+# byte-identical-across-lanes board digest are exit-code gated inside
+# the suite itself:
+#   make bench-diff OLD=BENCH_r20.json NEW=/tmp/BENCH_r20.json \
+#       METRIC=lanes.shard_n4.cell_updates_per_sec
 bench-diff:
 	@test -n "$(OLD)" && test -n "$(NEW)" || \
 		{ echo "usage: make bench-diff OLD=a.json NEW=b.json [TOLERANCE=0.1] [METRIC=dot.path]"; exit 2; }
@@ -190,6 +199,16 @@ storage-smoke:
 # journal through both kills.
 control-smoke:
 	python3 tools/control_smoke.py
+
+# Sharded-universe smoke (tools/shard_smoke.py): a real 3-worker
+# `gol fleet` takes one giant-universe shard job (HRW tile ownership,
+# halo frames over the packed wire), one worker is SIGKILLed
+# mid-super-step — the respawn replays ONLY its own shard's journal from
+# the durable super-step — and the final board must be byte-identical to
+# an uninterrupted single-process sparse run, with an exactly-once audit
+# (one done record per partition, restore records only on the victim).
+shard-smoke:
+	python3 tools/shard_smoke.py
 
 # Open-ended randomized differential campaigns (tools/soak_*.py docstrings).
 soak:

@@ -2561,6 +2561,206 @@ def _bench_macro(args) -> int:
     return 0 if headline >= 50.0 else 1
 
 
+def _bench_shard(args) -> int:
+    """Sharded single-job engine suite (--suite shard) -> BENCH_r20.json.
+
+    ISSUE 18's strong-scaling question: one FIXED giant universe (2^16
+    per side, a spread multi-glider load) split across N in {1, 2, 4}
+    real `gol serve` workers by HRW tile ownership, driven through the
+    router's shard coordinator lane — real HTTP step RPCs, real halo
+    frames, real per-worker checkpoint fsyncs.
+
+    The gated figure is **device-time** aggregate cell-updates/sec:
+    cell updates (active tiles x tile^2, identical across lanes — the
+    byte-exactness contract makes the active set partition-invariant,
+    asserted here) divided by the MAKESPAN in per-worker CPU seconds
+    (max over workers of /proc/<pid> utime+stime deltas around the
+    timed job). Each worker is one emulated device: on a host with a
+    core per worker this IS wall clock, and on the single-core CI host
+    it still measures everything the shard tier controls — halo
+    encode/exchange overhead, barrier bookkeeping, checkpoint encode,
+    and HRW balance (imbalance inflates the max directly) — instead of
+    measuring how many cores the CI box happens to have. Wall-clock
+    seconds per lane are recorded alongside, un-gated.
+
+    Headline: n4 aggregate rate over n1, gated by exit code at >= 2x
+    (the ISSUE 18 acceptance floor: overhead + imbalance may cost at
+    most half the ideal 4x). Per-lane rates land under lanes.shard_nN
+    for `tools/bench_diff.py --metric lanes.shard_n4.cell_updates_per_sec`.
+    Every lane's result board must be byte-identical (sha1 of the RLE)
+    to every other lane's — a scaling number for a wrong board is
+    noise, so the suite dies on digest drift.
+    """
+    import hashlib
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from gol_tpu.fleet import client as fleet_client
+    from gol_tpu.fleet.router import RouterServer
+    from gol_tpu.fleet.workers import Fleet, core_slice_prefix
+    from gol_tpu.sparse import SparseBoard
+
+    tile = 256
+    universe = 1 << 16           # 256x256 tiles of 256^2
+    gen_limit = args.gen_limit if args.gen_limit is not None else 48
+    checkpoint_every = 16
+    grid_n = 16                  # 16x16 gliders spread over the tile grid
+    glider = np.zeros((3, 3), dtype=np.uint8)
+    glider[0, 1] = glider[1, 2] = glider[2, 0] = glider[2, 1] = glider[2, 2] = 1
+
+    board = SparseBoard(universe, universe, tile)
+    for i in range(grid_n):
+        for j in range(grid_n):
+            arr = np.zeros((tile, tile), dtype=np.uint8)
+            if (i + j) % 8 == 0:
+                # A few gliders sit on a tile edge so halo frames carry
+                # live rings (the rest keep the load HRW-balanceable).
+                arr[1:4, 126:129] = glider
+            else:
+                arr[126:129, 126:129] = glider
+            board.set_tile((8 + 15 * i, 8 + 15 * j), arr)
+    rle = board.to_rle()
+    cores = os.cpu_count() or 4
+    pin = core_slice_prefix(max(1, min(6, (cores - 2) // 4)), cores)
+    workroot = tempfile.mkdtemp(prefix="gol-bench-shard-")
+    print(f"bench shard: {universe}^2 universe, {grid_n * grid_n} gliders, "
+          f"gen_limit {gen_limit}, ckpt every {checkpoint_every}, "
+          f"{cores} host core(s), platform={jax.devices()[0].platform}",
+          file=sys.stderr)
+
+    tck = float(os.sysconf("SC_CLK_TCK"))
+
+    def cpu_seconds(pid: int) -> float:
+        # utime+stime from /proc/<pid>/stat — fields 14/15, counted after
+        # the ')' so a space in comm cannot shift the split.
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            fields = f.read().rsplit(b")", 1)[1].split()
+        return (int(fields[11]) + int(fields[12])) / tck
+
+    def run_job(base: str, gens: int, ckpt: int) -> dict:
+        status, payload = fleet_client.http_json("POST", f"{base}/jobs", {
+            "shard": True, "rle": rle, "x": 0, "y": 0,
+            "width": universe, "height": universe, "tile": tile,
+            "convention": "c", "gen_limit": gens,
+            "check_similarity": False, "checkpoint_every": ckpt,
+        }, timeout=120)
+        if status != 202:
+            raise RuntimeError(f"shard submit HTTP {status}: {payload}")
+        jid = payload["id"]
+        while True:
+            status, job = fleet_client.http_json(
+                "GET", f"{base}/jobs/{jid}", timeout=30)
+            if status == 200 and job.get("state") == "done":
+                break
+            if status != 200 or job.get("state") == "failed":
+                raise RuntimeError(f"shard job {jid}: HTTP {status} {job}")
+            time.sleep(0.05)
+        status, result = fleet_client.http_json(
+            "GET", f"{base}/result/{jid}", timeout=300)
+        if status != 200:
+            raise RuntimeError(f"shard result HTTP {status}: {result}")
+        return result
+
+    def shard_lane(n_workers: int) -> dict:
+        fleet_dir = os.path.join(workroot, f"shard-n{n_workers}")
+        fleet = Fleet(fleet_dir, spawn_prefix=pin)
+        fleet.spawn_fleet(n_workers)
+        router = RouterServer(fleet, port=0)
+        router.start()
+        try:
+            # Warm lane: compiles the tile-step runner in EVERY worker
+            # process and pages the RLE parse path, outside the meters.
+            run_job(router.url, 4, 4)
+            pids = {w.id: w.pid for w in fleet.shard_pool()}
+            cpu0 = {wid: cpu_seconds(pid) for wid, pid in pids.items()}
+            t0 = time.perf_counter()
+            result = run_job(router.url, gen_limit, checkpoint_every)
+            wall = time.perf_counter() - t0
+            cpu = {wid: cpu_seconds(pid) - cpu0[wid]
+                   for wid, pid in pids.items()}
+        finally:
+            router.shutdown(cascade=True)
+        if result["generations"] != gen_limit or \
+                result["exit_reason"] != "gen_limit":
+            raise RuntimeError(f"shard lane n={n_workers}: unexpected exit "
+                               f"{result['generations']}/"
+                               f"{result['exit_reason']}")
+        makespan = max(cpu.values())
+        rate = result["cell_updates"] / makespan
+        print(f"  shard n={n_workers}: {rate / 1e6:.1f}M cell-updates/s "
+              f"(device makespan {makespan:.2f}s, wall {wall:.2f}s, "
+              f"worker-cpu {' '.join(f'{wid}={s:.2f}' for wid, s in sorted(cpu.items()))}, "
+              f"{result['supersteps']} supersteps)", file=sys.stderr)
+        return {
+            "workers": n_workers,
+            "cell_updates": result["cell_updates"],
+            "device_makespan_s": round(makespan, 3),
+            "worker_cpu_s": {wid: round(s, 3)
+                             for wid, s in sorted(cpu.items())},
+            "wall_s": round(wall, 3),
+            "supersteps": result["supersteps"],
+            "ownership": result["ownership"],
+            "cell_updates_per_sec": round(rate, 1),
+            "digest": hashlib.sha1(
+                result["rle"].encode("ascii")).hexdigest(),
+        }
+
+    lanes = {}
+    try:
+        for n in (1, 2, 4):
+            lanes[f"shard_n{n}"] = shard_lane(n)
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+    digests = {lane["digest"] for lane in lanes.values()}
+    if len(digests) != 1:
+        raise RuntimeError(f"result boards drifted across lanes: {digests}")
+    updates = {lane["cell_updates"] for lane in lanes.values()}
+    if len(updates) != 1:
+        raise RuntimeError(f"active-tile work drifted across lanes "
+                           f"(partition-variant active set): {updates}")
+
+    scaling = (lanes["shard_n4"]["cell_updates_per_sec"]
+               / lanes["shard_n1"]["cell_updates_per_sec"])
+    print(f"  n4 over n1 aggregate = {scaling:.2f}x "
+          f"(acceptance >= 2x)", file=sys.stderr)
+    payload = {
+        "metric": "shard_n4_over_n1_cell_updates_per_sec",
+        "value": round(scaling, 3),
+        "unit": "x",
+        "vs_baseline": None,  # the n1 lane IS the baseline; floor is 2.0
+        "load": {
+            "universe": f"{universe}x{universe}",
+            "tile": tile,
+            "gliders": grid_n * grid_n,
+            "gen_limit": gen_limit,
+            "checkpoint_every": checkpoint_every,
+            "host_cores": cores,
+            "note": "device-time strong scaling: each worker is one "
+            "emulated device; rates are cell updates over the MAX "
+            "per-worker CPU-seconds delta (utime+stime), so the figure "
+            "measures shard-tier overhead + HRW balance, not the CI "
+            "host's core count — on a core-per-worker host it equals "
+            "wall clock. wall_s per lane is recorded un-gated. Result "
+            "boards sha1-compared across lanes.",
+        },
+        "lanes": lanes,
+        "env": _env_stamp(),
+    }
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r20.json")
+    with open(artifact, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {artifact}", file=sys.stderr)
+    print(json.dumps(payload))
+    return 0 if scaling >= 2.0 else 1
+
+
 def _bench_chaos(args) -> int:
     """Chaos-hardened data path suite (--suite chaos) -> BENCH_r16.json.
 
@@ -3308,6 +3508,17 @@ SUITES = {
         "cost is quadratic in the glider stream); acceptance: macro >= "
         "50x the sparse lower bound, exit-code gated (CI gates --metric "
         "lanes.macro.speedup_vs_sparse); writes BENCH_r19.json",
+    ),
+    "shard": (
+        _bench_shard,
+        "sharded single-job engine: one fixed 2^16^2 multi-glider "
+        "universe split across N in {1, 2, 4} real workers by HRW tile "
+        "ownership through the router's shard coordinator (real halo "
+        "frames + checkpoint fsyncs); device-time aggregate "
+        "cell-updates/sec, byte-identical boards across lanes "
+        "(acceptance: n4 >= 2x n1; CI gates "
+        "--metric lanes.shard_n4.cell_updates_per_sec); writes "
+        "BENCH_r20.json",
     ),
     "tune": (
         _bench_tune,

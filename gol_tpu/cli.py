@@ -197,6 +197,20 @@ def _run(args) -> int:
             "--macro-cas applies to the macro engine lane; add "
             "--engine macro (or auto)"
         )
+    if args.engine == "shard" and not args.shard_across:
+        raise ValueError(
+            "--engine shard needs --shard-across ROUTER_URL (the job "
+            "runs across a fleet, not in this process)"
+        )
+    if args.shard_across and args.engine != "shard":
+        # Same loudness as --macro-cas: a sharding flag that silently
+        # ran locally would misreport what executed where.
+        raise ValueError("--shard-across applies to --engine shard")
+    if args.engine == "shard" and args.pattern is None:
+        raise ValueError(
+            "--engine shard takes the --pattern lane (the universe "
+            "travels as RLE; dense input files do not)"
+        )
     enable_compile_cache(args.compile_cache)
 
     if args.fault_plan:
@@ -848,6 +862,73 @@ def _run_macro(args, variant, config, board, read_ms, output_path) -> int:
     )
 
 
+def _run_shard(args, variant, config, pattern, x, y, height, width, tile,
+               read_ms) -> int:
+    """``--engine shard``: submit the pattern as ONE sharded job to a
+    fleet router (gol_tpu/shard) and poll it home. The printed contract
+    and the written RLE are byte-identical to the sparse lane's — the
+    sharded engine's core promise — only the execution spans N workers."""
+    from gol_tpu.fleet import client as fleet_client
+    from gol_tpu.io import rle as rle_codec
+    from gol_tpu.sparse.board import SparseBoard
+
+    router = args.shard_across.rstrip("/")
+    if variant.io_timings:
+        print(f"Reading file:\t{read_ms:.2f} msecs")
+    body = {
+        "shard": True,
+        "rle": rle_codec.encode(pattern),
+        "x": x, "y": y, "width": width, "height": height, "tile": tile,
+        "convention": config.convention,
+        "gen_limit": config.gen_limit,
+        "check_similarity": config.check_similarity,
+        "similarity_frequency": config.similarity_frequency,
+    }
+    t0 = time.perf_counter()
+    status, payload = fleet_client.http_json(
+        "POST", f"{router}/jobs", body, timeout=120)
+    if status != 202:
+        raise ValueError(
+            f"shard submit rejected: HTTP {status} {payload}"
+        )
+    job_id = payload["id"]
+    while True:
+        status, job = fleet_client.http_json(
+            "GET", f"{router}/jobs/{job_id}", timeout=30)
+        if status != 200:
+            raise ValueError(
+                f"shard job poll failed: HTTP {status} {job}"
+            )
+        if job.get("state") in ("done", "failed"):
+            break
+        time.sleep(0.1)
+    if job["state"] == "failed":
+        raise ValueError(
+            f"shard job failed: {job.get('error', 'unknown error')}"
+        )
+    status, result = fleet_client.http_json(
+        "GET", f"{router}/result/{job_id}", timeout=300)
+    if status != 200:
+        raise ValueError(f"shard result fetch failed: HTTP {status}")
+    exec_ms = (time.perf_counter() - t0) * 1000
+    generations = int(result["generations"])
+    comments = (
+        f"generations {generations} exit {result['exit_reason']}",
+    )
+    # Round-trip through SparseBoard: validates the merged document and
+    # re-emits it through the same encoder as the sparse lane, so the
+    # written file is byte-identical to a single-worker run's.
+    board = SparseBoard.from_rle(result["rle"], height=height,
+                                 width=width, tile=tile)
+    output_path = args.output or "./sparse_output.rle"
+    return _report_and_write(
+        variant,
+        generations,
+        exec_ms,
+        lambda: _write_text(output_path, board.to_rle(comments)),
+    )
+
+
 def _write_text(path: str, text: str) -> None:
     with open(path, "w", encoding="utf-8") as f:
         f.write(text)
@@ -902,6 +983,14 @@ def _run_pattern(args, variant) -> int:
             if auto_macro(height, width, tile, config.gen_limit,
                           (y, x, y + ph - 1, x + pw - 1)):
                 engine_pick = "macro"
+    if engine_pick == "shard":
+        if args.kernel != "auto":
+            raise ValueError(
+                "--kernel does not apply to the shard engine (the "
+                "workers' tile step is its own kernel family)"
+            )
+        return _run_shard(args, variant, config, pattern, x, y,
+                          height, width, tile, read_ms)
     if engine_pick in ("sparse", "macro"):
         if args.kernel != "auto":
             raise ValueError(
@@ -2942,13 +3031,22 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--engine", default="auto", choices=("auto", "dense", "sparse",
-                                             "macro"),
+                                             "macro", "shard"),
         help="engine family: dense (the classic O(area) lanes), sparse "
         "(tiled O(live-area) — gol_tpu/sparse), macro (hash-consed "
-        "macrocell, O(log gens) deep time — gol_tpu/macro), or auto "
+        "macrocell, O(log gens) deep time — gol_tpu/macro), shard (one "
+        "giant universe spanning a fleet's workers with per-super-step "
+        "halo exchange — gol_tpu/shard; needs --shard-across), or auto "
         "(sparse above the area threshold when the extents tile evenly, "
         "upgraded to macro above the generation threshold when the "
         "placement keeps the run off the torus seam)",
+    )
+    run.add_argument(
+        "--shard-across", default=None, metavar="URL",
+        help="fleet router URL for --engine shard: the universe is "
+        "partitioned across the router's workers by rendezvous hashing "
+        "over tile coordinates and run as coordinated super-steps; the "
+        "result is byte-identical to the sparse engine's",
     )
     run.add_argument(
         "--tile", type=int, default=0, metavar="N",

@@ -49,6 +49,7 @@ import json
 import logging
 import threading
 import urllib.error
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
 
@@ -461,6 +462,13 @@ class RouterServer:
         self._jobs_cap = 65536
         self._jobs_lock = threading.Lock()
         self._draining = False
+        # The sharded single-job lane (gol_tpu/shard): job id -> entry
+        # {state, workers, result, error, coordinator}. Coordinators run
+        # on daemon threads in THIS process, leader-only (a follower
+        # answers 409 and the client resubmits at the leader after
+        # failover — the flock lease guarantees one driver per job).
+        self._shard_jobs: dict[str, dict] = {}
+        self._shard_lock = threading.Lock()
         # Durable metrics history (obs/history.py), mounted by
         # start_history: one tick thread appending the FLOORED merged
         # snapshot — the MonotonicCounters pass above is exactly what
@@ -734,6 +742,160 @@ class RouterServer:
             return placement.rank_weighted(label, affinity.weights_for(pool))
         return placement.rank(label, [w.id for w in pool])
 
+    # -- the sharded single-job lane (gol_tpu/shard) -----------------------
+
+    def _shard_participant(self, worker_id: str):
+        """An HttpParticipant whose URL is re-read from the fleet record
+        on EVERY call (a respawned partition answers on a new port) and
+        resolved through the chaos hop when one is mounted — halo peers
+        and coordinator RPCs ride the same faulty data path as submits."""
+        from gol_tpu.shard.coordinator import HttpParticipant
+
+        def url():
+            worker = self.fleet.worker(worker_id)
+            if worker is None or not worker.url:
+                return None
+            return self._data_url(worker)
+
+        return HttpParticipant(worker_id, url)
+
+    def _shard_membership(self, initial_ids):
+        """The coordinator's elastic-membership hook: consulted at
+        checkpoint barriers, reporting a change only when the eligible
+        pool GREW (the autoscaler added workers — HRW moves only the
+        tiles the new workers win). Shrinks are deliberately ignored: a
+        dead worker is a RECOVERY (its journal replays), not a
+        membership change, and a retiring one finishes its shard."""
+        state = {"ids": set(initial_ids)}
+
+        def hook():
+            pool = self.fleet.shard_pool()
+            ids = {w.id for w in pool}
+            if not ids > state["ids"]:
+                return None
+            merged = sorted(state["ids"] | ids)
+            state["ids"] = set(merged)
+            return [self._shard_participant(wid) for wid in merged]
+
+        return hook
+
+    def _submit_shard(self, body: dict):
+        """``POST /jobs`` with ``"shard": true`` — one giant universe
+        spanning the worker set. 202 with the job id; progress and the
+        merged result come from the usual GET endpoints."""
+        if not self.fleet.leading:
+            return 409, {
+                "error": "shard jobs run on the leader router; this "
+                         "replica holds no flock lease",
+            }
+        missing = [k for k in ("rle", "width", "height") if k not in body]
+        if missing:
+            raise ValueError(
+                f"missing required field(s) for a shard job: {missing}"
+            )
+        pool = self.fleet.shard_pool()
+        if not pool:
+            return 503, {"error": "fleet has no routable workers"}
+        from gol_tpu.shard.coordinator import ShardCoordinator
+
+        job_id = uuid.uuid4().hex
+        spec = {
+            k: body[k] for k in (
+                "rle", "x", "y", "width", "height", "tile", "convention",
+                "gen_limit", "check_similarity", "similarity_frequency",
+            ) if k in body
+        }
+        ids = [w.id for w in pool]
+        coordinator = ShardCoordinator(
+            job_id, spec,
+            [self._shard_participant(wid) for wid in ids],
+            checkpoint_every=int(body.get("checkpoint_every", 0) or 8),
+            registry=self.registry,
+            membership=self._shard_membership(ids),
+        )
+        entry = {
+            "id": job_id, "state": "running", "workers": ids,
+            "result": None, "error": None, "coordinator": coordinator,
+        }
+        with self._shard_lock:
+            self._shard_jobs[job_id] = entry
+        thread = threading.Thread(
+            target=self._run_shard, args=(job_id, coordinator),
+            name=f"gol-shard-{job_id[:8]}", daemon=True,
+        )
+        thread.start()
+        return 202, {"id": job_id, "state": "running", "shard": True,
+                     "workers": ids}
+
+    def _run_shard(self, job_id: str, coordinator) -> None:
+        try:
+            result = coordinator.run()
+        except Exception as e:  # noqa: BLE001 — the job must reach a
+            # terminal state whatever the coordinator died of; the error
+            # is surfaced verbatim on GET.
+            logger.error("shard job %s failed: %s", job_id, e)
+            with self._shard_lock:
+                entry = self._shard_jobs[job_id]
+                entry["state"] = "failed"
+                entry["error"] = str(e)
+            self.registry.inc("shard_jobs_failed_total")
+            return
+        with self._shard_lock:
+            entry = self._shard_jobs[job_id]
+            entry["state"] = "done"
+            entry["result"] = result
+        self.registry.inc("shard_jobs_done_total")
+
+    def shard_job_json(self, job_id: str) -> dict | None:
+        """GET /jobs/<id> for a shard job (None: not a shard job — the
+        caller falls through to the forwarding path)."""
+        with self._shard_lock:
+            entry = self._shard_jobs.get(job_id)
+            if entry is None:
+                return None
+            out = {"id": job_id, "state": entry["state"], "shard": True,
+                   "workers": list(entry["workers"])}
+            coordinator = entry["coordinator"]
+            out["superstep"] = coordinator.k
+            out["durable_superstep"] = coordinator.durable
+            out["recoveries"] = coordinator.recoveries
+            if entry["error"]:
+                out["error"] = entry["error"]
+            if entry["state"] == "done":
+                result = dict(entry["result"])
+                result.pop("rle", None)  # the board rides /result/<id>
+                out["result"] = result
+            return out
+
+    def shard_result(self, job_id: str):
+        """GET /result/<id> for a shard job: (status, payload), or None
+        to fall through to the forwarding path."""
+        with self._shard_lock:
+            entry = self._shard_jobs.get(job_id)
+            if entry is None:
+                return None
+            if entry["state"] == "failed":
+                return 410, {"id": job_id, "state": "failed",
+                             "error": entry["error"]}
+            if entry["state"] != "done":
+                return 409, {"id": job_id, "state": entry["state"],
+                             "error": "shard job is still running"}
+            return 200, {"id": job_id, "state": "done",
+                         **entry["result"]}
+
+    def shard_cancel(self, job_id: str):
+        """DELETE /jobs/<id> for a shard job: running super-steps are not
+        cancellable mid-barrier (the answer the single-server scheduler
+        gives for claimed jobs)."""
+        with self._shard_lock:
+            entry = self._shard_jobs.get(job_id)
+            if entry is None:
+                return None
+            return 409, {
+                "id": job_id, "state": entry["state"],
+                "error": "shard jobs are not cancellable",
+            }
+
     def route_submit(self, raw: bytes, content_type: str | None = None,
                      deadline_header: str | None = None):
         """(status, payload) for POST /jobs: place, forward, spill.
@@ -783,6 +945,15 @@ class RouterServer:
             body = json.loads(raw.decode("utf-8"))
             if not isinstance(body, dict):
                 raise ValueError("request body must be a JSON object")
+        if body.get("shard"):
+            # The sharded single-job lane: this router COORDINATES the
+            # job across its workers instead of forwarding it to one.
+            if packed:
+                return 400, {
+                    "error": "shard jobs take the text form (rle field); "
+                             "the packed frame cannot be re-sliced here",
+                }
+            return self._submit_shard(body)
         key = placement.key_for(body)  # raises -> handler's 400
         rank_label = None
         if self.cache_route and not body.get("no_cache"):
@@ -1352,6 +1523,10 @@ def _make_handler(router: RouterServer):
                 self._reply(404, {"error": f"no such endpoint {path}"})
                 return
             job_id = path[len("/jobs/"):]
+            shard = router.shard_cancel(job_id)
+            if shard is not None:
+                self._reply(*shard)
+                return
             self._reply(*router.forward_job("DELETE", job_id))
 
         def do_GET(self):
@@ -1364,8 +1539,16 @@ def _make_handler(router: RouterServer):
                         "GET", rest[: -len("/timeline")], "timeline"
                     ))
                 else:
-                    self._reply(*router.forward_job("GET", rest))
+                    shard = router.shard_job_json(rest)
+                    if shard is not None:
+                        self._reply(200, shard)
+                    else:
+                        self._reply(*router.forward_job("GET", rest))
             elif path.startswith("/result/"):
+                shard = router.shard_result(path[len("/result/"):])
+                if shard is not None:
+                    self._reply(*shard)
+                    return
                 accept = self.headers.get("Accept")
                 if wire.accepts_packed(accept):
                     status, payload = router.forward_job(

@@ -216,6 +216,21 @@ class Fleet:
         with self._lock:
             return self._workers.get(worker_id)
 
+    def shard_pool(self) -> list[Worker]:
+        """The workers eligible to HOLD a shard of a sharded single-job
+        run (gol_tpu/shard): routable, healthy, not mid-drain. Stricter
+        than the submit walk on purpose — a shard assignment is sticky
+        for the whole job (its checkpoints live in the owner's journal
+        partition), so a wobbling worker that a submit would merely
+        deprioritize must not anchor a shard. Sorted by id: every caller
+        derives the same membership list, and the HRW partition is a
+        pure function of that list."""
+        with self._lock:
+            pool = [w for w in self._workers.values()
+                    if w.url and w.healthy and not w.retiring
+                    and not w.respawning]
+        return sorted(pool, key=lambda w: w.id)
+
     def _add(self, worker: Worker) -> Worker:
         with self._lock:
             if worker.id in self._workers:

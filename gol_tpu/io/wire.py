@@ -62,6 +62,20 @@ CONTENT_TYPE_FAMILY = "application/x-gol-"
 MAGIC = b"GOLP"
 VERSION = 1
 
+# -- shard frame meta convention (gol_tpu/shard/halo.py) --------------------
+#
+# The sharded single-job engine's worker↔worker hops ride this exact frame
+# format; the ``kind`` meta key names which shard payload the rows carry so
+# a halo frame can never be mistaken for a board submit (a submit's meta
+# never carries ``kind``). ``shard-halo`` stacks 4 ring rows (top, bottom,
+# left-as-row, right-as-row) per boundary tile; ``shard-tiles`` stacks
+# ``tile`` full rows per migrating tile (the elastic-rebalance transfer).
+# Both list their tile coords under the ``tiles`` meta key, in row-major
+# order matching the payload stacking.
+META_KIND = "kind"
+SHARD_HALO_KIND = "shard-halo"
+SHARD_TILES_KIND = "shard-tiles"
+
 _HEADER = struct.Struct("<4sHHIIII")
 HEADER_SIZE = _HEADER.size  # 24 bytes
 

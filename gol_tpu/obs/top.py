@@ -115,6 +115,29 @@ def render_frame(metrics: dict, slo: dict | None, *, ansi: bool = True,
                     line += f" ({last['reason']})"
         lines.append(_color(status, line, ansi) if action != "hold"
                      else line)
+    router_reg = (fleet or {}).get("router") or {}
+    rcounters = router_reg.get("counters") or {}
+    rgauges = router_reg.get("gauges") or {}
+    rhists = router_reg.get("histograms") or {}
+    owned = {k[len("shard_tiles_owned_"):]: v for k, v in rgauges.items()
+             if k.startswith("shard_tiles_owned_")}
+    if rcounters.get("shard_jobs_total") or owned:
+        # The sharded-universe panel: one giant board split across the
+        # fleet. The durable super-step is the replay floor — a SIGKILLed
+        # worker rewinds to it, nobody else moves past it un-checkpointed.
+        ss = rhists.get("shard_superstep_seconds") or {}
+        lines.append(
+            f"shard: jobs {int(rcounters.get('shard_jobs_total', 0))}"
+            f"  done {int(rcounters.get('shard_jobs_done_total', 0))}"
+            f"  failed {int(rcounters.get('shard_jobs_failed_total', 0))}"
+            f"   durable step {int(rgauges.get('shard_durable_step', 0))}"
+            f"   recoveries {int(rcounters.get('shard_recoveries_total', 0))}"
+            f"   superstep p50 {_fmt(ss.get('p50'))}s"
+            f" p95 {_fmt(ss.get('p95'))}s"
+        )
+        if owned:
+            lines.append("  tiles: " + "  ".join(
+                f"{wid} {int(n)}" for wid, n in sorted(owned.items())))
     lines.append("")
 
     # -- queue / flow -------------------------------------------------------

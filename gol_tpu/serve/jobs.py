@@ -150,6 +150,12 @@ class Job:
     # the macro engine is byte-identical to sparse by contract, so the
     # flag is an execution hint, like picking a kernel.
     macro: bool = False
+    # The sharded single-job form (gol_tpu/shard): accepted ONLY by a
+    # fleet router, which runs the job as coordinated super-steps across
+    # its workers instead of queueing it here. The field exists on Job so
+    # a shard submit aimed at a plain worker fails loudly at admission
+    # (400) rather than silently running single-worker.
+    shard: bool = False
     state: str = QUEUED
     # The result-cache key (gol_tpu/cache/fingerprint.py), computed by the
     # scheduler at admission when a cache is mounted; None otherwise (and
@@ -224,6 +230,16 @@ class Job:
             )
         if self.macro and self.rle is None:
             raise ValueError("macro jobs take the sparse input form (rle)")
+        if not isinstance(self.shard, bool):
+            raise TypeError(
+                f"shard must be a JSON boolean, got "
+                f"{type(self.shard).__name__}"
+            )
+        if self.shard:
+            raise ValueError(
+                "shard jobs are router-driven: submit them to a fleet "
+                "router (gol fleet), not directly to a worker"
+            )
         self.priority = int(self.priority)
         if self.deadline_s is not None:
             self.deadline_s = float(self.deadline_s)

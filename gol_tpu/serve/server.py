@@ -180,6 +180,11 @@ class GolServer:
         )
         self.journal_dir = journal_dir
         self.journal_retain = journal_retain
+        # The sharded single-job lane (gol_tpu/shard): mounted lazily on
+        # the first /shard/* RPC — a worker that never joins a sharded
+        # job pays nothing for the subsystem.
+        self._shard = None
+        self._shard_lock = threading.Lock()
         # Durable metrics history (obs/history.py): OFF by default — no
         # writer object, no per-tick work. With --metrics-history, every
         # sampler tick appends the serving registry snapshot to the
@@ -348,10 +353,66 @@ class GolServer:
 
     # -- request-level operations (handler methods stay thin) -------------
 
+    @property
+    def shard(self):
+        """The lazily-mounted shard host (gol_tpu/shard/worker.py): its
+        checkpoint logs live in this worker's journal partition, so a
+        respawn on the same partition finds them."""
+        if self._shard is None:
+            with self._shard_lock:
+                if self._shard is None:
+                    from gol_tpu.shard.worker import ShardHost
+
+                    self._shard = ShardHost(journal_dir=self.journal_dir)
+        return self._shard
+
+    def shard_request(self, leg: str, raw: bytes):
+        """One ``POST /shard/<leg>`` RPC -> (status, payload). The packed
+        legs (halo, adopt) take GOLP frames; the rest JSON bodies.
+        ValueError (ShardError, WireError, malformed JSON) propagates to
+        the handler's 400 mapping; an exhausted halo-send budget answers
+        503 naming the peer — the coordinator's recovery cue."""
+        from gol_tpu.shard.worker import PeerUnreachable
+
+        host = self.shard
+        try:
+            if leg == "halo":
+                return 200, host.halo_in(raw)
+            if leg == "adopt":
+                return 200, host.adopt(raw)
+            body = json.loads(raw.decode("utf-8"))
+            if not isinstance(body, dict):
+                raise ValueError("shard request body must be a JSON object")
+            if leg == "init":
+                return 200, host.init_job(body)
+            if leg == "step":
+                return 200, host.step_job(body["job"], body["step"])
+            if leg == "checkpoint":
+                return 200, host.checkpoint(body["job"], body["step"])
+            if leg == "rewind":
+                return 200, host.rewind(body["job"], body["step"],
+                                        body.get("peers"))
+            if leg == "restore":
+                return 200, host.restore_job(body)
+            if leg == "status":
+                return 200, host.status(body["job"])
+            if leg == "rebalance":
+                return 200, host.rebalance(body)
+            if leg == "collect":
+                return 200, host.collect(body["job"],
+                                         body.get("which", "current"))
+            if leg == "done":
+                return 200, host.finish(body["job"])
+            return 404, {"error": f"unknown shard leg {leg!r}"}
+        except PeerUnreachable as e:
+            return 503, {"error": str(e)}
+
     def submit_json(self, body: dict, trace_header: str | None = None,
                     deadline_header: str | None = None) -> dict:
         if "rle" in body:
             return self._submit_sparse(body, trace_header, deadline_header)
+        if body.get("shard"):
+            raise ValueError("shard jobs take the sparse input form (rle)")
         required = ("width", "height", "cells")
         missing = [k for k in required if k not in body]
         if missing:
@@ -383,6 +444,7 @@ class GolServer:
         for field in (
             "convention", "gen_limit", "check_similarity",
             "similarity_frequency", "priority", "no_cache", "macro",
+            "shard",
         ):
             if field in body:
                 kwargs[field] = body[field]
@@ -832,6 +894,15 @@ def _make_handler(server: GolServer):
                         "drained": drained,
                         "stats": server.scheduler.stats(),
                     })
+                elif path.startswith("/shard/"):
+                    # The sharded single-job lane's worker RPCs
+                    # (gol_tpu/shard): halo frames, super-steps,
+                    # checkpoints, recovery. Driven by the router's
+                    # coordinator, worker-to-worker for halo/adopt.
+                    code, payload = server.shard_request(
+                        path[len("/shard/"):], self._read_raw()
+                    )
+                    self._reply(code, payload)
                 else:
                     self._discard_body()
                     self._reply(404, {"error": f"no such endpoint {path}"})

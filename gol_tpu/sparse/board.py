@@ -115,10 +115,17 @@ class SparseBoard:
     @classmethod
     def from_rle(cls, text: str, height: int | None = None,
                  width: int | None = None, tile: int = DEFAULT_TILE,
-                 x: int = 0, y: int = 0) -> "SparseBoard":
+                 x: int = 0, y: int = 0, owned=None) -> "SparseBoard":
         """Build a board from an RLE document via the streaming run path —
         no dense canvas at any size. With ``height``/``width`` absent the
-        RLE header's extents ARE the universe."""
+        RLE header's extents ARE the universe.
+
+        ``owned`` is an optional ``(ty, tx) -> bool`` tile filter: runs are
+        split across the tiles they span and only owned tiles materialize
+        — the shard-worker loading path (gol_tpu/shard), where a worker
+        owning one slice of a 2^20-square document must cost O(its runs)
+        in memory, never the whole document's tiles. ``None`` (every other
+        caller) loads everything, byte-identically to before."""
         (pw, ph), runs = rle.live_runs(text)
         if height is None or width is None:
             height, width = ph, pw
@@ -132,7 +139,7 @@ class SparseBoard:
                 f"{height}x{width} universe"
             )
         for row, col, count in runs:
-            board._set_run(y + row, x + col, count)
+            board._set_run(y + row, x + col, count, owned)
         return board
 
     def place(self, pattern: np.ndarray, x: int, y: int) -> None:
@@ -152,18 +159,21 @@ class SparseBoard:
             for start, end in rle._row_runs(row):
                 self._set_run(y + r, x + start, end - start)
 
-    def _set_run(self, row: int, col: int, count: int) -> None:
+    def _set_run(self, row: int, col: int, count: int, owned=None) -> None:
         """Set ``count`` cells live starting at (row, col), splitting the
-        run across the tiles it spans."""
+        run across the tiles it spans. ``owned`` filters which tiles may
+        materialize (tile-by-tile: an unowned slice of the run is skipped
+        without ever allocating its tile)."""
         t = self.tile
         ty, ly = divmod(row, t)
         while count > 0:
             tx, lx = divmod(col, t)
             take = min(count, t - lx)
-            arr = self.tiles.get((ty, tx))
-            if arr is None:
-                arr = self.tiles[(ty, tx)] = np.zeros((t, t), np.uint8)
-            arr[ly, lx:lx + take] = 1
+            if owned is None or owned((ty, tx)):
+                arr = self.tiles.get((ty, tx))
+                if arr is None:
+                    arr = self.tiles[(ty, tx)] = np.zeros((t, t), np.uint8)
+                arr[ly, lx:lx + take] = 1
             col += take
             count -= take
 

@@ -111,28 +111,59 @@ def auto_engine(height: int, width: int, tile: int,
     return "dense"
 
 
-def _active_set(board: SparseBoard) -> set[tuple[int, int]]:
-    """Live tiles plus halo-activated neighbors of ring-live tiles."""
+def ring_live(arr: np.ndarray) -> bool:
+    """True when a tile's outermost ring holds a live cell — the condition
+    under which its neighbors activate (and, in the shard lanes, the
+    condition under which its ring must cross the wire)."""
+    return bool(arr[0].any() or arr[-1].any()
+                or arr[:, 0].any() or arr[:, -1].any())
+
+
+def _ghost_live(ring) -> bool:
+    """Ring-liveness of a ghost entry (see ``step_tiles`` for the ghost
+    protocol). An all-dead ghost ring activates nothing — exactly like an
+    absent tile, which it is indistinguishable from."""
+    return bool(ring.top.any() or ring.bottom.any()
+                or ring.left.any() or ring.right.any())
+
+
+def _active_set(board: SparseBoard, ghost=None,
+                owned=None) -> set[tuple[int, int]]:
+    """Live tiles plus halo-activated neighbors of ring-live tiles.
+
+    ``ghost`` extends ring-liveness to remote tiles (their neighbors
+    activate here too); ``owned`` filters the result to this worker's
+    ownership slice — a tile another worker owns is stepped there, never
+    here. Both default to None: the solo path is byte-identical."""
     active = set(board.tiles)
     ty_n, tx_n = board.tiles_y, board.tiles_x
-    for (ty, tx), arr in board.tiles.items():
-        if (arr[0].any() or arr[-1].any()
-                or arr[:, 0].any() or arr[:, -1].any()):
-            for dy in (-1, 0, 1):
-                for dx in (-1, 0, 1):
-                    if dy or dx:
-                        active.add(((ty + dy) % ty_n, (tx + dx) % tx_n))
+    seeds = [coord for coord, arr in board.tiles.items() if ring_live(arr)]
+    if ghost:
+        seeds.extend(c for c, ring in ghost.items() if _ghost_live(ring))
+    for ty, tx in seeds:
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                if dy or dx:
+                    active.add(((ty + dy) % ty_n, (tx + dx) % tx_n))
+    if owned is not None:
+        active = {coord for coord in active if owned(coord)}
     return active
 
 
-def _assemble_block(board: SparseBoard, coord: tuple[int, int]) -> np.ndarray:
+def _assemble_block(board: SparseBoard, coord: tuple[int, int],
+                    ghost=None) -> np.ndarray:
     """One tile's ``(tile+2)^2`` halo-extended block, ring gathered from
     its 8 torus neighbors (self-wrap on 1-tile-wide grids is the universe
-    torus, so a single-tile universe assembles its own torus halo)."""
+    torus, so a single-tile universe assembles its own torus halo).
+
+    A neighbor absent from the board may be present in ``ghost`` — a
+    remote tile's ring, received over the halo wire. Only the ring cells
+    a block ever reads exist there: edge rows/cols and corners."""
     t = board.tile
     ty, tx = coord
     ty_n, tx_n = board.tiles_y, board.tiles_x
     tiles = board.tiles
+    ghost = ghost or {}
     up, down = (ty - 1) % ty_n, (ty + 1) % ty_n
     left, right = (tx - 1) % tx_n, (tx + 1) % tx_n
     block = np.zeros((t + 2, t + 2), np.uint8)
@@ -142,32 +173,48 @@ def _assemble_block(board: SparseBoard, coord: tuple[int, int]) -> np.ndarray:
     n = tiles.get((up, tx))
     if n is not None:
         block[0, 1:-1] = n[-1]
+    elif (g := ghost.get((up, tx))) is not None:
+        block[0, 1:-1] = g.bottom
     s = tiles.get((down, tx))
     if s is not None:
         block[-1, 1:-1] = s[0]
+    elif (g := ghost.get((down, tx))) is not None:
+        block[-1, 1:-1] = g.top
     w = tiles.get((ty, left))
     if w is not None:
         block[1:-1, 0] = w[:, -1]
+    elif (g := ghost.get((ty, left))) is not None:
+        block[1:-1, 0] = g.right
     e = tiles.get((ty, right))
     if e is not None:
         block[1:-1, -1] = e[:, 0]
+    elif (g := ghost.get((ty, right))) is not None:
+        block[1:-1, -1] = g.left
     nw = tiles.get((up, left))
     if nw is not None:
         block[0, 0] = nw[-1, -1]
+    elif (g := ghost.get((up, left))) is not None:
+        block[0, 0] = g.bottom[-1]
     ne = tiles.get((up, right))
     if ne is not None:
         block[0, -1] = ne[-1, 0]
+    elif (g := ghost.get((up, right))) is not None:
+        block[0, -1] = g.bottom[0]
     sw = tiles.get((down, left))
     if sw is not None:
         block[-1, 0] = sw[0, -1]
+    elif (g := ghost.get((down, left))) is not None:
+        block[-1, 0] = g.top[-1]
     se = tiles.get((down, right))
     if se is not None:
         block[-1, -1] = se[0, 0]
+    elif (g := ghost.get((down, right))) is not None:
+        block[-1, -1] = g.top[0]
     return block
 
 
-def _step(board: SparseBoard, memo: TileMemo | None, stats: SparseStats
-          ) -> tuple[SparseBoard, bool]:
+def _step(board: SparseBoard, memo: TileMemo | None, stats: SparseStats,
+          ghost=None, owned=None) -> tuple[SparseBoard, bool]:
     """One global generation: ``(next_board, changed_any)``."""
     import jax
     import jax.numpy as jnp
@@ -176,7 +223,7 @@ def _step(board: SparseBoard, memo: TileMemo | None, stats: SparseStats
     from gol_tpu.serve import batcher
 
     t = board.tile
-    active = sorted(_active_set(board))
+    active = sorted(_active_set(board, ghost, owned))
     stats.tiles_active += len(active)
     results: dict[tuple[int, int], TileStep] = {}
     # Each miss is (key, block, [coords]): with a memo, identical blocks
@@ -186,7 +233,7 @@ def _step(board: SparseBoard, memo: TileMemo | None, stats: SparseStats
     misses: list[list] = []
     pending: dict[str, list] = {}
     for coord in active:
-        block = _assemble_block(board, coord)
+        block = _assemble_block(board, coord, ghost)
         if memo is not None:
             key = TileMemo.key(block, t)
             hit = memo.get(key)
@@ -239,6 +286,24 @@ def _step(board: SparseBoard, memo: TileMemo | None, stats: SparseStats
             # Invariant holds by the flag: only live interiors are stored.
             new_board.tiles[coord] = step.interior
     return new_board, changed_any
+
+
+def step_tiles(board: SparseBoard, memo: TileMemo | None, stats: SparseStats,
+               *, ghost=None, owned=None) -> tuple[SparseBoard, bool]:
+    """One super-step over an ownership slice: ``(next_board, changed)``.
+
+    The shard worker's entry point (gol_tpu/shard/worker.py). ``board``
+    holds only the tiles this worker owns; ``ghost`` maps remote neighbor
+    coords to ring views — objects with ``top``/``bottom``/``left``/
+    ``right`` length-``tile`` uint8 arrays (gol_tpu/shard/halo.Ring),
+    received as packed frames from the tiles' owners; ``owned`` is the
+    partition's membership predicate. Because a tile's step reads ONLY its
+    neighbors' outermost ring, and a tile with an all-dead ring is
+    indistinguishable from an absent one (it activates nothing and
+    contributes nothing), the union of every worker's ``step_tiles``
+    result equals one solo ``_step`` — byte-exactly, the property the
+    shard byte-gates pin. With both None this IS the solo step."""
+    return _step(board, memo, stats, ghost=ghost, owned=owned)
 
 
 def _run_c(board, config, memo, stats):

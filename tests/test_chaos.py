@@ -1161,6 +1161,87 @@ class TestChaosMatrix:
 
 
 # ---------------------------------------------------------------------------
+# The chaos matrix, shard lane: reset mid-frame on the halo hop
+
+
+class TestShardHaloChaos:
+    """ISSUE 18's halo-hop matrix entry: ``reset`` mid-exchange on the
+    worker<->worker data path of a SHARDED job. The proxy delivers the
+    halo frame whole, then resets the reply — the sender's retry ladder
+    re-sends bytes the receiver already holds, and the receiver's
+    (step, sender) inbox idempotency makes the duplicate a no-op. The
+    same faults hit the coordinator's step RPCs, which must surface as
+    ShardPeerDown -> recovery from the durable floor, never a wrong
+    board. Contract: the job completes, the board is byte-identical to
+    the solo sparse engine, each partition's shard journal holds exactly
+    ONE done record, and the reset class actually fired."""
+
+    def test_reset_mid_frame_is_exactly_once_and_byte_identical(
+            self, tmp_path, matrix_workers):
+        from gol_tpu.config import Convention
+        from gol_tpu.sparse import SparseBoard, TileMemo, simulate_sparse
+
+        root, workers = matrix_workers
+        rle = "x = 3, y = 3\nb2o$2o$bo!"  # r-pentomino on a tile corner
+        height = width = 512
+        tile, gens = 256, 12
+        fleet = Fleet(str(tmp_path / "fleet"))
+        for wid, srv in workers.items():
+            fleet.attach(srv.url, wid)
+        pool = ProxyPool(ChaosPlan.parse("seed=107,reset=0.25"))
+        router = RouterServer(fleet, port=0, chaos=pool)
+        router.start()
+        try:
+            base = router.url
+            status, payload = _http("POST", f"{base}/jobs", {
+                "shard": True, "rle": rle, "x": tile - 1, "y": tile - 1,
+                "width": width, "height": height, "tile": tile,
+                "convention": "c", "gen_limit": gens,
+                "check_similarity": False, "checkpoint_every": 4,
+            })
+            assert status == 202, payload
+            job_id = payload["id"]
+
+            def state():
+                try:
+                    st, job = _http("GET", f"{base}/jobs/{job_id}")
+                except (urllib.error.URLError, ConnectionError, OSError):
+                    return None
+                return job.get("state") if st == 200 else None
+
+            assert _wait(lambda: state() in ("done", "failed"),
+                         timeout=240), "shard job hung under reset chaos"
+            assert state() == "done"
+            status, result = _http("GET", f"{base}/result/{job_id}")
+            assert status == 200, result
+
+            cfg = GameConfig(gen_limit=gens, check_similarity=False,
+                             convention=Convention.C)
+            solo = simulate_sparse(
+                SparseBoard.from_rle(rle, height=height, width=width,
+                                     tile=tile, x=tile - 1, y=tile - 1),
+                cfg, TileMemo())
+            assert result["rle"] == solo.board.to_rle()
+            assert result["generations"] == solo.generations
+            assert result["exit_reason"] == solo.exit_reason
+
+            # The schedule actually fired: an idle proxy proves nothing.
+            assert pool.stats().get("reset", 0) > 0, pool.stats()
+        finally:
+            router.shutdown(cascade=False)
+
+        # Exactly-once across every partition's shard journal.
+        for wid in workers:
+            path = root / wid / f"shard-{job_id}.jsonl"
+            assert path.exists(), f"{wid} never journaled its shard"
+            dones = [json.loads(line)
+                     for line in path.read_text().splitlines()
+                     if line.strip()
+                     and json.loads(line).get("kind") == "done"]
+            assert len(dones) == 1, (wid, dones)
+
+
+# ---------------------------------------------------------------------------
 # The serve-side retry budget rides the scheduler
 
 

@@ -262,6 +262,19 @@ def test_no_wall_clock_in_sparse():
         )
 
 
+def test_no_wall_clock_in_shard():
+    """Same rule for gol_tpu/shard/: super-step barriers, halo retry
+    backoff, and recovery probing are all interval arithmetic — a
+    wall-clock jump (NTP step, suspend) must never fake a barrier
+    timeout or age a checkpoint. ``time.perf_counter()`` only."""
+    for needle in ("time.time(", "datetime.now"):
+        offenders = _offenders(_LIBRARY_ROOT / "shard", needle)
+        assert not offenders, (
+            f"wall-clock {needle} in gol_tpu/shard/ (use "
+            f"time.perf_counter() for any timing path): {offenders}"
+        )
+
+
 def test_no_wall_clock_in_macro():
     """Same rule for gol_tpu/macro/: macro jobs ride the same scheduler
     lanes as sparse ones and the advance memo feeds the same CAS — and a

@@ -1,0 +1,422 @@
+"""Sharded single-job engine (gol_tpu/shard) tests.
+
+The acceptance surface of ISSUE 18:
+
+- HRW tile ownership: total, deterministic, order-independent, and
+  MINIMALLY disruptive — adding a worker moves only tiles the joiner
+  now owns, retiring one moves exactly its tiles and nothing else;
+- halo-neighbor map two-sided consistency: what A sends to B is exactly
+  the ring set B's ghost assembly needs, for every ordered pair across
+  every moved boundary;
+- byte-identity (cells, generations, exit_reason) of a sharded run at
+  N in {2, 3} against the single-process sparse engine — glider, Gosper
+  gun, and r-pentomino loads, both conventions, all three exit reasons;
+- SIGKILL-mid-super-step replay: a killed worker's shard replays from
+  its own journal at the durable super-step, the survivors rewind in
+  memory, and the finished board is still byte-identical;
+- owned-filtered RLE loading: a worker owning one slice of a 2^20-wide
+  document loads only its tiles;
+- ghost-ring stepping: step_tiles over a partition's shards with halo
+  ghosts unions to the solo step, byte for byte.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_tpu.config import Convention, GameConfig
+from gol_tpu.shard import halo
+from gol_tpu.shard.coordinator import LocalCluster, ShardCoordinator
+from gol_tpu.shard.partition import Partition, moved_tiles, tile_label
+from gol_tpu.shard.worker import ShardHost
+from gol_tpu.sparse import SparseBoard, SparseStats, TileMemo, simulate_sparse
+from gol_tpu.sparse.engine import step_tiles
+
+GLIDER_RLE = "x = 3, y = 3, rule = B3/S23\nbob$2bo$3o!"
+RPENTO_RLE = "x = 3, y = 3\nb2o$2o$bo!"
+DOMINO_RLE = "x = 2, y = 1\n2o!"  # dies in one generation -> empty
+BLOCK_RLE = "x = 2, y = 2\n2o$2o!"  # still life -> similar
+GOSPER_RLE = """x = 36, y = 9, rule = B3/S23
+24bo$22bobo$12b2o6b2o12b2o$11bo3bo4b2o12b2o$2o8bo5bo3b2o$2o8bo3bob2o4b
+obo$10bo5bo7bo$11bo3bo$12b2o!"""
+
+H = W = 768
+TILE = 256
+
+
+def _ids(n):
+    return [f"w{i}" for i in range(n)]
+
+
+def _all_coords(part):
+    return [(ty, tx) for ty in range(part.tiles_y)
+            for tx in range(part.tiles_x)]
+
+
+# ---------------------------------------------------------------------------
+# HRW tile ownership
+
+
+class TestPartition:
+    def test_ownership_total_deterministic_order_independent(self):
+        a = Partition(_ids(3), 8, 8)
+        b = Partition(list(reversed(_ids(3))), 8, 8)
+        for coord in _all_coords(a):
+            owner = a.owner(coord)
+            assert owner in a.worker_ids
+            assert b.owner(coord) == owner  # id-set, not id-order
+
+    def test_join_moves_only_tiles_the_joiner_now_owns(self):
+        old = Partition(_ids(3), 16, 16)
+        new = Partition(_ids(4), 16, 16)
+        coords = _all_coords(old)
+        moved = moved_tiles(old, new, coords)
+        assert moved, "a 4th worker must win some tiles"
+        for coord in moved:
+            assert new.owner(coord) == "w3", (
+                f"{coord} moved between SURVIVORS "
+                f"({old.owner(coord)} -> {new.owner(coord)}) — HRW "
+                "minimal disruption broken"
+            )
+        for coord in set(coords) - moved:
+            assert new.owner(coord) == old.owner(coord)
+
+    def test_retire_moves_exactly_the_departed_workers_tiles(self):
+        old = Partition(_ids(3), 16, 16)
+        new = Partition(["w0", "w2"], 16, 16)
+        coords = _all_coords(old)
+        moved = moved_tiles(old, new, coords)
+        assert moved == {c for c in coords if old.owner(c) == "w1"}
+
+    def test_for_universe_rejects_indivisible_geometry(self):
+        with pytest.raises(ValueError):
+            Partition.for_universe(_ids(2), 1000, 1024, 256)
+
+    def test_label_is_stable(self):
+        # The HRW key: labels are the placement contract — changing the
+        # format reshuffles every deployed shard map.
+        assert tile_label(3, 17) == "tile:3:17"
+
+
+# ---------------------------------------------------------------------------
+# Halo-neighbor map: two-sided consistency
+
+
+class TestHaloMap:
+    def _shard_boards(self, part):
+        """Per-worker boards holding ONLY owned tiles (the production
+        shape — each ShardHost loads its slice), every ring live."""
+        boards = {wid: SparseBoard(part.tiles_y * TILE,
+                                   part.tiles_x * TILE, TILE)
+                  for wid in part.worker_ids}
+        for coord in _all_coords(part):
+            boards[part.owner(coord)].set_tile(
+                coord, np.ones((TILE, TILE), dtype=np.uint8))
+        return boards
+
+    @pytest.mark.parametrize("n", [2, 3, 4])
+    def test_sent_set_equals_needed_set_for_every_pair(self, n):
+        part = Partition(_ids(n), 6, 6)
+        boards = self._shard_boards(part)
+        coords = set(_all_coords(part))
+        sent = {wid: halo.outgoing(boards[wid], part, wid)
+                for wid in part.worker_ids}
+        for a in part.worker_ids:
+            for b in part.worker_ids:
+                if a == b:
+                    continue
+                got = set((sent[a].get(b) or {}).keys())
+                # Sender's view: my tiles with a neighbor owned by b.
+                want_send = {
+                    c for c in coords
+                    if part.owner(c) == a
+                    and any(part.owner(nc) == b
+                            for nc in part.neighbors(c))
+                }
+                # Receiver's view: a's tiles adjacent to MY tiles — the
+                # rings b's ghost assembly will look up. The 8-neighbor
+                # torus relation is symmetric, so the two sides must
+                # name the same set; a mismatch is a halo deadlock (b
+                # waits for a ring a never sends) or a wrong board.
+                want_recv = {
+                    nc
+                    for c in coords if part.owner(c) == b
+                    for nc in part.neighbors(c) if part.owner(nc) == a
+                }
+                assert got == want_send == want_recv, (a, b)
+
+    def test_moved_boundary_recomputes_consistently_on_both_sides(self):
+        old = Partition(_ids(3), 6, 6)
+        new = Partition(_ids(4), 6, 6)
+        boards = self._shard_boards(new)
+        moved = moved_tiles(old, new, _all_coords(old))
+        assert moved
+        sent = {wid: halo.outgoing(boards[wid], new, wid)
+                for wid in new.worker_ids}
+        for coord in moved:
+            for nc in new.neighbors(coord):
+                a, b = new.owner(nc), new.owner(coord)
+                if a == b:
+                    continue
+                # Every cross-owner edge of a moved tile appears in the
+                # new sender's map toward the new owner...
+                assert nc in sent[a][b], (coord, nc)
+                # ...and the moved tile itself flows back the other way.
+                assert coord in sent[b][a], (coord, nc)
+
+    def test_dead_rings_are_not_sent(self):
+        part = Partition(_ids(2), 3, 3)
+        board = SparseBoard(3 * TILE, 3 * TILE, TILE)
+        arr = np.zeros((TILE, TILE), dtype=np.uint8)
+        arr[100:103, 100:103] = 1  # interior only: ring all-dead
+        for coord in _all_coords(part):
+            board.set_tile(coord, arr)
+        for wid in part.worker_ids:
+            assert not any(halo.outgoing(board, part, wid).values()), (
+                "all-dead rings were sent — a remote live tile with a "
+                "dead ring must be indistinguishable from an absent one"
+            )
+
+    def test_halo_frame_round_trip(self):
+        part = Partition(_ids(2), 3, 3)
+        board = self._shard_boards(part)["w0"]
+        out = halo.outgoing(board, part, "w0")
+        (peer, entries), = out.items()
+        raw = halo.encode("job", 7, "w0", entries, TILE)
+        meta, rings = halo.decode(raw)
+        assert (meta["job"], meta["step"], meta["from"]) == ("job", 7, "w0")
+        assert set(rings) == set(entries)
+        for coord, ring in rings.items():
+            for side, arr in zip(ring._fields, ring):
+                np.testing.assert_array_equal(
+                    arr, getattr(entries[coord], side))
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity against the single-process sparse engine
+
+_SOLO_CACHE: dict = {}
+
+
+def _solo(rle, conv, gen_limit, x=250, y=250, h=H, w=W):
+    key = (rle, conv, gen_limit, x, y, h, w)
+    if key not in _SOLO_CACHE:
+        cfg = GameConfig(gen_limit=gen_limit, convention=conv)
+        board = SparseBoard.from_rle(rle, height=h, width=w, tile=TILE,
+                                     x=x, y=y)
+        res = simulate_sparse(board, cfg, TileMemo())
+        _SOLO_CACHE[key] = (res.board.to_rle(), res.generations,
+                            res.exit_reason)
+    return _SOLO_CACHE[key]
+
+
+def _shard_run(tmp_path, rle, conv, n, gen_limit, x=250, y=250, h=H, w=W,
+               checkpoint_every=8):
+    cfg = GameConfig(gen_limit=gen_limit, convention=conv)
+    cluster = LocalCluster(_ids(n), journal_root=str(tmp_path))
+    spec = {"rle": rle, "x": x, "y": y, "height": h, "width": w,
+            "tile": TILE, "convention": conv, "gen_limit": gen_limit,
+            "check_similarity": cfg.check_similarity,
+            "similarity_frequency": cfg.similarity_frequency}
+    coord = ShardCoordinator("job", spec, cluster.participants(),
+                             checkpoint_every=checkpoint_every)
+    return coord.run()
+
+
+class TestByteIdentity:
+    @pytest.mark.parametrize("conv", [Convention.C, Convention.CUDA])
+    @pytest.mark.parametrize("n", [2, 3])
+    def test_glider_gen_limit(self, tmp_path, conv, n):
+        res = _shard_run(tmp_path, GLIDER_RLE, conv, n, 40)
+        assert (res["rle"], res["generations"], res["exit_reason"]) == \
+            _solo(GLIDER_RLE, conv, 40)
+
+    @pytest.mark.parametrize("conv", [Convention.C, Convention.CUDA])
+    @pytest.mark.parametrize("n", [2, 3])
+    def test_gosper_gun(self, tmp_path, conv, n):
+        res = _shard_run(tmp_path, GOSPER_RLE, conv, n, 36)
+        assert (res["rle"], res["generations"], res["exit_reason"]) == \
+            _solo(GOSPER_RLE, conv, 36)
+
+    @pytest.mark.parametrize("conv", [Convention.C, Convention.CUDA])
+    @pytest.mark.parametrize("n", [2, 3])
+    def test_r_pentomino(self, tmp_path, conv, n):
+        res = _shard_run(tmp_path, RPENTO_RLE, conv, n, 40)
+        assert (res["rle"], res["generations"], res["exit_reason"]) == \
+            _solo(RPENTO_RLE, conv, 40)
+
+    @pytest.mark.parametrize("conv", [Convention.C, Convention.CUDA])
+    def test_exit_empty(self, tmp_path, conv):
+        res = _shard_run(tmp_path, DOMINO_RLE, conv, 2, 40)
+        ref = _solo(DOMINO_RLE, conv, 40)
+        assert ref[2] == "empty"  # the load must actually die
+        assert (res["rle"], res["generations"], res["exit_reason"]) == ref
+
+    @pytest.mark.parametrize("conv", [Convention.C, Convention.CUDA])
+    def test_exit_similar(self, tmp_path, conv):
+        res = _shard_run(tmp_path, BLOCK_RLE, conv, 2, 40)
+        ref = _solo(BLOCK_RLE, conv, 40)
+        assert ref[2] == "similar"
+        assert (res["rle"], res["generations"], res["exit_reason"]) == ref
+
+    def test_pattern_straddling_worker_boundary(self, tmp_path):
+        # The r-pentomino dead on a tile corner: its growth crosses every
+        # adjacent tile, so wrong/missing halos show up immediately.
+        res = _shard_run(tmp_path, RPENTO_RLE, Convention.C, 3, 32,
+                         x=TILE - 1, y=TILE - 1)
+        assert (res["rle"], res["generations"], res["exit_reason"]) == \
+            _solo(RPENTO_RLE, Convention.C, 32, x=TILE - 1, y=TILE - 1)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL-mid-super-step replay
+
+
+class TestKillRestore:
+    @pytest.mark.parametrize("conv", [Convention.C, Convention.CUDA])
+    def test_killed_worker_replays_only_its_shard(self, tmp_path, conv):
+        gen_limit = 40
+        cfg = GameConfig(gen_limit=gen_limit, convention=conv)
+        cluster = LocalCluster(_ids(3), journal_root=str(tmp_path))
+        spec = {"rle": GLIDER_RLE, "x": 250, "y": 250, "height": H,
+                "width": W, "tile": TILE, "convention": conv,
+                "gen_limit": gen_limit,
+                "check_similarity": cfg.check_similarity,
+                "similarity_frequency": cfg.similarity_frequency}
+        coord = ShardCoordinator("job", spec, cluster.participants(),
+                                 checkpoint_every=4, probe_interval=0.05,
+                                 recover_timeout=30)
+        out: dict = {}
+        t = threading.Thread(target=lambda: out.update(res=coord.run()))
+        t.start()
+        deadline = time.perf_counter() + 60
+        while coord.k < 9:  # past the durable floor at 8, mid-super-step
+            assert time.perf_counter() < deadline, "never reached step 9"
+            assert t.is_alive(), "coordinator died before the kill"
+            time.sleep(0.01)
+        cluster.kill("w1")
+        time.sleep(0.2)
+        cluster.respawn("w1")  # fresh host, same journal dir
+        t.join(timeout=120)
+        assert not t.is_alive(), "coordinator hung after the kill"
+        res = out["res"]
+        assert res["recoveries"] >= 1, "the kill was never exercised"
+        assert (res["rle"], res["generations"], res["exit_reason"]) == \
+            _solo(GLIDER_RLE, conv, gen_limit)
+
+    def test_respawned_host_restores_from_its_own_journal_only(
+            self, tmp_path):
+        # Direct host-level pin of "replays ONLY its shard": the restore
+        # payload names a step; the fresh host rebuilds from the ckpt
+        # record in ITS journal dir and answers status at that step.
+        cfg = GameConfig(gen_limit=8, convention=Convention.C)
+        cluster = LocalCluster(_ids(2), journal_root=str(tmp_path))
+        spec = {"rle": GLIDER_RLE, "x": 250, "y": 250, "height": H,
+                "width": W, "tile": TILE, "convention": Convention.C,
+                "gen_limit": 8,
+                "check_similarity": cfg.check_similarity,
+                "similarity_frequency": cfg.similarity_frequency}
+        coord = ShardCoordinator("job", spec, cluster.participants(),
+                                 checkpoint_every=4)
+        coord.run()
+        # The job is finished; a fresh process on w1's journal can still
+        # restore the durable step-4 checkpoint of w1's shard.
+        cluster.kill("w1")
+        host = cluster.respawn("w1")
+        assert isinstance(host, ShardHost)
+        reply = host.restore_job({
+            "job": "job", "spec": spec, "self": "w1",
+            "workers": _ids(2), "step": 4,
+            "peers": {"w0": "local://w0"},
+        })
+        assert reply["step"] == 4
+        status = host.status("job")
+        assert status["known"] and status["step"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Owned-filtered RLE loading (the giant-document slice contract)
+
+
+class TestOwnedLoading:
+    def test_owned_filter_loads_only_the_slice(self):
+        full = SparseBoard.from_rle(GLIDER_RLE, height=H, width=W,
+                                    tile=TILE, x=10, y=10)
+        assert set(full.tiles) == {(0, 0)}
+        sliced = SparseBoard.from_rle(
+            GLIDER_RLE, height=H, width=W, tile=TILE, x=10, y=10,
+            owned=lambda c: c == (0, 0))
+        np.testing.assert_array_equal(sliced.tiles[(0, 0)],
+                                      full.tiles[(0, 0)])
+        empty = SparseBoard.from_rle(
+            GLIDER_RLE, height=H, width=W, tile=TILE, x=10, y=10,
+            owned=lambda c: c == (1, 1))
+        assert not empty.tiles
+
+    def test_two_to_the_twenty_document_loads_on_a_slice_owner(self):
+        # A WHOLE-universe 2^20-per-side document: the glider sits half a
+        # million blank rows and columns into the text itself (giant run
+        # counts, not x/y placement), and a worker owning one 256^2 tile
+        # of it must load just that slice.
+        side = 1 << 20  # 4096x4096 tiles: far past any dense guard
+        half = side // 2
+        doc = (f"x = {side}, y = {side}\n"
+               f"{half}${half}bbob${half}b2bo${half}b3o!")
+        board = SparseBoard.from_rle(
+            doc, height=side, width=side, tile=TILE,
+            owned=lambda c: c == (half // TILE, half // TILE))
+        assert set(board.tiles) == {(half // TILE, half // TILE)}
+        assert board.population() == 5
+
+    def test_partitioned_load_is_a_partition_of_the_full_load(self):
+        part = Partition(_ids(3), H // TILE, W // TILE)
+        full = SparseBoard.from_rle(GOSPER_RLE, height=H, width=W,
+                                    tile=TILE, x=300, y=300)
+        shards = {
+            wid: SparseBoard.from_rle(GOSPER_RLE, height=H, width=W,
+                                      tile=TILE, x=300, y=300,
+                                      owned=part.owns(wid))
+            for wid in part.worker_ids
+        }
+        seen = {}
+        for wid, shard in shards.items():
+            for coord, arr in shard.tiles.items():
+                assert part.owner(coord) == wid
+                assert coord not in seen
+                seen[coord] = arr
+        assert set(seen) == set(full.tiles)
+        for coord, arr in seen.items():
+            np.testing.assert_array_equal(arr, full.tiles[coord])
+
+
+# ---------------------------------------------------------------------------
+# Ghost-ring stepping: the distributed step IS the solo step
+
+
+class TestGhostStep:
+    def test_partitioned_step_unions_to_the_solo_step(self):
+        part = Partition(_ids(3), H // TILE, W // TILE)
+        solo = SparseBoard.from_rle(RPENTO_RLE, height=H, width=W,
+                                    tile=TILE, x=TILE - 1, y=TILE - 1)
+        want, _ = step_tiles(solo, TileMemo(), SparseStats())
+
+        shards = {
+            wid: SparseBoard.from_rle(RPENTO_RLE, height=H, width=W,
+                                      tile=TILE, x=TILE - 1, y=TILE - 1,
+                                      owned=part.owns(wid))
+            for wid in part.worker_ids
+        }
+        merged = SparseBoard(H, W, TILE)
+        for wid, shard in shards.items():
+            ghost: dict = {}
+            for other, board in shards.items():
+                if other != wid:
+                    ghost.update(
+                        halo.outgoing(board, part, other).get(wid) or {})
+            stepped, _ = step_tiles(shard, TileMemo(), SparseStats(),
+                                    ghost=ghost, owned=part.owns(wid))
+            for coord, arr in stepped.tiles.items():
+                merged.set_tile(coord, arr)
+        assert merged.to_rle() == want.to_rle()

@@ -1,0 +1,339 @@
+"""Sharded-universe smoke: SIGKILL a shard worker mid-super-step.
+
+The `make shard-smoke` harness, exercising the ISSUE 18 acceptance
+end-to-end against real OS processes:
+
+1. boot ``gol fleet --workers 3`` on a fresh ``--fleet-dir`` (3 journal
+   partitions + the membership manifest);
+2. submit ONE giant-universe job with ``"shard": true`` — the router's
+   leader-only shard coordinator partitions the tile grid across all 3
+   workers by HRW, drives super-steps over real HTTP halo frames, and
+   journals per-owner checkpoints into each worker's OWN partition;
+3. wait until the job is past its first durable checkpoint, then SIGKILL
+   the worker owning the most live tiles, mid-super-step;
+4. the fleet health loop respawns the victim on the SAME partition; the
+   coordinator rewinds the survivors to the durable super-step in memory
+   and restores the victim from its shard journal — the victim replays
+   ONLY its own shard (restore records must appear on it and nowhere
+   else);
+5. the finished board must be byte-identical (RLE text, generations,
+   exit_reason) to an uninterrupted single-process `simulate_sparse` run
+   of the same spec;
+6. exactly-once audit across ALL partition shard journals: every hosting
+   partition holds exactly ONE done record for the job, and the job's
+   recovery counter shows the kill was actually exercised;
+7. SIGTERM the fleet: the cascaded drain must exit rc 0 with every
+   worker pid gone.
+
+Exit code 0 on success, 1 with a diagnostic on any violation:
+
+    python tools/shard_smoke.py [--gen-limit 80] [--kill-at 10]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gol_tpu.config import GameConfig  # noqa: E402
+from gol_tpu.shard.partition import Partition  # noqa: E402
+from gol_tpu.sparse import SparseBoard, TileMemo, simulate_sparse  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TILE = 256
+UNIVERSE = 4096  # 16x16 tiles of 256^2
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(method, url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _start_fleet(port: int, fleet_dir: str, workers: int = 3):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu", "fleet",
+            "--port", str(port),
+            "--workers", str(workers),
+            "--fleet-dir", fleet_dir,
+            "--health-interval", "0.5",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.perf_counter() + 300
+    base = f"http://127.0.0.1:{port}"
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(
+                f"fleet died on boot rc={proc.returncode}:\n{out[-4000:]}"
+            )
+        try:
+            status, payload = _http("GET", f"{base}/healthz", timeout=2)
+            if status == 200 and payload.get("fleet", {}).get("workers") == workers:
+                return proc
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("fleet did not become healthy within 300s")
+
+
+def _glider_board() -> SparseBoard:
+    """16 gliders spread over the 16x16 tile grid, a few on tile edges so
+    halo frames carry live rings across worker boundaries."""
+    glider = np.zeros((3, 3), dtype=np.uint8)
+    glider[0, 1] = glider[1, 2] = glider[2, 0] = glider[2, 1] = glider[2, 2] = 1
+    board = SparseBoard(UNIVERSE, UNIVERSE, TILE)
+    for i in range(4):
+        for j in range(4):
+            arr = np.zeros((TILE, TILE), dtype=np.uint8)
+            if (i + j) % 3 == 0:
+                arr[1:4, 120:123] = glider  # top edge: live halo ring
+            else:
+                arr[120:123, 120:123] = glider
+            board.set_tile((2 + 3 * i, 2 + 3 * j), arr)
+    return board
+
+
+def _shard_records(fleet_dir: str, job_id: str) -> dict:
+    """worker partition -> list of shard-journal records for the job."""
+    out = {}
+    for name in sorted(os.listdir(fleet_dir)):
+        path = os.path.join(fleet_dir, name, f"shard-{job_id}.jsonl")
+        if not os.path.isfile(path):
+            continue
+        recs = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn tail — the engine tolerates it, so do we
+        out[name] = recs
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--gen-limit", type=int, default=80)
+    parser.add_argument(
+        "--kill-at", type=int, default=10,
+        help="SIGKILL the victim once the coordinator reports this "
+        "super-step (past the first durable checkpoint at 8)",
+    )
+    args = parser.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="gol-shard-smoke-")
+    fleet_dir = os.path.join(workdir, "fleet")
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    board = _glider_board()
+    rle = board.to_rle()
+
+    rc = 1
+    proc = None
+    try:
+        proc = _start_fleet(port, fleet_dir)
+        print(f"shard-smoke: 3-worker fleet up on {base}, dir {fleet_dir}")
+
+        status, payload = _http("POST", f"{base}/jobs", {
+            "shard": True, "rle": rle, "x": 0, "y": 0,
+            "width": UNIVERSE, "height": UNIVERSE, "tile": TILE,
+            "convention": "c", "gen_limit": args.gen_limit,
+            "check_similarity": False, "checkpoint_every": 8,
+        })
+        if status != 202:
+            print(f"shard-smoke: submit rejected HTTP {status}: {payload}")
+            return 1
+        job_id = payload["id"]
+        workers = payload["workers"]
+        print(f"shard-smoke: shard job {job_id} across {workers}")
+
+        # The victim: the worker owning the most live tiles (it must have
+        # real shard state to replay). Ownership is the same pure HRW
+        # function the coordinator used.
+        part = Partition(workers, UNIVERSE // TILE, UNIVERSE // TILE)
+        counts = part.counts(board.tiles)
+        victim_id = max(counts, key=lambda k: counts[k])
+
+        # Kill mid-super-step, past the first durable checkpoint.
+        deadline = time.perf_counter() + 300
+        while True:
+            if time.perf_counter() > deadline:
+                print("shard-smoke: job never reached the kill point")
+                return 1
+            status, job = _http("GET", f"{base}/jobs/{job_id}", timeout=10)
+            if status != 200 or job.get("state") == "failed":
+                print(f"shard-smoke: job lost before kill: {status} {job}")
+                return 1
+            if job.get("state") == "done":
+                print(f"shard-smoke: job finished before super-step "
+                      f"{args.kill_at}; raise --gen-limit")
+                return 1
+            if job.get("superstep", 0) >= args.kill_at:
+                break
+            time.sleep(0.02)
+        status, fl = _http("GET", f"{base}/fleet")
+        victim = next(w for w in fl["workers"] if w["id"] == victim_id)
+        print(f"shard-smoke: SIGKILL {victim_id} (pid {victim['pid']}, "
+              f"{counts[victim_id]} live tiles) at super-step "
+              f"{job['superstep']} (durable {job['durable_superstep']})")
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        # The health loop respawns the victim on its partition; the
+        # coordinator recovers to the durable floor and the job finishes.
+        deadline = time.perf_counter() + 600
+        while True:
+            if time.perf_counter() > deadline:
+                print("shard-smoke: job never completed after the kill")
+                return 1
+            try:
+                status, job = _http("GET", f"{base}/jobs/{job_id}",
+                                    timeout=10)
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+                continue
+            if status != 200 or job.get("state") == "failed":
+                print(f"shard-smoke: job died after kill: {status} {job}")
+                return 1
+            if job.get("state") == "done":
+                break
+            time.sleep(0.1)
+        if job.get("recoveries", 0) < 1:
+            print(f"shard-smoke: kill was not exercised (recoveries "
+                  f"{job.get('recoveries')})")
+            return 1
+        status, fl = _http("GET", f"{base}/fleet")
+        restarts = sum(w["restarts"] for w in fl["workers"])
+        if restarts < 1:
+            print(f"shard-smoke: expected a respawned worker: {fl}")
+            return 1
+        print(f"shard-smoke: job done through the kill "
+              f"({job['recoveries']} recovery, {restarts} restart(s))")
+
+        status, result = _http("GET", f"{base}/result/{job_id}",
+                               timeout=300)
+        if status != 200:
+            print(f"shard-smoke: result HTTP {status}: {result}")
+            return 1
+
+        # Byte-identity against an uninterrupted single-process sparse run.
+        cfg = GameConfig(gen_limit=args.gen_limit, check_similarity=False,
+                         convention="c")
+        solo = simulate_sparse(_glider_board(), cfg, TileMemo())
+        if (result["rle"] != solo.board.to_rle()
+                or result["generations"] != solo.generations
+                or result["exit_reason"] != solo.exit_reason):
+            print(f"shard-smoke: sharded result diverges from solo sparse "
+                  f"(gens {result['generations']} vs {solo.generations}, "
+                  f"exit {result['exit_reason']} vs {solo.exit_reason}, "
+                  f"rle match {result['rle'] == solo.board.to_rle()})")
+            return 1
+        print(f"shard-smoke: board byte-identical to solo sparse "
+              f"({result['generations']} generations, "
+              f"{result['exit_reason']})")
+
+        # Drain before the journal audit so every fsync has landed.
+        pids = [w["pid"] for w in fl["workers"] if w["pid"]]
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            print("shard-smoke: fleet ignored SIGTERM")
+            proc.kill()
+            return 1
+        if proc.returncode != 0:
+            print(f"shard-smoke: fleet exited rc={proc.returncode}:\n"
+                  f"{out[-3000:]}")
+            return 1
+        proc = None
+        for pid in pids:
+            try:
+                os.kill(pid, 0)
+                print(f"shard-smoke: worker pid {pid} survived the drain")
+                return 1
+            except ProcessLookupError:
+                pass
+
+        # Exactly-once audit: one done record per hosting partition, and
+        # restore records ONLY on the victim (survivors rewind in memory —
+        # a restore record elsewhere means somebody replayed a shard that
+        # was never lost).
+        records = _shard_records(fleet_dir, job_id)
+        if set(records) != set(workers):
+            print(f"shard-smoke: partitions with shard journals "
+                  f"{sorted(records)} != job workers {sorted(workers)}")
+            return 1
+        bad = False
+        for name, recs in records.items():
+            dones = [r for r in recs if r.get("kind") == "done"]
+            restores = [r for r in recs if r.get("kind") == "restore"]
+            if len(dones) != 1:
+                print(f"shard-smoke: partition {name} has {len(dones)} "
+                      f"done record(s), want exactly 1")
+                bad = True
+            if name == victim_id and not restores:
+                print(f"shard-smoke: victim {name} has no restore record "
+                      f"— its shard was never replayed from journal")
+                bad = True
+            if name != victim_id and restores:
+                print(f"shard-smoke: survivor {name} has restore "
+                      f"record(s) {restores} — replayed a shard that was "
+                      f"never lost")
+                bad = True
+        if bad:
+            return 1
+        done_steps = {name: recs[-1]["step"] for name, recs in
+                      records.items()
+                      if recs and recs[-1].get("kind") == "done"}
+        print(f"shard-smoke: PASS — exactly one done record per "
+              f"partition {done_steps}, restore only on {victim_id}, "
+              "cascaded drain clean")
+        rc = 0
+        return 0
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        if rc == 0:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            print(f"shard-smoke: artifacts kept in {workdir}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
